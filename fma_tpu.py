@@ -1,0 +1,7 @@
+"""Short import alias: ``import fma_tpu`` == ``import llm_d_fast_model_actuation_tpu``."""
+
+import sys
+
+import llm_d_fast_model_actuation_tpu as _pkg
+
+sys.modules[__name__] = _pkg

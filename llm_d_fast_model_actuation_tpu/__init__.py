@@ -1,0 +1,21 @@
+"""llm-d-fast-model-actuation for TPU — a TPU-native fast-model-actuation framework.
+
+A ground-up re-design, for TPU hardware, of the capabilities of
+`llm-d-incubation/llm-d-fast-model-actuation` (the "reference"):
+
+* an **inference engine** stratum (the reference delegates this to vLLM+CUDA;
+  here it is JAX/XLA/Pallas-native: bf16 matmuls on the MXU, paged KV cache,
+  ``jit``-compiled prefill/decode, ``jax.sharding.Mesh`` TP/DP/SP over ICI),
+* **level-1 sleep/wake**: live model tensors move HBM <-> pinned host memory
+  via XLA memory kinds without killing the serving process
+  (reference: vLLM sleep mode, ``README.md:16-26``),
+* a **launcher** that preloads JAX/libtpu and spawns/kills engine instances
+  via a REST API (reference: ``inference_server/launcher/launcher.py``),
+* the **dual-pods** control plane: server-requesting / server-providing Pod
+  pairing, binding state machine, sleeper budget, launcher population policy
+  (reference: ``pkg/controller/dual-pods``, ``pkg/controller/launcher-populator``).
+
+Import alias: ``import llm_d_fast_model_actuation_tpu as fma_tpu``.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,25 @@
+"""Public API contract of the dual-pods technique, TPU edition.
+
+The annotation/label vocabulary is kept wire-compatible with the reference
+(`pkg/api/interface.go`, `pkg/controller/common/interface.go`) so that an
+existing llm-d FMA deployment can switch engines without re-teaching its
+ecosystem (EPP routing, autoscalers, benchmarks). TPU-specific additions use
+the same domain with new suffixes.
+"""
+
+from .constants import *  # noqa: F401,F403
+from .types import (  # noqa: F401
+    AcceleratorSpec,
+    EngineServerConfig,
+    InferenceServerConfig,
+    InferenceServerConfigSpec,
+    LauncherConfig,
+    LauncherConfigSpec,
+    LauncherPopulationPolicy,
+    LauncherPopulationPolicySpec,
+    ObjectMeta,
+    ServerRequestingPodStatus,
+    SleepState,
+    SliceTopology,
+    Status,
+)

@@ -1,0 +1,148 @@
+"""Annotation / label / port contract between users, controllers, and agents.
+
+Parity map (reference `file:line` -> here):
+  pkg/api/interface.go:47-135      -> user-facing annotations and FYI labels
+  pkg/controller/common/interface.go:19-42 -> controller-internal shared keys
+  pkg/spi/interface.go:29-61       -> requester SPI paths (see `spi.py`)
+
+In the dual-pods technique a *server-requesting Pod* is a stub that holds the
+TPU allocation in the eyes of the scheduler, while the *server-providing Pod*
+actually runs the inference engine but is accounted as using zero TPU chips.
+These constants are the single vocabulary binding the two.
+"""
+
+# --------------------------------------------------------------------------
+# User-facing annotations on the server-requesting Pod.
+# --------------------------------------------------------------------------
+
+#: Annotation holding a template that transforms the requesting Pod's
+#: (de-individualized) spec into the providing Pod's spec — a strategic-merge
+#: patch rendered with :class:`ProviderData`. Mutually exclusive with
+#: :data:`INFERENCE_SERVER_CONFIG_ANNOTATION`.
+SERVER_PATCH_ANNOTATION = "dual-pods.llm-d.ai/server-patch"
+
+#: Annotation naming the InferenceServerConfig the providing Pod uses
+#: (launcher-based path). Mutually exclusive with
+#: :data:`SERVER_PATCH_ANNOTATION`.
+INFERENCE_SERVER_CONFIG_ANNOTATION = "dual-pods.llm-d.ai/inference-server-config"
+
+#: Annotation maintained by the dual-pods controller reporting
+#: :class:`~..api.types.ServerRequestingPodStatus` as JSON.
+STATUS_ANNOTATION = "dual-pods.llm-d.ai/status"
+
+#: Name of the container (in the requesting Pod) that the server patch
+#: describes and that the providing Pod actually runs.
+INFERENCE_SERVER_CONTAINER_NAME = "inference-server"
+
+#: Annotation naming the port of the requester stub's SPI server.
+ADMIN_PORT_ANNOTATION = "dual-pods.llm-d.ai/admin-port"
+
+#: Default SPI port of the requester stub.
+ADMIN_PORT_DEFAULT = "8081"
+
+# --------------------------------------------------------------------------
+# FYI annotations/labels emitted by the dual-pods controller.
+# --------------------------------------------------------------------------
+
+#: FYI annotation listing the accelerator (TPU chip) IDs associated with a
+#: requesting/providing Pod pair.
+ACCELERATORS_ANNOTATION = "dual-pods.llm-d.ai/accelerators"
+
+#: FYI annotation marking a providing Pod as launcher-based.
+LAUNCHER_BASED_ANNOTATION = "dual-pods.llm-d.ai/launcher-based"
+
+#: FYI label: while bound, present on both Pods with the other Pod's name.
+DUAL_LABEL = "dual-pods.llm-d.ai/dual"
+
+#: FYI label on a bound requesting Pod: the engine instance ID.
+INSTANCE_LABEL = "dual-pods.llm-d.ai/instance"
+
+#: FYI label on providing Pods: "true"/"false" — whether (all instances of)
+#: the provider are asleep.
+SLEEPING_LABEL = "dual-pods.llm-d.ai/sleeping"
+
+# --------------------------------------------------------------------------
+# Controller-internal shared keys (dual-pods controller <-> populator <->
+# launcher template builder).
+# --------------------------------------------------------------------------
+
+#: Annotation on a providing Pod naming the requesting Pod bound to it
+#: ("<name>" or "<name>/<uid>"): presence == bound.
+REQUESTER_ANNOTATION = "dual-pods.llm-d.ai/requester"
+
+COMPONENT_LABEL = "app.kubernetes.io/component"
+LAUNCHER_COMPONENT = "launcher"
+
+#: Label on launcher Pods naming their LauncherConfig.
+LAUNCHER_CONFIG_NAME_LABEL = "dual-pods.llm-d.ai/launcher-config-name"
+
+#: Label on launcher Pods naming their Node.
+NODE_NAME_LABEL = "dual-pods.llm-d.ai/node-name"
+
+#: Annotation: node-specialized hash of the launcher config a providing Pod
+#: was built from.
+LAUNCHER_CONFIG_HASH_ANNOTATION = "dual-pods.llm-d.ai/launcher-config-hash"
+
+#: Annotation: node-independent launcher template hash, for drift detection
+#: by the populator.
+LAUNCHER_TEMPLATE_HASH_ANNOTATION = "dual-pods.llm-d.ai/launcher-populator-template-hash"
+
+#: Port on which every launcher exposes its instance-management REST API.
+LAUNCHER_SERVICE_PORT = 8001
+
+# --------------------------------------------------------------------------
+# Instance state persisted on launcher Pods (restart recovery).
+# Reference: pkg/controller/dual-pods/controller.go:63-115.
+# --------------------------------------------------------------------------
+
+#: Annotation: ID of the engine instance serving the bound requester.
+INSTANCE_ID_ANNOTATION = "dual-pods.llm-d.ai/instance-id"
+
+#: Annotation: port the bound instance serves on.
+SERVER_PORT_ANNOTATION = "dual-pods.llm-d.ai/server-port"
+
+#: Annotation: JSON of the engine config the bound instance was created with.
+ENGINE_CONFIG_ANNOTATION = "dual-pods.llm-d.ai/engine-config"
+
+#: Annotation: JSON of the ISC routing labels/annotations stamped while bound.
+ISC_ROUTING_METADATA_ANNOTATION = "dual-pods.llm-d.ai/isc-routing-metadata"
+
+#: Annotation patched by the launcher notifier sidecar: SHA-256 signature of
+#: the sorted (instance_id, status) pairs — turns node-local instance state
+#: changes into Pod events. Reference: launcher_pod_notifier.py:16-198.
+INSTANCE_SIGNATURE_ANNOTATION = "dual-pods.llm-d.ai/vllm-instance-signature"
+
+# --------------------------------------------------------------------------
+# TPU-specific additions (no GPU-reference equivalent).
+# --------------------------------------------------------------------------
+
+#: Resource name of TPU chips in Kubernetes.
+TPU_RESOURCE = "google.com/tpu"
+
+#: Annotation on Nodes / providing Pods recording the slice topology
+#: (e.g. "2x4" for a v5e-8 host). The controller's placement logic is
+#: topology-aware, not a flat chip-index space.
+SLICE_TOPOLOGY_ANNOTATION = "dual-pods.llm-d.ai/tpu-topology"
+
+#: Env var pinning the set of TPU chips visible to an engine process
+#: (comma-separated local chip indices) — the TPU analogue of
+#: CUDA_VISIBLE_DEVICES.
+TPU_VISIBLE_DEVICES_ENV = "TPU_VISIBLE_DEVICES"
+
+#: Env vars used to run multiple engine processes on one TPU host without
+#: the device plugin arbitrating chips.
+TPU_PROCESS_BOUNDS_ENV = "TPU_PROCESS_BOUNDS"
+TPU_CHIPS_PER_PROCESS_BOUNDS_ENV = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+
+#: Name of the ConfigMap mapping node -> chip ID <-> local index/coords
+#: (the reference's `gpu-map`, generalized to chips with ICI coordinates).
+CHIP_MAP_CONFIGMAP = "chip-map"
+
+# --------------------------------------------------------------------------
+# Engine admin API (contract kept engine-agnostic, mirroring vLLM sleep mode;
+# reference: pkg/controller/dual-pods/inference-server.go:1497,1712,1984).
+# --------------------------------------------------------------------------
+
+ENGINE_SLEEP_PATH = "/sleep"
+ENGINE_WAKE_PATH = "/wake_up"
+ENGINE_IS_SLEEPING_PATH = "/is_sleeping"

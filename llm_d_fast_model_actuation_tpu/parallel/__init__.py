@@ -1,0 +1,17 @@
+"""TPU parallelism layer: slice topology model, device meshes, shardings.
+
+The reference has no parallelism code of its own (SURVEY.md §2.9) — it passes
+``--tensor-parallel-size`` through to vLLM and tracks a flat GPU-UUID list.
+Here the engine stratum is in-repo, so this package owns the TPU-first
+equivalents: a topology-aware chip model, `jax.sharding.Mesh` construction
+over tp/sp/dp/pp/ep axes, and named-axis sharding rules for params/KV/activations.
+"""
+
+from .topology import ChipInfo, ChipMap, HostTopology, assign_chips  # noqa: F401
+from .mesh import (  # noqa: F401
+    MeshPlan,
+    make_mesh,
+    logical_axis_rules,
+    shard_pytree,
+    named_sharding,
+)

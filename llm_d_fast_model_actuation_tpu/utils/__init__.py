@@ -1,0 +1,4 @@
+"""Shared utilities: hashing/identity, async event fan-out, ranged logs, metrics."""
+
+from .hashing import canonical_json, instance_id_for, sha256_hex  # noqa: F401
+from .events import EventBroadcaster, RevisionTooOld  # noqa: F401

@@ -1,0 +1,33 @@
+"""Test fixture root: run the suite on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; shardings/collectives are
+validated on 8 virtual CPU devices (the same trick the driver's
+`dryrun_multichip` uses). Env must be set before jax is first imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The image's sitecustomize imports jax at interpreter startup (before this
+# file runs), so the env var alone is too late; force the platform on the
+# already-imported module too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,122 @@
+"""API types: round-tripping, quantities, topology semantics."""
+
+from llm_d_fast_model_actuation_tpu.api import (
+    EngineServerConfig,
+    InferenceServerConfig,
+    LauncherConfig,
+    LauncherPopulationPolicy,
+    SliceTopology,
+)
+from llm_d_fast_model_actuation_tpu.api.types import ResourceRange, parse_quantity
+from llm_d_fast_model_actuation_tpu.utils.hashing import instance_id_for, template_hash
+
+
+def test_quantity_parsing():
+    assert parse_quantity("4") == 4.0
+    assert parse_quantity("16Gi") == 16 * 2**30
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("2k") == 2000.0
+    assert parse_quantity(7) == 7.0
+
+
+def test_resource_range():
+    r = ResourceRange(min="4", max="8")
+    assert r.matches("4") and r.matches(8) and r.matches("6")
+    assert not r.matches("2") and not r.matches("16")
+    assert ResourceRange(min="8Gi").matches("16Gi")
+
+
+def test_slice_topology():
+    t = SliceTopology.parse("2x4")
+    assert t.num_chips == 8 and str(t) == "2x4"
+    assert t.contains(SliceTopology.parse("2x2"))
+    assert t.contains(SliceTopology.parse("4"))
+    assert not t.contains(SliceTopology.parse("3x3"))
+
+
+def test_isc_roundtrip():
+    isc = InferenceServerConfig.from_dict(
+        {
+            "metadata": {"name": "llama8b", "namespace": "ns"},
+            "spec": {
+                "modelServerConfig": {
+                    "port": 8000,
+                    "options": "--model meta-llama/Llama-3-8B",
+                    "env_vars": {"A": "1"},
+                    "labels": {"route": "yes"},
+                    "accelerator": {"chips": 8, "topology": "2x4"},
+                },
+                "launcherConfigName": "lc1",
+            },
+        }
+    )
+    assert isc.metadata.name == "llama8b"
+    assert isc.spec.engine_server_config.accelerator.chips == 8
+    d = isc.to_dict()
+    again = InferenceServerConfig.from_dict(d)
+    assert again.to_dict() == d
+
+
+def test_lc_lpp_roundtrip():
+    lc = LauncherConfig.from_dict(
+        {
+            "metadata": {"name": "lc1"},
+            "spec": {
+                "podTemplate": {
+                    "metadata": {"labels": {"a": "b"}},
+                    "spec": {"containers": [{"name": "launcher"}]},
+                },
+                "maxInstances": 4,
+            },
+        }
+    )
+    assert lc.spec.max_instances == 4
+    lpp = LauncherPopulationPolicy.from_dict(
+        {
+            "metadata": {"name": "p"},
+            "spec": {
+                "enhancedNodeSelector": {
+                    "labelSelector": {"matchLabels": {"pool": "v5e"}},
+                    "allocatableResources": {"google.com/tpu": {"min": "8"}},
+                },
+                "countForLauncher": [
+                    {"launcherConfigName": "lc1", "launcherCount": 2}
+                ],
+            },
+        }
+    )
+    assert lpp.spec.count_for_launcher[0].launcher_count == 2
+    assert lpp.to_dict() == LauncherPopulationPolicy.from_dict(lpp.to_dict()).to_dict()
+
+
+def test_instance_id_stability():
+    cfg = EngineServerConfig(port=8000, options="--model m")
+    a = instance_id_for(cfg, ["tpu-n-0-1", "tpu-n-0-0"])
+    b = instance_id_for(cfg, ["tpu-n-0-0", "tpu-n-0-1"])
+    assert a == b and a.startswith("I") and a.endswith("i")
+    c = instance_id_for(cfg, ["tpu-n-0-0"])
+    assert c != a
+    cfg2 = EngineServerConfig(port=8000, options="--model other")
+    assert instance_id_for(cfg2, ["tpu-n-0-0", "tpu-n-0-1"]) != a
+
+
+def test_template_hash_order_independence():
+    t1 = {
+        "spec": {
+            "containers": [
+                {"name": "a", "env": [{"name": "X", "value": "1"}, {"name": "B", "value": "2"}]},
+            ],
+            "volumes": [{"name": "v2"}, {"name": "v1"}],
+        }
+    }
+    t2 = {
+        "spec": {
+            "containers": [
+                {"name": "a", "env": [{"name": "B", "value": "2"}, {"name": "X", "value": "1"}]},
+            ],
+            "volumes": [{"name": "v1"}, {"name": "v2"}],
+        }
+    }
+    assert template_hash(t1) == template_hash(t2)
+    t3 = {"spec": {"containers": [{"name": "a"}], "volumes": []}}
+    assert template_hash(t1) != template_hash(t3)

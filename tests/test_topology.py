@@ -1,0 +1,72 @@
+"""Chip topology: contiguous sub-slice assignment, env injection, chip map."""
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.parallel.topology import (
+    ChipMap,
+    HostTopology,
+    assign_chips,
+    contiguous,
+)
+
+
+@pytest.fixture
+def v5e8():
+    return HostTopology.make("2x4", node="n1")
+
+
+def test_host_make(v5e8):
+    assert len(v5e8.chips) == 8
+    assert v5e8.chips[0].coords == (0, 0)
+    assert v5e8.chips[7].coords == (1, 3)
+
+
+def test_indices_and_env(v5e8):
+    ids = [c.chip_id for c in v5e8.chips[:4]]
+    env = v5e8.visible_devices_env(ids)
+    assert env["TPU_VISIBLE_DEVICES"] == "0,1,2,3"
+    assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,4,1"
+
+
+def test_contiguous():
+    assert contiguous([(0, 0), (0, 1), (1, 0), (1, 1)])
+    assert not contiguous([(0, 0), (0, 2)])
+    assert not contiguous([(0, 0), (0, 1), (1, 3)])
+
+
+def test_assign_contiguous_subslice(v5e8):
+    free = [c.chip_id for c in v5e8.chips]
+    got = assign_chips(v5e8, free, 4, topology="2x2")
+    assert got is not None and len(got) == 4
+    coords = [v5e8.by_id()[cid].coords for cid in got]
+    assert contiguous(coords)
+    xs = sorted({c[0] for c in coords})
+    ys = sorted({c[1] for c in coords})
+    assert len(xs) == 2 and len(ys) == 2
+
+
+def test_assign_respects_fragmentation(v5e8):
+    # only a non-contiguous set of 4 chips free -> no 2x2 placement
+    free = [v5e8.chips[i].chip_id for i in (0, 2, 5, 7)]  # scattered
+    assert assign_chips(v5e8, free, 4, topology="2x2") is None
+    # but 1 chip is always fine
+    assert assign_chips(v5e8, free, 1) is not None
+
+
+def test_assign_whole_host(v5e8):
+    free = [c.chip_id for c in v5e8.chips]
+    got = assign_chips(v5e8, free, 8)
+    assert got is not None and len(got) == 8
+
+
+def test_chip_map_roundtrip(v5e8):
+    cm = ChipMap()
+    cm.set_host("n1", v5e8)
+    data = cm.dump()
+    cm2 = ChipMap.parse(data)
+    host = cm2.host("n1")
+    assert host is not None
+    assert [c.chip_id for c in host.chips] == [c.chip_id for c in v5e8.chips]
+    assert str(host.topology) == "2x4"
+    ids = [v5e8.chips[3].chip_id, v5e8.chips[1].chip_id]
+    assert cm2.indices_for("n1", ids) == [3, 1]
