@@ -1,0 +1,126 @@
+"""Headline benchmark: level-1 sleep/wake actuation on real TPU.
+
+Measures what the reference advertises (vLLM level-1 sleep: ~3 s wake for
+64 GiB => 21.3 GiB/s, README.md:16-26) on our engine: offload the live model
+(params + KV pool) HBM -> pinned host, wake it back, and serve the first
+token. Prints ONE JSON line:
+
+  metric  wake_up -> first-token bandwidth-normalized actuation
+  value   host->HBM wake bandwidth in GiB/s
+  vs_baseline  value / 21.33 GiB/s (the reference's published wake rate)
+
+Extra fields carry the full actuation breakdown (sleep s, wake s, TTFT after
+wake, decode tok/s) for BENCH_r{N}.json archaeology.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
+    from llm_d_fast_model_actuation_tpu.engine.sleep import attach_sleep
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # ~1.4B params (2.8 GiB bf16) + 1.6 GiB KV pool: sized for one v5e chip.
+        model = llama.LlamaConfig(
+            vocab_size=32000,
+            hidden_size=2048,
+            num_layers=24,
+            num_heads=16,
+            num_kv_heads=8,
+            head_dim=128,
+            intermediate_size=5632,
+            rope_theta=10000.0,
+            max_seq_len=2048,
+        )
+        cfg = EngineConfig(model=model, max_batch=8, page_size=16, num_pages=512, max_seq_len=1024)
+        prompt_len, decode_steps = 128, 32
+    else:
+        model = llama.LlamaConfig.tiny()
+        cfg = EngineConfig(model=model, max_batch=4, page_size=8, num_pages=64, max_seq_len=64)
+        prompt_len, decode_steps = 16, 8
+
+    t0 = time.monotonic()
+    eng = InferenceEngine(cfg, seed=0)
+    jax.block_until_ready(eng.params)
+    init_s = time.monotonic() - t0
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, model.vocab_size, prompt_len).tolist()
+
+    # Warm-up: compile prefill + decode programs (host-resident; wake reuses them).
+    t0 = time.monotonic()
+    warm = eng.generate([prompt], max_new_tokens=4)[0]
+    compile_s = time.monotonic() - t0
+
+    # Steady-state decode throughput (batch = max_batch).
+    prompts = [
+        rng.integers(1, model.vocab_size, prompt_len).tolist()
+        for _ in range(cfg.max_batch)
+    ]
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=decode_steps)
+    while eng._waiting:
+        eng.step()
+    t0 = time.monotonic()
+    steps = 0
+    while eng.has_work():
+        eng.step()
+        steps += 1
+    decode_s = time.monotonic() - t0
+    decode_tok_s = (steps * cfg.max_batch) / decode_s if decode_s > 0 else 0.0
+
+    # --- the actuation cycle -------------------------------------------------
+    mgr = attach_sleep(eng)
+    state_bytes = sum(
+        x.nbytes
+        for x in jax.tree.leaves({"p": eng.params, "kv": eng.pool.as_tuple()})
+    )
+    gib = state_bytes / 2**30
+
+    info = mgr.sleep(1)
+    sleep_s = info["last_sleep_seconds"]
+
+    t0 = time.monotonic()
+    mgr.wake_up()
+    wake_s = time.monotonic() - t0
+
+    # wake -> first token (no recompilation: same shapes/shardings).
+    t_ttft0 = time.monotonic()
+    first = eng.generate([prompt], max_new_tokens=1)[0]
+    ttft_after_wake = time.monotonic() - t_ttft0
+    assert first[0] == warm[0], "generation changed across sleep/wake"
+
+    wake_gibps = gib / wake_s if wake_s > 0 else 0.0
+    baseline_gibps = 64.0 / 3.0  # reference: 64 GiB in ~3 s
+    result = {
+        "metric": "level1_wake_bandwidth",
+        "value": round(wake_gibps, 2),
+        "unit": "GiB/s",
+        "vs_baseline": round(wake_gibps / baseline_gibps, 3),
+        "extra": {
+            "platform": jax.devices()[0].platform,
+            "state_gib": round(gib, 3),
+            "sleep_s": round(sleep_s, 4),
+            "wake_s": round(wake_s, 4),
+            "wake_to_first_token_s": round(wake_s + ttft_after_wake, 4),
+            "ttft_after_wake_s": round(ttft_after_wake, 4),
+            "decode_tok_s": round(decode_tok_s, 1),
+            "engine_init_s": round(init_s, 2),
+            "first_compile_s": round(compile_s, 2),
+            "model_params": model.num_params(),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 
 # -- k8s resource.Quantity ---------------------------------------------------
 
-_QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+)([EPTGMk]i?|[munpf]|e[0-9]+)?$")
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+)([EPTGMk]i?|[munpf]|[eE][+-]?[0-9]+)?$")
 _SUFFIX = {
     "k": 10**3, "M": 10**6, "G": 10**9, "T": 10**12, "P": 10**15, "E": 10**18,
     "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
@@ -45,7 +45,7 @@ def parse_quantity(q: "int | float | str") -> float:
     base, suffix = m.groups()
     mult = 1.0
     if suffix:
-        if suffix.startswith("e"):
+        if suffix[0] in "eE" and suffix not in _SUFFIX and len(suffix) > 1:
             mult = 10 ** int(suffix[1:])
         else:
             mult = _SUFFIX[suffix]

@@ -1,0 +1,12 @@
+"""The TPU-native inference engine stratum.
+
+The reference delegates this entire layer to vLLM (+ CUDA); here it is
+in-repo and JAX-native: paged KV cache, continuous batching, jitted
+prefill/decode, level-1 sleep/wake (HBM <-> pinned host) and the
+engine-agnostic admin API (`/sleep`, `/wake_up`, `/is_sleeping`) the
+dual-pods controller speaks.
+"""
+
+from .kv_cache import PageAllocator, PagePool  # noqa: F401
+from .engine import EngineConfig, InferenceEngine  # noqa: F401
+from .sleep import SleepLevel, SleepManager  # noqa: F401

@@ -1,0 +1,298 @@
+"""The serving engine: continuous batching over a paged KV cache.
+
+A single-process engine instance (one per model, spawned by the launcher)
+owning sharded params, the page pool, and two compiled programs:
+
+  * ``_prefill_fn``  — batch-1 prompt ingestion, bucketed to power-of-two
+    lengths so at most log2(max_seq) prefill programs are ever compiled;
+  * ``_step_fn``     — one fused decode+sample step for the whole slot batch,
+    cache donated so page updates are in-place in HBM.
+
+Decode runs every slot every step (static shapes; empty slots write to the
+reserved null page and their outputs are ignored) — the XLA-friendly version
+of continuous batching: requests join/leave by host-side slot bookkeeping,
+the compiled step never changes shape.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..models import llama
+from ..parallel.mesh import shard_pytree
+from .kv_cache import OutOfPages, PageAllocator, PagePool
+from .sampling import sample
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model: llama.LlamaConfig
+    max_batch: int = 8
+    page_size: int = 16
+    num_pages: int = 2048
+    max_seq_len: int = 0  # 0 -> model.max_seq_len
+    eos_token_id: int = -1  # -1 = never stop on EOS
+
+    @property
+    def seq_len(self) -> int:
+        return self.max_seq_len or self.model.max_seq_len
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.seq_len // self.page_size)
+
+
+@dataclass
+class Request:
+    seq_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    out_tokens: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    pos: int = 0  # tokens in cache
+    slot: int = -1
+    done: bool = False
+    submit_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+
+
+class EngineAsleep(RuntimeError):
+    """The engine's device state is offloaded; wake_up() before serving."""
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        params: Optional[Dict[str, Any]] = None,
+        mesh: Optional[Mesh] = None,
+        seed: int = 0,
+    ) -> None:
+        self.cfg = cfg
+        self.mesh = mesh
+        m = cfg.model
+        if params is None:
+            params = llama.init_params(jax.random.key(seed), m)
+        if mesh is not None:
+            params = shard_pytree(params, mesh, llama.param_logical_axes(m))
+        self.params = params
+        self.pool = PagePool.create(
+            m.num_layers,
+            cfg.num_pages,
+            cfg.page_size,
+            m.num_kv_heads,
+            m.head_dim,
+            dtype=m.dtype,
+            mesh=mesh,
+        )
+        self.allocator = PageAllocator(cfg.num_pages)
+        b, p = cfg.max_batch, cfg.pages_per_seq
+        self._page_table = np.zeros((b, p), dtype=np.int32)
+        self._positions = np.zeros((b,), dtype=np.int32)
+        self._last_tokens = np.zeros((b,), dtype=np.int32)
+        self._temps = np.zeros((b,), dtype=np.float32)
+        self._slots: List[Optional[Request]] = [None] * b
+        self._waiting: List[Request] = []
+        self._next_seq_id = 1
+        self._rng = jax.random.key(seed + 1)
+
+        model_cfg = m
+
+        def _prefill(params, tokens, seq_lens, cache, page_table):
+            logits, cache = llama.prefill(
+                params, model_cfg, tokens, seq_lens, cache, page_table
+            )
+            last = jnp.take_along_axis(
+                logits, (seq_lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            return last, cache
+
+        # cache (arg 3) donated: prefill updates pages in place.
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
+
+        def _step(params, tokens, positions, cache, page_table, temps, key):
+            logits, cache = llama.decode_step(
+                params, model_cfg, tokens, positions, cache, page_table
+            )
+            next_tokens = sample(logits, key, temps)
+            return next_tokens, cache
+
+        self._step_fn = jax.jit(_step, donate_argnums=(3,))
+
+    # -- request lifecycle --------------------------------------------------
+
+    def add_request(
+        self,
+        prompt: Seq[int],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+    ) -> int:
+        if not prompt:
+            raise ValueError("empty prompt")
+        total = len(prompt) + max_new_tokens
+        if total > self.cfg.seq_len:
+            raise ValueError(
+                f"prompt+generation {len(prompt)}+{max_new_tokens} exceeds "
+                f"max_seq_len {self.cfg.seq_len}"
+            )
+        if PageAllocator.pages_needed(total, self.cfg.page_size) > self.cfg.num_pages - 1:
+            raise ValueError(
+                f"request needs {PageAllocator.pages_needed(total, self.cfg.page_size)} "
+                f"pages but the pool only has {self.cfg.num_pages - 1}"
+            )
+        req = Request(
+            seq_id=self._next_seq_id,
+            prompt=list(prompt),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+        )
+        self._next_seq_id += 1
+        self._waiting.append(req)
+        return req.seq_id
+
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self, req: Request) -> bool:
+        slot = self._free_slot()
+        if slot is None:
+            return False
+        total = len(req.prompt) + req.max_new_tokens
+        need = PageAllocator.pages_needed(total, self.cfg.page_size)
+        try:
+            req.pages = self.allocator.alloc(need)
+        except OutOfPages:
+            return False
+        req.slot = slot
+        self._slots[slot] = req
+        row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
+        row[: len(req.pages)] = req.pages
+        self._page_table[slot] = row
+        return True
+
+    def _prefill_bucket(self, n: int) -> int:
+        b = 16
+        while b < n:
+            b *= 2
+        return min(b, self.cfg.seq_len)
+
+    def _run_prefill(self, req: Request) -> None:
+        n = len(req.prompt)
+        bucket = self._prefill_bucket(n)
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, :n] = req.prompt
+        seq_lens = np.array([n], dtype=np.int32)
+        table = self._page_table[req.slot : req.slot + 1]
+        last_logits, cache = self._prefill_fn(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(seq_lens),
+            self.pool.as_tuple(),
+            jnp.asarray(table),
+        )
+        self.pool.replace(cache)
+        self._rng, key = jax.random.split(self._rng)
+        tok = sample(
+            last_logits,
+            key,
+            jnp.asarray([req.temperature], dtype=jnp.float32),
+        )
+        first = int(tok[0])
+        req.pos = n
+        self._emit(req, first)
+        self._positions[req.slot] = req.pos  # position of the token to place
+        self._last_tokens[req.slot] = first
+        self._temps[req.slot] = req.temperature
+
+    def _emit(self, req: Request, token: int) -> None:
+        if req.first_token_time is None:
+            req.first_token_time = time.monotonic()
+        req.out_tokens.append(token)
+        if (
+            len(req.out_tokens) >= req.max_new_tokens
+            or token == self.cfg.eos_token_id
+        ):
+            req.done = True
+
+    def _retire(self, req: Request) -> None:
+        self.allocator.free(req.pages)
+        self._slots[req.slot] = None
+        self._page_table[req.slot] = 0
+        self._positions[req.slot] = 0
+        self._last_tokens[req.slot] = 0
+        req.slot = -1
+
+    # -- the engine loop body ----------------------------------------------
+
+    def step(self) -> List[Request]:
+        """Admit + prefill waiting requests, then one decode step for the
+        running batch. Returns requests that finished this step."""
+        if self.params is None:
+            raise EngineAsleep("engine state is offloaded (sleeping)")
+        finished: List[Request] = []
+
+        while self._waiting:
+            req = self._waiting[0]
+            if not self._admit(req):
+                break
+            self._waiting.pop(0)
+            self._run_prefill(req)
+            if req.done:
+                self._retire(req)
+                finished.append(req)
+
+        running = [r for r in self._slots if r is not None]
+        if running:
+            self._rng, key = jax.random.split(self._rng)
+            next_tokens, cache = self._step_fn(
+                self.params,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._positions),
+                self.pool.as_tuple(),
+                jnp.asarray(self._page_table),
+                jnp.asarray(self._temps),
+                key,
+            )
+            self.pool.replace(cache)
+            toks = np.asarray(next_tokens)
+            for req in running:
+                tok = int(toks[req.slot])
+                req.pos += 1
+                self._positions[req.slot] = req.pos
+                self._last_tokens[req.slot] = tok
+                self._emit(req, tok)
+                if req.done:
+                    self._retire(req)
+                    finished.append(req)
+        return finished
+
+    def has_work(self) -> bool:
+        return bool(self._waiting) or any(s is not None for s in self._slots)
+
+    # -- convenience --------------------------------------------------------
+
+    def generate(
+        self,
+        prompts: Seq[Seq[int]],
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+    ) -> List[List[int]]:
+        ids = [
+            self.add_request(p, max_new_tokens, temperature) for p in prompts
+        ]
+        results: Dict[int, List[int]] = {}
+        while self.has_work():
+            for req in self.step():
+                results[req.seq_id] = req.out_tokens
+        return [results[i] for i in ids]

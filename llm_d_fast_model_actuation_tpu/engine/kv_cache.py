@@ -1,0 +1,104 @@
+"""Paged KV cache: device page pools + host-side page allocator.
+
+Pool layout (per k and v): ``[num_layers, num_pages, page_size, kv_heads,
+head_dim]`` — one array for all layers so the layer axis can be scanned and
+the whole pool moved HBM<->host in one transfer on sleep/wake. kv_heads is
+sharded over `tp`; everything else replicated (pages are a node-local pool,
+like vLLM's block allocator, not a distributed object).
+
+Page size defaults to 16 tokens: with head_dim 128 a (16, kvh_shard*128)
+page tile keeps the last dim at the TPU 128-lane boundary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class PagePool:
+    k_pages: jnp.ndarray
+    v_pages: jnp.ndarray
+
+    @classmethod
+    def create(
+        cls,
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+        mesh: Optional[Mesh] = None,
+    ) -> "PagePool":
+        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        if mesh is not None:
+            sharding = NamedSharding(mesh, P(None, None, None, "tp", None))
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+            )
+        else:
+            zeros = lambda: jnp.zeros(shape, dtype)  # noqa: E731
+        return cls(k_pages=zeros(), v_pages=zeros())
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    def nbytes(self) -> int:
+        return self.k_pages.nbytes + self.v_pages.nbytes
+
+    def as_tuple(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return self.k_pages, self.v_pages
+
+    def replace(self, kv: Tuple[jnp.ndarray, jnp.ndarray]) -> None:
+        self.k_pages, self.v_pages = kv
+
+
+class OutOfPages(Exception):
+    """Page pool exhausted — the scheduler must preempt or queue."""
+
+
+@dataclass
+class PageAllocator:
+    """Host-side free-list allocator over the pool's page indices.
+
+    Page 0 is reserved as the null page (page tables are initialized to it),
+    so sequences never alias a live page before assignment.
+    """
+
+    num_pages: int
+    _free: List[int] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self._free:
+            self._free = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise OutOfPages(f"need {n} pages, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p == 0:
+                continue
+            self._free.append(p)
+
+    @staticmethod
+    def pages_needed(num_tokens: int, page_size: int) -> int:
+        return -(-num_tokens // page_size)

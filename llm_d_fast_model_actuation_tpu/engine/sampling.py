@@ -1,0 +1,24 @@
+"""Token sampling, in-jit (no host round-trip per step).
+
+Greedy when temperature == 0 (selected with `lax.cond`-free arithmetic so the
+same compiled fn serves both; temperature is a traced scalar)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample(
+    logits: jnp.ndarray,  # [b, vocab] fp32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [b] fp32; 0 = greedy
+    top_k: int = 0,  # static; 0 = no truncation
+) -> jnp.ndarray:
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits / t, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -1,0 +1,19 @@
+"""Model families (functional JAX: params are pytrees, forward is pure).
+
+The reference contains no model code (vLLM owns it); here the engine stratum
+is in-repo, so the model zoo lives here. Each family exposes:
+  * a config dataclass with known-size constructors,
+  * ``init_params(key, cfg)`` -> bf16 pytree,
+  * ``param_logical_axes(cfg)`` -> matching pytree of logical axis tuples
+    (consumed by ``parallel.mesh.shard_pytree``),
+  * ``prefill(...)`` / ``decode_step(...)`` pure functions built for
+    ``lax.scan`` over layers and paged-KV caches.
+"""
+
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    decode_step,
+    init_params,
+    param_logical_axes,
+    prefill,
+)

@@ -1,0 +1,287 @@
+"""Llama-family decoder (Llama 2/3, TinyLlama, Qwen2-style GQA) — TPU-first.
+
+Design choices for the TPU/XLA compilation model:
+  * layer params are **stacked** on a leading layer axis and the forward is a
+    single ``lax.scan`` over layers — one compiled layer body regardless of
+    depth (compile time O(1) in layers, the win that matters for wake-up);
+  * paged KV cache is threaded *through* the scan, so cache updates are
+    in-place (donated) scatters fused into the step;
+  * all matmuls bf16 on the MXU, softmax/norm math fp32;
+  * tensor-parallel sharding is expressed via logical axes only
+    (`param_logical_axes`); GSPMD inserts the all-reduces.
+
+The flagship config mirrors Llama-3-8B (the reference's north-star model for
+wake_up->TTFT, BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import causal_prefill_attention, paged_decode_attention
+from ..ops.norm import rms_norm
+from ..ops.rope import apply_rope, rope_table
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate_size: int = 14336
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(
+            hidden_size=8192,
+            num_layers=80,
+            num_heads=64,
+            num_kv_heads=8,
+            intermediate_size=28672,
+        )
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "LlamaConfig":
+        """CPU-mesh test size."""
+        return cls(
+            vocab_size=vocab,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            intermediate_size=128,
+            rope_theta=10000.0,
+            max_seq_len=128,
+        )
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def num_params(self) -> int:
+        per_layer = (
+            2 * self.hidden_size  # norms
+            + self.hidden_size * self.q_dim
+            + 2 * self.hidden_size * self.kv_dim
+            + self.q_dim * self.hidden_size
+            + 3 * self.hidden_size * self.intermediate_size
+        )
+        head = 0 if self.tie_embeddings else self.hidden_size * self.vocab_size
+        return (
+            self.vocab_size * self.hidden_size
+            + self.num_layers * per_layer
+            + self.hidden_size
+            + head
+        )
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Random-init bf16 params (serving loads checkpoints; random init is for
+    tests/benchmarks and shape-defining)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    h, L = cfg.hidden_size, cfg.num_layers
+
+    def norm_init(shape):
+        return jnp.ones(shape, dtype=cfg.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5
+        ).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": norm_init((L, h)),
+        "wq": dense_init(ks[0], (L, h, cfg.q_dim), h),
+        "wk": dense_init(ks[1], (L, h, cfg.kv_dim), h),
+        "wv": dense_init(ks[2], (L, h, cfg.kv_dim), h),
+        "wo": dense_init(ks[3], (L, cfg.q_dim, h), cfg.q_dim),
+        "mlp_norm": norm_init((L, h)),
+        "w_gate": dense_init(ks[4], (L, h, cfg.intermediate_size), h),
+        "w_up": dense_init(ks[5], (L, h, cfg.intermediate_size), h),
+        "w_down": dense_init(ks[6], (L, cfg.intermediate_size, h), cfg.intermediate_size),
+    }
+    params = {
+        "embed": dense_init(k_embed, (cfg.vocab_size, h), h),
+        "layers": layers,
+        "final_norm": norm_init((h,)),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense_init(k_head, (h, cfg.vocab_size), h)
+    return params
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Pytree of logical axis names matching `init_params`' structure."""
+    layers = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# -- forward -----------------------------------------------------------------
+
+
+def _mlp(x, gate, up, down):
+    g = x @ gate
+    u = x @ up
+    return (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ down
+
+
+def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
+    """x: [b, s, h] -> q [b,s,heads,hd], k/v [b,s,kvh,hd], roped."""
+    b, s, _ = x.shape
+    q = (x @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = (x @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = (x @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cos_tab, sin_tab)
+    k = apply_rope(k, positions, cos_tab, sin_tab)
+    return q, k, v
+
+
+def _scatter_prefill(pages, new, page_table, positions, valid, page_size):
+    """Write prefill K or V [b,s,kvh,hd] into the page pool.
+
+    Invalid (padding) positions scatter to an out-of-bounds page -> dropped.
+    """
+    b, s = positions.shape
+    num_pages = pages.shape[0]
+    page_of = positions // page_size  # [b, s] logical page per token
+    slot_of = positions % page_size
+    phys = jnp.take_along_axis(page_table, page_of, axis=1)  # [b, s]
+    phys = jnp.where(valid, phys, num_pages)
+    return pages.at[phys.reshape(-1), slot_of.reshape(-1)].set(
+        new.reshape((b * s,) + new.shape[2:]), mode="drop"
+    )
+
+
+def _scatter_decode(pages, new, page_table, positions, page_size):
+    """Write one token's K or V [b,kvh,hd] at `positions` [b]."""
+    page_of = positions // page_size
+    slot_of = positions % page_size
+    phys = jnp.take_along_axis(page_table, page_of[:, None], axis=1)[:, 0]
+    return pages.at[phys, slot_of].set(new, mode="drop")
+
+
+def prefill(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b, s] int32, right-padded
+    seq_lens: jnp.ndarray,  # [b] int32
+    cache: Tuple[jnp.ndarray, jnp.ndarray],  # k/v pages [L, P, ps, kvh, hd]
+    page_table: jnp.ndarray,  # [b, pages_per_seq] int32
+):
+    """Prefill a batch of prompts, writing KV into the paged cache.
+
+    Returns (logits [b, s, vocab], new_cache). The caller reads logits at
+    seq_lens-1 to sample the first generated token.
+    """
+    b, s = tokens.shape
+    k_pages, v_pages = cache
+    page_size = k_pages.shape[2]
+    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    valid = positions < seq_lens[:, None]
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
+        kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
+        vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
+        attn = causal_prefill_attention(q, k, v, seq_lens)
+        x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, (new_k, new_v)
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b] int32 — the latest token per sequence
+    positions: jnp.ndarray,  # [b] int32 — its position (seq_len - 1)
+    cache: Tuple[jnp.ndarray, jnp.ndarray],
+    page_table: jnp.ndarray,  # [b, pages_per_seq]
+):
+    """One decode step for the whole running batch.
+
+    Returns (logits [b, vocab], new_cache).
+    """
+    b = tokens.shape[0]
+    k_pages, v_pages = cache
+    page_size = k_pages.shape[2]
+    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    seq_lens = positions + 1
+
+    x = params["embed"][tokens].astype(cfg.dtype)  # [b, h]
+
+    def layer(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _project_qkv(
+            cfg, lp, h[:, None, :], positions[:, None], cos_tab, sin_tab
+        )
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [b, heads/kvh, hd]
+        kp = _scatter_decode(kp, k, page_table, positions, page_size)
+        vp = _scatter_decode(vp, v, page_table, positions, page_size)
+        attn = paged_decode_attention(q, kp, vp, page_table, seq_lens)
+        x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, (new_k, new_v)

@@ -1,0 +1,14 @@
+"""TPU compute ops: reference JAX implementations + Pallas kernels.
+
+Every op has a pure-JAX reference implementation (runs anywhere, used for
+CPU-mesh tests and as the numerical oracle) and, where it is on the serving
+hot path, a Pallas TPU kernel behind the same signature. Kernel selection is
+automatic by backend with an env override (FMA_TPU_FORCE_REFERENCE_OPS=1).
+"""
+
+from .norm import rms_norm  # noqa: F401
+from .rope import apply_rope, rope_table  # noqa: F401
+from .attention import (  # noqa: F401
+    causal_prefill_attention,
+    paged_decode_attention,
+)

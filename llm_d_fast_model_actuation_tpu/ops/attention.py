@@ -1,0 +1,95 @@
+"""Attention ops: causal prefill and paged decode.
+
+The serving engine keeps the KV cache *paged*: a global pool of fixed-size
+pages per layer, with per-sequence page tables — the vLLM paged-KV idea laid
+out for TPU: page_size is a multiple of the VPU lane tile, the kv_heads axis
+is sharded over the `tp` mesh axis, and the gather by page table lowers to a
+dynamic-slice-friendly pattern XLA handles well (a Pallas ragged kernel can
+replace it behind the same signature; see `ops/pallas/`).
+
+Shapes (per layer):
+  k_pages, v_pages: [num_pages, page_size, kv_heads, head_dim]
+  page_table:       [batch, pages_per_seq] int32 (entries past the sequence
+                    end are arbitrary; masked by seq_lens)
+  seq_lens:         [batch] int32 — tokens currently in cache per sequence
+
+All softmax math is fp32 regardless of the io dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(x: jnp.ndarray, n_rep: int, axis: int) -> jnp.ndarray:
+    """GQA: repeat kv heads to match query heads."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=axis)
+
+
+def causal_prefill_attention(
+    q: jnp.ndarray,  # [batch, seq, heads, head_dim]
+    k: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
+    v: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
+    seq_lens: jnp.ndarray,  # [batch] int32: valid prefix length per row
+) -> jnp.ndarray:
+    """Causal self-attention over a (right-padded) prefill batch."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    k = _repeat_kv(k, h // kvh, axis=2)
+    v = _repeat_kv(v, h // kvh, axis=2)
+
+    qf = q.astype(jnp.float32) * (d**-0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+
+    pos = jnp.arange(s)
+    causal = pos[None, :, None] >= pos[None, None, :]  # [1, q, k]
+    valid = pos[None, None, :] < seq_lens[:, None, None]  # [b, 1, k]
+    mask = (causal & valid)[:, None, :, :]  # [b, 1, q, k]
+    logits = jnp.where(mask, logits, NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [batch, heads, head_dim] — one new token per sequence
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
+    seq_lens: jnp.ndarray,  # [batch] int32 (length INCLUDING the new token)
+) -> jnp.ndarray:
+    """One decode step of attention against the paged cache.
+
+    Reference implementation: gather each sequence's pages, flatten to a
+    [batch, ctx, kv_heads, head_dim] view, mask past seq_len. ctx =
+    pages_per_seq * page_size is static, so the whole step is one fused
+    region under jit — no dynamic shapes.
+    """
+    b, h, d = q.shape
+    pages_per_seq = page_table.shape[1]
+    page_size = k_pages.shape[1]
+    kvh = k_pages.shape[2]
+    ctx = pages_per_seq * page_size
+
+    def flatten(pages):
+        g = pages[page_table]  # [b, pages_per_seq, page_size, kvh, d]
+        return g.reshape(b, ctx, kvh, d)
+
+    k = _repeat_kv(flatten(k_pages), h // kvh, axis=2)  # [b, ctx, h, d]
+    v = _repeat_kv(flatten(v_pages), h // kvh, axis=2)
+
+    qf = q.astype(jnp.float32) * (d**-0.5)
+    logits = jnp.einsum("bhd,bkhd->bhk", qf, k.astype(jnp.float32))
+    valid = jnp.arange(ctx)[None, :] < seq_lens[:, None]  # [b, ctx]
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+
+    probs = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

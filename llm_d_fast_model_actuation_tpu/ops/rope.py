@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE), Llama convention.
+
+Tables are precomputed once per (max_len, head_dim, theta) and passed in —
+inside `jit` the gather by position fuses into the attention prologue.
+"""
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0):
+    """(cos, sin) tables of shape [max_len, head_dim//2], fp32."""
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    angles = pos[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, cos_tab, sin_tab):
+    """Rotate q or k by position.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq] int32.
+    Uses the "split halves" (rotate-half) layout, matching HF Llama.
+    """
+    dtype = x.dtype
+    cos = cos_tab[positions].astype(jnp.float32)  # [..., seq, half]
+    sin = sin_tab[positions].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    half = xf.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    # broadcast cos/sin over the heads axis
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)

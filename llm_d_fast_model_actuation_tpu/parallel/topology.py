@@ -55,6 +55,9 @@ class HostTopology:
     def by_id(self) -> Dict[str, ChipInfo]:
         return {c.chip_id: c for c in self.chips}
 
+    def by_index(self) -> Dict[int, ChipInfo]:
+        return {c.index: c for c in self.chips}
+
     def indices_for(self, chip_ids: Sequence[str]) -> List[int]:
         """chip IDs -> local indices (the TPU_VISIBLE_DEVICES value),
         preserving request order. KeyError on unknown ID."""
@@ -69,12 +72,15 @@ class HostTopology:
         multiple engine processes can share one host without the device
         plugin arbitrating.
         """
-        idxs = self.indices_for(chip_ids)
+        by_id = self.by_id()
+        chips = [by_id[cid] for cid in chip_ids]
         env = {
-            "TPU_VISIBLE_DEVICES": ",".join(str(i) for i in sorted(idxs)),
+            "TPU_VISIBLE_DEVICES": ",".join(
+                str(i) for i in sorted(c.index for c in chips)
+            ),
             "TPU_PROCESS_BOUNDS": "1,1,1",
             "TPU_CHIPS_PER_PROCESS_BOUNDS": _chips_bounds(
-                [self.chips[i].coords for i in idxs], self.topology.dims
+                [c.coords for c in chips], self.topology.dims
             ),
         }
         return env

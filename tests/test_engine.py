@@ -1,0 +1,157 @@
+"""Engine: continuous batching, page accounting, sleep/wake."""
+
+import jax
+import numpy as np
+import pytest
+
+from llm_d_fast_model_actuation_tpu.engine import (
+    EngineConfig,
+    InferenceEngine,
+    PageAllocator,
+)
+from llm_d_fast_model_actuation_tpu.engine.kv_cache import OutOfPages
+from llm_d_fast_model_actuation_tpu.engine.sleep import SleepLevel, attach_sleep
+from llm_d_fast_model_actuation_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(),
+        max_batch=4,
+        page_size=8,
+        num_pages=64,
+        max_seq_len=64,
+    )
+    return InferenceEngine(cfg, seed=0)
+
+
+def test_allocator():
+    a = PageAllocator(8)
+    assert a.available == 7  # page 0 reserved
+    pages = a.alloc(3)
+    assert len(set(pages)) == 3 and 0 not in pages
+    a.free(pages)
+    assert a.available == 7
+    with pytest.raises(OutOfPages):
+        a.alloc(8)
+    assert PageAllocator.pages_needed(17, 8) == 3
+
+
+def test_single_generate(engine):
+    out = engine.generate([[1, 2, 3, 4, 5]], max_new_tokens=6)
+    assert len(out) == 1 and len(out[0]) == 6
+    assert all(0 <= t < engine.cfg.model.vocab_size for t in out[0])
+    # engine fully drained: all pages returned
+    assert engine.allocator.available == engine.cfg.num_pages - 1
+
+
+def test_greedy_deterministic(engine):
+    a = engine.generate([[7, 8, 9]], max_new_tokens=5)[0]
+    b = engine.generate([[7, 8, 9]], max_new_tokens=5)[0]
+    assert a == b
+
+
+def test_batch_matches_single(engine):
+    """Continuous batching must not change greedy results."""
+    prompts = [[1, 2, 3], [10, 20, 30, 40], [100, 101]]
+    batched = engine.generate(prompts, max_new_tokens=4)
+    singles = [engine.generate([p], max_new_tokens=4)[0] for p in prompts]
+    assert batched == singles
+
+
+def test_oversubscription_queues(engine):
+    """More requests than slots: all complete eventually."""
+    prompts = [[i + 1, i + 2] for i in range(9)]  # 9 requests, 4 slots
+    outs = engine.generate(prompts, max_new_tokens=3)
+    assert len(outs) == 9
+    assert all(len(o) == 3 for o in outs)
+    assert engine.allocator.available == engine.cfg.num_pages - 1
+
+
+def test_request_validation(engine):
+    with pytest.raises(ValueError):
+        engine.add_request([], 4)
+    with pytest.raises(ValueError):
+        engine.add_request([1] * 60, 10)  # exceeds max_seq_len=64
+
+
+def test_sleep_wake_preserves_generation():
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(),
+        max_batch=2,
+        page_size=8,
+        num_pages=32,
+        max_seq_len=64,
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    before = eng.generate([[4, 5, 6]], max_new_tokens=4)[0]
+
+    mgr = attach_sleep(eng)
+    assert not mgr.is_sleeping
+    info = mgr.sleep(1)
+    assert mgr.is_sleeping and info["is_sleeping"]
+    assert info["level"] == SleepLevel.L1_HOST_OFFLOAD
+    assert info["bytes_offloaded"] > 0
+    assert eng.params is None  # HBM actually released
+
+    mgr.wake_up()
+    assert not mgr.is_sleeping
+    after = eng.generate([[4, 5, 6]], max_new_tokens=4)[0]
+    assert before == after
+
+
+def test_sleep_wake_midstream_resumes():
+    """Level-1 sleep in the middle of a generation, wake, and the sequence
+    continues bit-exact (KV pages survived the round trip)."""
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(),
+        max_batch=2,
+        page_size=8,
+        num_pages=32,
+        max_seq_len=64,
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    gold = eng.generate([[9, 8, 7]], max_new_tokens=8)[0]
+
+    eng2 = InferenceEngine(cfg, seed=0)
+    eng2.add_request([9, 8, 7], max_new_tokens=8)
+    for _ in range(3):
+        eng2.step()
+    mgr = attach_sleep(eng2)
+    mgr.sleep(1)
+    mgr.wake_up()
+    outs = []
+    while eng2.has_work():
+        outs.extend(eng2.step())
+    assert outs[0].out_tokens == gold
+
+
+def test_level2_discard_and_reinit():
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(), max_batch=2, page_size=8, num_pages=16
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    mgr = attach_sleep(eng)
+    mgr.sleep(2)
+    assert mgr.is_sleeping and mgr.stats.bytes_offloaded == 0
+    with pytest.raises(ValueError):
+        mgr.wake_up()  # level-2 needs reinit
+
+    def reinit():
+        params = llama.init_params(jax.random.key(0), cfg.model)
+        from llm_d_fast_model_actuation_tpu.engine.kv_cache import PagePool
+
+        pool = PagePool.create(
+            cfg.model.num_layers,
+            cfg.num_pages,
+            cfg.page_size,
+            cfg.model.num_kv_heads,
+            cfg.model.head_dim,
+            dtype=cfg.model.dtype,
+        )
+        return {"params": params, "kv": pool.as_tuple()}
+
+    mgr.wake_up(reinit=reinit)
+    out = eng.generate([[1, 2]], max_new_tokens=3)[0]
+    assert len(out) == 3

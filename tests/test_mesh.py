@@ -52,7 +52,7 @@ def test_shard_pytree(devices8):
 
 def test_collective_under_mesh(devices8):
     # psum over tp via shard_map compiles and runs on the virtual mesh
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = make_mesh(MeshPlan(dp=2, tp=4), devices8)
     x = jnp.arange(8.0).reshape(2, 4)
