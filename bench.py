@@ -27,20 +27,12 @@ def main() -> None:
     from llm_d_fast_model_actuation_tpu.engine.sleep import attach_sleep
     from llm_d_fast_model_actuation_tpu.models import llama
 
+    from llm_d_fast_model_actuation_tpu.engine.server import MODEL_CONFIGS
+
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
         # ~1.4B params (2.8 GiB bf16) + 1.6 GiB KV pool: sized for one v5e chip.
-        model = llama.LlamaConfig(
-            vocab_size=32000,
-            hidden_size=2048,
-            num_layers=24,
-            num_heads=16,
-            num_kv_heads=8,
-            head_dim=128,
-            intermediate_size=5632,
-            rope_theta=10000.0,
-            max_seq_len=2048,
-        )
+        model = MODEL_CONFIGS["bench-1b"]()
         cfg = EngineConfig(model=model, max_batch=8, page_size=16, num_pages=512, max_seq_len=1024)
         prompt_len, decode_steps = 128, 32
     else:

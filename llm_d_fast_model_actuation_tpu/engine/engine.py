@@ -60,6 +60,7 @@ class Request:
     pos: int = 0  # tokens in cache
     slot: int = -1
     done: bool = False
+    error: Optional[str] = None
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
 
@@ -279,6 +280,21 @@ class InferenceEngine:
 
     def has_work(self) -> bool:
         return bool(self._waiting) or any(s is not None for s in self._slots)
+
+    def abort_all(self, reason: str) -> List[Request]:
+        """Fail every waiting and in-flight request and reset the scheduler
+        (slots, page tables, allocator). Used when continuity of generation
+        cannot be preserved — e.g. a level-2 sleep discarded the KV cache."""
+        aborted = list(self._waiting)
+        self._waiting.clear()
+        for req in list(self._slots):
+            if req is not None:
+                aborted.append(req)
+                self._retire(req)
+        for req in aborted:
+            req.done = True
+            req.error = reason
+        return aborted
 
     # -- convenience --------------------------------------------------------
 

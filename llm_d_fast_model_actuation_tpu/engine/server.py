@@ -1,0 +1,358 @@
+"""Engine HTTP server: OpenAI-style completions + the sleep/wake admin API.
+
+This is the process the launcher forks (the reference forks `vllm serve` with
+VLLM_SERVER_DEV_MODE admin endpoints; here it's our JAX engine). The admin
+contract is engine-agnostic and matches what the dual-pods controller speaks
+(inference-server.go:1497,1712,1984):
+
+  GET  /health       200 once serving
+  GET  /is_sleeping  {"is_sleeping": bool}
+  POST /sleep?level=1|2
+  POST /wake_up
+
+Inference:
+  POST /v1/completions  {"prompt": str | [int], "max_tokens", "temperature"}
+  GET  /v1/models
+
+The engine loop runs on a dedicated thread (device steps block); HTTP
+handlers enqueue requests and await futures. Sleep acquires the step lock, so
+it happens on a step boundary with no request in flight on device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import concurrent.futures
+import logging
+import os
+import shlex
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from aiohttp import web
+
+from ..models import llama
+from .engine import EngineConfig, InferenceEngine
+from .sleep import attach_sleep
+
+logger = logging.getLogger(__name__)
+
+MODEL_CONFIGS = {
+    "tiny": llama.LlamaConfig.tiny,
+    "llama3-8b": llama.LlamaConfig.llama3_8b,
+    "llama3-70b": llama.LlamaConfig.llama3_70b,
+    "bench-1b": lambda: llama.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        num_layers=24,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        intermediate_size=5632,
+        rope_theta=10000.0,
+        max_seq_len=2048,
+    ),
+}
+
+
+def make_arg_parser() -> argparse.ArgumentParser:
+    """The engine's CLI (the `options` string of an instance config is parsed
+    with exactly this parser, mirroring how the reference launcher reuses
+    vLLM's own parser, launcher.py:871-883)."""
+    p = argparse.ArgumentParser(prog="fma-engine", add_help=False)
+    p.add_argument("--model", default="tiny", help="model name or config key")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--max-model-len", type=int, default=0)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--eos-token-id", type=int, default=-1)
+    return p
+
+
+def validate_parsed_args(args: argparse.Namespace) -> None:
+    if args.model not in MODEL_CONFIGS:
+        raise ValueError(
+            f"unknown model {args.model!r}; known: {sorted(MODEL_CONFIGS)}"
+        )
+    if args.tensor_parallel_size < 1:
+        raise ValueError("--tensor-parallel-size must be >= 1")
+    if args.port <= 0 or args.port > 65535:
+        raise ValueError(f"invalid port {args.port}")
+
+
+def parse_engine_options(options: str) -> argparse.Namespace:
+    args, unknown = make_arg_parser().parse_known_args(shlex.split(options or ""))
+    if unknown:
+        raise ValueError(f"unknown engine options: {unknown}")
+    validate_parsed_args(args)
+    return args
+
+
+class EngineService:
+    """Thread-hosted engine with an async-facing submit/sleep API."""
+
+    def __init__(self, args: argparse.Namespace) -> None:
+        self.args = args
+        self._lock = threading.Lock()  # serializes device work vs sleep edges
+        self._new_work = threading.Event()
+        self._stop = False
+        self._futures: Dict[int, concurrent.futures.Future] = {}
+        self._pending: List[Any] = []
+        self.failure: Optional[str] = None
+        self.started_at = time.monotonic()
+
+        model_cfg = MODEL_CONFIGS[args.model]()
+        mesh = None
+        if args.tensor_parallel_size > 1:
+            from ..parallel.mesh import MeshPlan, make_mesh
+
+            mesh = make_mesh(MeshPlan(tp=args.tensor_parallel_size))
+        self.engine = InferenceEngine(
+            EngineConfig(
+                model=model_cfg,
+                max_batch=args.max_batch,
+                page_size=args.page_size,
+                num_pages=args.num_pages,
+                max_seq_len=args.max_model_len or 0,
+                eos_token_id=args.eos_token_id,
+            ),
+            mesh=mesh,
+            seed=args.seed,
+        )
+        self.sleeper = attach_sleep(self.engine)
+        self._thread = threading.Thread(target=self._run, daemon=True, name="engine-loop")
+        self._thread.start()
+
+    # -- engine thread -------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                with self._lock:
+                    if not self.sleeper.is_sleeping:
+                        while self._pending:
+                            prompt, max_tokens, temperature, fut = self._pending.pop(0)
+                            try:
+                                seq_id = self.engine.add_request(
+                                    prompt, max_tokens, temperature
+                                )
+                                self._futures[seq_id] = fut
+                            except Exception as e:
+                                fut.set_exception(e)
+                        if self.engine.has_work():
+                            for req in self.engine.step():
+                                fut = self._futures.pop(req.seq_id, None)
+                                if fut is not None and not fut.done():
+                                    fut.set_result(req)
+                            continue
+            except Exception as e:  # device/runtime failure: fail loudly
+                logger.exception("engine loop failed")
+                self.failure = f"{type(e).__name__}: {e}"
+                self._fail_all(RuntimeError(self.failure))
+                return
+            self._new_work.wait(timeout=0.05)
+            self._new_work.clear()
+
+    def _fail_all(self, exc: Exception) -> None:
+        for _, _, _, fut in self._pending:
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._futures.clear()
+
+    # -- API used by handlers (event-loop thread) ---------------------------
+
+    def submit(
+        self, prompt: List[int], max_tokens: int, temperature: float
+    ) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self.failure is not None:
+            fut.set_exception(RuntimeError(self.failure))
+            return fut
+        self._pending.append((prompt, max_tokens, temperature, fut))
+        self._new_work.set()
+        return fut
+
+    def sleep(self, level: int) -> Dict[str, Any]:
+        with self._lock:
+            return self.sleeper.sleep(level)
+
+    def wake_up(self) -> Dict[str, Any]:
+        with self._lock:
+            if self.sleeper.level == 2:
+                # KV state is gone: abort anything mid-generation before the
+                # fresh state arrives, then rebuild params+pool in place.
+                aborted = self.engine.abort_all("level-2 sleep discarded state")
+                exc = RuntimeError("aborted by level-2 sleep (KV discarded)")
+                for req in aborted:
+                    fut = self._futures.pop(req.seq_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(exc)
+                eng = self.engine
+                m = eng.cfg.model
+
+                def reinit():
+                    import jax
+
+                    from ..models import llama as _llama
+                    from ..parallel.mesh import shard_pytree
+                    from .kv_cache import PagePool
+
+                    params = _llama.init_params(jax.random.key(self.args.seed), m)
+                    if eng.mesh is not None:
+                        params = shard_pytree(
+                            params, eng.mesh, _llama.param_logical_axes(m)
+                        )
+                    pool = PagePool.create(
+                        m.num_layers,
+                        eng.cfg.num_pages,
+                        eng.cfg.page_size,
+                        m.num_kv_heads,
+                        m.head_dim,
+                        dtype=m.dtype,
+                        mesh=eng.mesh,
+                    )
+                    return {"params": params, "kv": pool.as_tuple()}
+
+                out = self.sleeper.wake_up(reinit=reinit)
+            else:
+                out = self.sleeper.wake_up()
+        self._new_work.set()
+        return out
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._new_work.set()
+        self._thread.join(timeout=5)
+
+
+def _tokenize(prompt: Any) -> List[int]:
+    if isinstance(prompt, list):
+        return [int(t) for t in prompt]
+    if isinstance(prompt, str):
+        return list(prompt.encode("utf-8"))
+    raise ValueError("prompt must be a string or a list of token ids")
+
+
+def build_app(service: EngineService) -> web.Application:
+    app = web.Application()
+    vocab = service.engine.cfg.model.vocab_size
+
+    async def health(request: web.Request) -> web.Response:
+        if service.failure is not None:
+            return web.json_response(
+                {"status": "FAILED", "error": service.failure}, status=503
+            )
+        return web.json_response({"status": "OK"})
+
+    async def is_sleeping(request: web.Request) -> web.Response:
+        return web.json_response({"is_sleeping": service.sleeper.is_sleeping})
+
+    async def sleep(request: web.Request) -> web.Response:
+        level = int(request.query.get("level", "1"))
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, service.sleep, level
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(info)
+
+    async def wake_up(request: web.Request) -> web.Response:
+        info = await asyncio.get_running_loop().run_in_executor(
+            None, service.wake_up
+        )
+        return web.json_response(info)
+
+    async def models(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"object": "list", "data": [{"id": service.args.model, "object": "model"}]}
+        )
+
+    async def completions(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        try:
+            tokens = [t % vocab for t in _tokenize(body.get("prompt"))]
+            if not tokens:
+                raise ValueError("empty prompt")
+            max_tokens = int(body.get("max_tokens", 16))
+            temperature = float(body.get("temperature", 0.0))
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        fut = service.submit(tokens, max_tokens, temperature)
+        try:
+            req = await asyncio.wrap_future(fut)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        ttft = (
+            (req.first_token_time - req.submit_time)
+            if req.first_token_time
+            else None
+        )
+        return web.json_response(
+            {
+                "object": "text_completion",
+                "model": service.args.model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "token_ids": req.out_tokens,
+                        "text": bytes(
+                            t % 256 for t in req.out_tokens
+                        ).decode("utf-8", errors="replace"),
+                        "finish_reason": "stop"
+                        if req.out_tokens
+                        and req.out_tokens[-1] == service.engine.cfg.eos_token_id
+                        else "length",
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(tokens),
+                    "completion_tokens": len(req.out_tokens),
+                    "time_to_first_token_s": ttft,
+                },
+            }
+        )
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/is_sleeping", is_sleeping)
+    app.router.add_post("/sleep", sleep)
+    app.router.add_post("/wake_up", wake_up)
+    app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/completions", completions)
+    return app
+
+
+def run_server(args: argparse.Namespace) -> None:
+    """Blocking server main (the child process body)."""
+    logging.basicConfig(level=logging.INFO)
+    service = EngineService(args)
+    app = build_app(service)
+    try:
+        web.run_app(
+            app, host=args.host, port=args.port, print=None, handle_signals=True
+        )
+    finally:
+        service.shutdown()
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = make_arg_parser().parse_args(argv)
+    validate_parsed_args(args)
+    run_server(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,11 +79,19 @@ class SleepManager:
     # -- edges ---------------------------------------------------------------
 
     def sleep(self, level: int = 1) -> Dict[str, Any]:
-        if self._level != SleepLevel.AWAKE:
-            return self.describe()
         level = SleepLevel(level)
         if level == SleepLevel.AWAKE:
             raise ValueError("sleep level must be 1 or 2")
+        if self._level != SleepLevel.AWAKE:
+            if level == SleepLevel.L2_DISCARD and self._level == SleepLevel.L1_HOST_OFFLOAD:
+                # Escalate 1 -> 2: give the host RAM back too.
+                if self._use_memory_kind and self._host_state is not None:
+                    for leaf in jax.tree.leaves(self._host_state):
+                        leaf.delete()
+                self._host_state = None
+                self._level = SleepLevel.L2_DISCARD
+                self.stats.bytes_offloaded = 0
+            return self.describe()
         t0 = time.monotonic()
         state = self._get_state()
         self._shardings = jax.tree.map(lambda x: x.sharding, state)

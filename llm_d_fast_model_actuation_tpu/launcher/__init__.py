@@ -1,0 +1,19 @@
+"""The launcher: node-local engine-instance manager with a REST API.
+
+TPU edition of the reference's `inference_server/launcher/`: it preloads the
+expensive modules (JAX, libtpu bindings, the engine) once, then forks engine
+instances on demand so cold start skips interpreter+import time; it owns a
+persistent XLA compilation-cache dir shared by all instances; it detects
+instance crashes with zero polling via process-sentinel fds; and it speaks
+the same REST surface as the reference launcher (`/v2/vllm/instances` CRUDL,
+NDJSON watch with revisions + 410 resync, RFC 9110 ranged log reads) so the
+reference's controllers can drive it unchanged.
+
+TPU-specific: chip identity is topology-aware (`ChipTranslator`), sleeping
+instances must *release their chips* before another instance can open them —
+chip-set ownership is serialized per launcher (`ChipLedger`).
+"""
+
+from .chiptranslator import ChipTranslator  # noqa: F401
+from .instance import EngineInstance, HalfMade  # noqa: F401
+from .manager import EngineProcessManager  # noqa: F401

@@ -1,0 +1,268 @@
+"""One engine instance: a forked child process running the engine server.
+
+Mirrors the reference's `VllmInstance` semantics (launcher.py:157-340):
+status vocabulary (started / already_running / running / stopped /
+not_running / terminated), per-instance log file dup2'd over the child's
+stdout/stderr, graceful SIGTERM then process-group SIGKILL, and **sentinel
+crash detection**: the child's `multiprocessing` sentinel fd is registered on
+the event loop, so process death becomes a callback with zero polling.
+
+TPU deltas: chip IDs translate to TPU_VISIBLE_DEVICES / process-bounds env
+(not CUDA_VISIBLE_DEVICES), and the fork inherits the preloaded JAX modules
+plus a shared persistent XLA compilation-cache dir (cold-start killer on TPU,
+where compilation dominates).
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import signal
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .chiptranslator import ChipTranslator
+
+logger = logging.getLogger(__name__)
+
+MAX_LOG_RESPONSE_BYTES = 1 << 20  # 1 MiB per ranged-log response
+
+
+class InvalidInstanceConfig(Exception):
+    """The instance config is semantically invalid (e.g. unknown chip ID)."""
+
+
+class HalfMade(Exception):
+    """Something other than start() was the first op on an instance."""
+
+    def __init__(self, instance_id: str) -> None:
+        super().__init__(instance_id)
+        self.instance_id = instance_id
+
+
+class LogRangeNotAvailable(Exception):
+    def __init__(self, requested: int, total: int) -> None:
+        super().__init__(f"start {requested} beyond total {total}")
+        self.requested = requested
+        self.total = total
+
+
+@dataclass
+class InstanceConfig:
+    """Wire config of one instance (reference VllmConfig, launcher.py:64-68).
+
+    Serialized with the reference's field names (`options`, `gpu_uuids`,
+    `env_vars`, `annotations`) so the reference's Go launcher client talks to
+    this launcher unchanged; `chip_ids` is accepted as an input alias."""
+
+    options: str = ""
+    chip_ids: Optional[List[str]] = None
+    env_vars: Optional[Dict[str, str]] = None
+    annotations: Optional[Dict[str, str]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"options": self.options}
+        if self.chip_ids is not None:
+            d["gpu_uuids"] = list(self.chip_ids)
+        if self.env_vars is not None:
+            d["env_vars"] = dict(self.env_vars)
+        if self.annotations is not None:
+            d["annotations"] = dict(self.annotations)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InstanceConfig":
+        if "options" not in d:
+            raise ValueError("instance config requires 'options'")
+        chips = d.get("chip_ids", d.get("gpu_uuids"))
+        return cls(
+            options=str(d["options"]),
+            chip_ids=None if chips is None else [str(c) for c in chips],
+            env_vars=None if d.get("env_vars") is None else dict(d["env_vars"]),
+            annotations=None
+            if d.get("annotations") is None
+            else dict(d["annotations"]),
+        )
+
+
+def _close_inherited_sockets() -> None:
+    """Close inherited *socket* fds in the child (keep pipes, incl. the
+    sentinel) — the reference's fix for wedged client connections inherited
+    across fork (launcher.py:808-832, issue #550)."""
+    import stat
+
+    for fd in range(3, 1024):
+        try:
+            mode = os.fstat(fd).st_mode
+        except OSError:
+            continue
+        if stat.S_ISSOCK(mode):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+
+def engine_kickoff(config: InstanceConfig, log_path: str) -> None:
+    """Child-process body: new process group, stdio -> log file, env, then
+    the engine server (modules already imported pre-fork = preloading)."""
+    os.setpgrp()
+    _close_inherited_sockets()
+    fd = os.open(log_path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+    os.dup2(fd, 1)
+    os.dup2(fd, 2)
+    if fd > 2:
+        os.close(fd)
+    for k, v in (config.env_vars or {}).items():
+        os.environ[k] = str(v)
+    from ..engine.server import parse_engine_options, run_server
+
+    args = parse_engine_options(config.options)
+    run_server(args)
+
+
+class EngineInstance:
+    def __init__(
+        self,
+        instance_id: str,
+        config: InstanceConfig,
+        translator: ChipTranslator,
+        log_dir: str = "",
+        kickoff=engine_kickoff,
+    ) -> None:
+        # Translate chip IDs to device-pinning env at construction time
+        # (the reference's CUDA_VISIBLE_DEVICES injection, launcher.py:175-191).
+        if config.chip_ids:
+            try:
+                env = translator.env_for(config.chip_ids)
+            except KeyError as e:
+                raise InvalidInstanceConfig(f"unknown chip id {e.args[0]!r}")
+            config.env_vars = {**(config.env_vars or {}), **env}
+            logger.info(
+                "instance %s: chips %s -> %s",
+                instance_id,
+                config.chip_ids,
+                env["TPU_VISIBLE_DEVICES"],
+            )
+        self.instance_id = instance_id
+        self.config = config
+        self.process: Optional[multiprocessing.Process] = None
+        self.last_revision: Optional[int] = None
+        self._kickoff = kickoff
+        self._sentinel_active = False
+        self._on_exit_callback = None
+        self._log_file_path = os.path.join(
+            log_dir or "/tmp", f"launcher-{os.getpid()}-engine-{instance_id}.log"
+        )
+
+    # -- state rendering -----------------------------------------------------
+
+    def _make_state(self, status: str) -> Dict[str, Any]:
+        return {
+            "status": status,
+            "instance_id": self.instance_id,
+            "revision": self.last_revision,
+            **self.config.to_dict(),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> Dict[str, Any]:
+        if self.process and self.process.is_alive():
+            return self._make_state("already_running")
+        open(self._log_file_path, "wb").close()
+        self.process = multiprocessing.get_context("fork").Process(
+            target=self._kickoff, args=(self.config, self._log_file_path)
+        )
+        self.process.start()
+        return self._make_state("started")
+
+    def stop(self, timeout: float = 10) -> Dict[str, Any]:
+        if self.process is None:
+            raise HalfMade(self.instance_id)
+        if not self.process.is_alive():
+            self._cleanup_log_file()
+            return self._make_state("not_running")
+        self.process.terminate()  # graceful: SIGTERM to the server
+        self.process.join(timeout=timeout)
+        if self.process.is_alive():
+            try:
+                os.killpg(self.process.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self.process.join()
+        self._cleanup_log_file()
+        return self._make_state("terminated")
+
+    def get_status(self) -> Dict[str, Any]:
+        if self.process is None:
+            raise HalfMade(self.instance_id)
+        return self._make_state(
+            "running" if self.process.is_alive() else "stopped"
+        )
+
+    # -- crash detection -----------------------------------------------------
+
+    def start_sentinel_watcher(self, on_exit_callback) -> None:
+        """Register the child's sentinel fd on the running event loop; the
+        kernel makes it readable when the child dies."""
+        import asyncio
+
+        if self.process is None:
+            raise HalfMade(self.instance_id)
+        self._on_exit_callback = on_exit_callback
+        loop = asyncio.get_running_loop()
+        loop.add_reader(self.process.sentinel, self._on_sentinel_exit)
+        self._sentinel_active = True
+
+    def _on_sentinel_exit(self) -> None:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        loop.remove_reader(self.process.sentinel)
+        self._sentinel_active = False
+        # Reap so exitcode is populated. The sentinel can become readable a
+        # beat before the child is waitable, so a zero-timeout join can miss;
+        # a short blocking join is effectively instant here.
+        self.process.join(timeout=2)
+        if self._on_exit_callback:
+            self._on_exit_callback(self.instance_id, self.process.exitcode)
+
+    def cancel_sentinel_watcher(self) -> None:
+        import asyncio
+
+        if self._sentinel_active and self.process is not None:
+            try:
+                asyncio.get_running_loop().remove_reader(self.process.sentinel)
+            except RuntimeError:
+                pass
+            self._sentinel_active = False
+
+    # -- logs ----------------------------------------------------------------
+
+    def _cleanup_log_file(self) -> None:
+        try:
+            os.unlink(self._log_file_path)
+        except FileNotFoundError:
+            pass
+
+    def get_log_bytes(
+        self, start: int = 0, end: Optional[int] = None
+    ) -> tuple:
+        """(content, total_length) for [start, end] (inclusive), capped at
+        MAX_LOG_RESPONSE_BYTES. Raises LogRangeNotAvailable if start >= total."""
+        try:
+            total = os.path.getsize(self._log_file_path)
+        except FileNotFoundError:
+            total = 0
+        if start >= total:
+            raise LogRangeNotAvailable(start, total)
+        if end is None:
+            read_end = min(start + MAX_LOG_RESPONSE_BYTES - 1, total - 1)
+        else:
+            read_end = min(end, total - 1)
+        with open(self._log_file_path, "rb") as f:
+            f.seek(start)
+            data = f.read(read_end - start + 1)
+        return data, total

@@ -1,0 +1,83 @@
+"""Launcher CLI: preload, then serve the instance-management REST API.
+
+Reference parity (launcher.py:900-967): ``--mock-gpus`` family becomes
+``--mock-chips``; the launcher imports JAX + the engine modules *before* any
+fork so children inherit warm modules, and it exports a persistent XLA
+compilation-cache directory shared by every instance (on TPU, compilation —
+not weight loading — dominates cold start; a shared cache turns repeat model
+launches into cache hits).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+
+def preload(compile_cache_dir: str) -> None:
+    """Import the heavy modules once, pre-fork, and arm the persistent
+    compilation cache (the TPU analogue of the reference's 'launcher imported
+    vLLM before forking', launcher.py:836-885)."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir)
+    os.makedirs(compile_cache_dir, exist_ok=True)
+    import jax  # noqa: F401
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", compile_cache_dir)
+    except Exception:
+        pass
+    from ..engine import server as _server  # noqa: F401  (engine modules warm)
+    from ..models import llama as _llama  # noqa: F401
+
+    logger.info("preloaded jax %s; compile cache at %s", jax.__version__, compile_cache_dir)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="fma-tpu-launcher")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8001)
+    p.add_argument("--log-level", default="info")
+    p.add_argument("--mock-chips", action="store_true")
+    p.add_argument("--mock-chip-count", type=int, default=8)
+    p.add_argument("--mock-topology", default="")
+    p.add_argument("--chip-map-path", default="")
+    p.add_argument("--log-dir", default="")
+    p.add_argument(
+        "--compile-cache-dir", default="/tmp/fma-tpu-xla-cache"
+    )
+    p.add_argument("--no-preload", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
+    if not args.no_preload:
+        preload(args.compile_cache_dir)
+
+    from .chiptranslator import ChipTranslator
+    from .manager import EngineProcessManager
+    from .rest import build_app
+
+    translator = ChipTranslator.create(
+        mock_chips=args.mock_chips,
+        mock_chip_count=args.mock_chip_count,
+        mock_topology=args.mock_topology,
+        chip_map_path=args.chip_map_path or None,
+    )
+    manager = EngineProcessManager(translator, log_dir=args.log_dir)
+    app = build_app(manager)
+    logger.info(
+        "launcher serving on %s:%s (%s chips, mode %s)",
+        args.host,
+        args.port,
+        len(translator.chip_ids()),
+        translator.mode,
+    )
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,189 @@
+"""Multi-instance manager: CRUDL over engine instances + revisioned events.
+
+Mirrors the reference's `VllmMultiProcessManager` (launcher.py:344-515): a
+monotonically increasing revision counter stamped on every lifecycle event
+(CREATED / STOPPED / DELETED), duplicate-ID create is an error (REST maps it
+to 409), stop is graceful-then-kill, and a crashed child produces a STOPPED
+event with its exit code via the sentinel watcher.
+
+TPU delta: a `ChipLedger` records which chip sets are held by live instance
+processes; overlapping placements are reported (the dual-pods controller is
+the one that guarantees at most one *awake* instance per chip set — the
+ledger gives it the node-local truth to verify against).
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid as uuidlib
+from typing import Any, Dict, List, Optional
+
+from ..utils.events import EventBroadcaster
+from .chiptranslator import ChipTranslator
+from .instance import EngineInstance, InstanceConfig
+
+logger = logging.getLogger(__name__)
+
+STATUS_STOPPED = "stopped"
+STATUS_RUNNING = "running"
+
+
+class ChipLedger:
+    """Node-local truth of which live instance holds which chips."""
+
+    def __init__(self) -> None:
+        self._held: Dict[str, List[str]] = {}  # instance_id -> chip_ids
+
+    def acquire(self, instance_id: str, chip_ids: Optional[List[str]]) -> List[str]:
+        """Record ownership; returns the list of instance IDs whose chip sets
+        overlap (empty = clean placement)."""
+        chips = set(chip_ids or [])
+        overlaps = [
+            iid
+            for iid, held in self._held.items()
+            if iid != instance_id and chips & set(held)
+        ]
+        self._held[instance_id] = sorted(chips)
+        return overlaps
+
+    def release(self, instance_id: str) -> None:
+        self._held.pop(instance_id, None)
+
+    def holders(self) -> Dict[str, List[str]]:
+        return dict(self._held)
+
+
+class EngineProcessManager:
+    def __init__(
+        self,
+        translator: ChipTranslator,
+        log_dir: str = "",
+        kickoff=None,
+    ) -> None:
+        self.instances: Dict[str, EngineInstance] = {}
+        self.translator = translator
+        if log_dir:
+            import os
+
+            os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self.ledger = ChipLedger()
+        self.broadcaster = EventBroadcaster()
+        self._revision = 0
+        self._kickoff = kickoff
+
+    # -- revisions -----------------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        return self._revision
+
+    def _next_revision(self) -> int:
+        self._revision += 1
+        return self._revision
+
+    def _publish(self, event_type: str, obj: Dict[str, Any]) -> None:
+        rev = obj.get("revision") or self._next_revision()
+        obj["revision"] = rev
+        self.broadcaster.publish_nowait(rev, {"type": event_type, "object": obj})
+
+    # -- CRUDL ---------------------------------------------------------------
+
+    def create_instance(
+        self, config: InstanceConfig, instance_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        iid = instance_id or str(uuidlib.uuid4())
+        if iid in self.instances:
+            raise ValueError(f"instance {iid} already exists")
+        if self._kickoff is None:
+            # Real engine path: validate the options string pre-fork so a bad
+            # config is a 422 at create time, not a crash discovered later.
+            from ..engine.server import parse_engine_options
+            from .instance import InvalidInstanceConfig
+
+            try:
+                parse_engine_options(config.options)
+            except Exception as e:
+                raise InvalidInstanceConfig(f"invalid engine options: {e}")
+        kwargs = {} if self._kickoff is None else {"kickoff": self._kickoff}
+        instance = EngineInstance(
+            iid, config, self.translator, log_dir=self.log_dir, **kwargs
+        )
+        overlaps = self.ledger.acquire(iid, config.chip_ids)
+        if overlaps:
+            logger.warning(
+                "instance %s chips overlap live instances %s "
+                "(controller must ensure the overlapping ones are asleep)",
+                iid,
+                overlaps,
+            )
+        result = instance.start()
+        self.instances[iid] = instance
+        instance.last_revision = self._next_revision()
+        result["revision"] = instance.last_revision
+        self._publish("CREATED", dict(result))
+        logger.info("created instance %s (rev %s)", iid, instance.last_revision)
+        return result
+
+    def _on_instance_stopped(self, instance_id: str, exitcode) -> None:
+        """Sentinel callback: the child died on its own."""
+        instance = self.instances.get(instance_id)
+        if instance is None:
+            return
+        self.ledger.release(instance_id)
+        instance.last_revision = self._next_revision()
+        obj = instance.get_status()
+        obj["exit_code"] = exitcode
+        self._publish("STOPPED", obj)
+        logger.warning(
+            "instance %s stopped itself (exit code %s)", instance_id, exitcode
+        )
+
+    def stop_instance(self, instance_id: str, timeout: float = 10) -> Dict[str, Any]:
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        instance = self.instances[instance_id]
+        instance.cancel_sentinel_watcher()
+        result = instance.stop(timeout=timeout)
+        del self.instances[instance_id]
+        self.ledger.release(instance_id)
+        result["revision"] = self._next_revision()
+        self._publish("DELETED", dict(result))
+        logger.info("stopped instance %s", instance_id)
+        return result
+
+    def stop_all_instances(self, timeout: float = 10) -> Dict[str, Any]:
+        stopped = []
+        for iid in list(self.instances):
+            self.stop_instance(iid, timeout=timeout)
+            stopped.append(iid)
+        return {"status": "all_stopped", "stopped_instances": stopped}
+
+    def get_instance_status(self, instance_id: str) -> Dict[str, Any]:
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        return self.instances[instance_id].get_status()
+
+    def get_all_instances_status(self) -> Dict[str, Any]:
+        statuses = []
+        running = 0
+        for instance in self.instances.values():
+            st = instance.get_status()
+            statuses.append(st)
+            if st["status"] == STATUS_RUNNING:
+                running += 1
+        return {
+            "total_instances": len(statuses),
+            "running_instances": running,
+            "instances": statuses,
+        }
+
+    def list_instances(self) -> List[str]:
+        return list(self.instances.keys())
+
+    def get_instance_log_bytes(
+        self, instance_id: str, start: int = 0, end: Optional[int] = None
+    ):
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        return self.instances[instance_id].get_log_bytes(start, end)

@@ -1,0 +1,249 @@
+"""Launcher REST API (aiohttp).
+
+Wire-compatible with the reference launcher's FastAPI surface
+(launcher.py:568-800) so the reference's Go `launcherclient` drives this
+launcher unchanged: same paths (`/v2/vllm/instances...`), same status codes
+(201 create, 409 duplicate PUT, 404 missing, 410 stale watch revision, 206/416
+ranged logs with Content-Range), same NDJSON watch event shape
+``{"type": CREATED|STOPPED|DELETED, "object": {...}}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+from http import HTTPStatus
+from typing import Optional, Tuple
+
+from aiohttp import web
+
+from ..utils.events import RevisionTooOld
+from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
+from .manager import EngineProcessManager
+
+logger = logging.getLogger(__name__)
+
+_RANGE_RE = re.compile(r"^bytes=(\d+)-(\d+)?$")
+
+
+def parse_range_header(value: str) -> Tuple[int, Optional[int]]:
+    """``bytes=START-END`` or ``bytes=START-`` (suffix ranges rejected)."""
+    m = _RANGE_RE.match(value)
+    if m is None:
+        raise ValueError(f"Unsupported or malformed Range header: {value}")
+    start = int(m.group(1))
+    end = int(m.group(2)) if m.group(2) else None
+    if end is not None and end < start:
+        raise ValueError(f"Range end ({end}) must be >= start ({start})")
+    return start, end
+
+
+def build_app(manager: EngineProcessManager) -> web.Application:
+    app = web.Application()
+    app["manager"] = manager
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "OK"})
+
+    async def index(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "name": "Multi-Instance Engine Management API (TPU)",
+                "version": "2.0",
+                "endpoints": {
+                    "index": "GET /",
+                    "health": "GET /health",
+                    "create_instance": "POST /v2/vllm/instances",
+                    "create_named_instance": "PUT /v2/vllm/instances/{instance_id}",
+                    "delete_instance": "DELETE /v2/vllm/instances/{instance_id}",
+                    "delete_all_instances": "DELETE /v2/vllm/instances",
+                    "get_instance_status": "GET /v2/vllm/instances/{instance_id}",
+                    "get_all_instances": "GET /v2/vllm/instances",
+                    "get_instance_logs": "GET /v2/vllm/instances/{instance_id}/log",
+                    "watch_instances": "GET /v2/vllm/instances/watch",
+                },
+            }
+        )
+
+    async def _parse_config(request: web.Request) -> InstanceConfig:
+        try:
+            body = await request.json()
+            return InstanceConfig.from_dict(body)
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            raise web.HTTPUnprocessableEntity(text=f"invalid instance config: {e}")
+
+    async def create_instance(request: web.Request) -> web.Response:
+        config = await _parse_config(request)
+        try:
+            result = manager.create_instance(config)
+        except InvalidInstanceConfig as e:
+            raise web.HTTPUnprocessableEntity(text=str(e))
+        except Exception as e:
+            logger.exception("create failed")
+            raise web.HTTPInternalServerError(text=str(e))
+        _watch_sentinel(manager, result["instance_id"])
+        return web.json_response(result, status=HTTPStatus.CREATED)
+
+    async def create_named_instance(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        config = await _parse_config(request)
+        try:
+            result = manager.create_instance(config, instance_id=instance_id)
+        except InvalidInstanceConfig as e:
+            raise web.HTTPUnprocessableEntity(text=str(e))
+        except ValueError as e:
+            raise web.HTTPConflict(text=str(e))
+        except Exception as e:
+            logger.exception("create failed")
+            raise web.HTTPInternalServerError(text=str(e))
+        _watch_sentinel(manager, instance_id)
+        return web.json_response(result, status=HTTPStatus.CREATED)
+
+    async def delete_instance(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        loop = asyncio.get_running_loop()
+        inst = manager.instances.get(instance_id)
+        if inst is not None:
+            inst.cancel_sentinel_watcher()  # must run on the loop thread
+        try:
+            # stop() blocks on SIGTERM/join for seconds; keep the loop live.
+            result = await loop.run_in_executor(
+                None, manager.stop_instance, instance_id
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        return web.json_response(result)
+
+    async def delete_all(request: web.Request) -> web.Response:
+        loop = asyncio.get_running_loop()
+        for inst in list(manager.instances.values()):
+            inst.cancel_sentinel_watcher()
+        result = await loop.run_in_executor(None, manager.stop_all_instances)
+        return web.json_response(result)
+
+    async def get_all(request: web.Request) -> web.Response:
+        detail = request.query.get("detail", "true").lower() != "false"
+        if detail:
+            return web.json_response(manager.get_all_instances_status())
+        ids = manager.list_instances()
+        return web.json_response(
+            {"revision": manager.revision, "instance_ids": ids, "count": len(ids)}
+        )
+
+    async def get_one(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        try:
+            return web.json_response(manager.get_instance_status(instance_id))
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+
+    async def watch(request: web.Request) -> web.StreamResponse:
+        since_raw = request.query.get("since")
+        try:
+            since = int(since_raw) if since_raw is not None else None
+        except ValueError:
+            raise web.HTTPBadRequest(text=f"invalid since revision: {since_raw!r}")
+        if since is not None:
+            oldest = manager.broadcaster.oldest_revision
+            if oldest is not None and since < oldest - 1:
+                raise web.HTTPGone(
+                    text=f"Requested revision {since} is no longer available. "
+                    f"Oldest available: {oldest}."
+                )
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "application/x-ndjson",
+                "X-Content-Type-Options": "nosniff",
+            },
+        )
+        await resp.prepare(request)
+
+        async def send(obj) -> None:
+            await resp.write((json.dumps(obj) + "\n").encode())
+
+        if since is None:
+            start_revision = manager.revision
+            for instance in list(manager.instances.values()):
+                await send({"type": "CREATED", "object": instance.get_status()})
+        else:
+            start_revision = since
+        try:
+            async for event in manager.broadcaster.subscribe(start_revision):
+                await send(event)
+        except RevisionTooOld:
+            pass
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        return resp
+
+    async def get_log(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        range_header = request.headers.get("Range")
+        if range_header is None:
+            start, end, partial = 0, None, False
+        else:
+            try:
+                start, end = parse_range_header(range_header)
+            except ValueError as e:
+                raise web.HTTPBadRequest(text=str(e))
+            partial = True
+        try:
+            data, total = manager.get_instance_log_bytes(instance_id, start, end)
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except LogRangeNotAvailable as e:
+            if not partial:
+                # Rangeless GET of a still-empty log is a healthy 200, not 416.
+                return web.Response(
+                    body=b"",
+                    status=HTTPStatus.OK,
+                    content_type="application/octet-stream",
+                    headers={"Accept-Ranges": "bytes"},
+                )
+            return web.Response(
+                body=b"",
+                status=HTTPStatus.REQUESTED_RANGE_NOT_SATISFIABLE,
+                content_type="application/octet-stream",
+                headers={"Content-Range": f"bytes */{e.total}"},
+            )
+        actual_end = start + len(data) - 1
+        return web.Response(
+            body=data,
+            status=HTTPStatus.PARTIAL_CONTENT if partial else HTTPStatus.OK,
+            content_type="application/octet-stream",
+            headers={
+                "Accept-Ranges": "bytes",
+                "Content-Range": f"bytes {start}-{actual_end}/{total}",
+            },
+        )
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/", index)
+    app.router.add_get("/v2/vllm/instances/watch", watch)
+    app.router.add_post("/v2/vllm/instances", create_instance)
+    app.router.add_put("/v2/vllm/instances/{instance_id}", create_named_instance)
+    app.router.add_delete("/v2/vllm/instances/{instance_id}", delete_instance)
+    app.router.add_delete("/v2/vllm/instances", delete_all)
+    app.router.add_get("/v2/vllm/instances", get_all)
+    app.router.add_get("/v2/vllm/instances/{instance_id}", get_one)
+    app.router.add_get("/v2/vllm/instances/{instance_id}/log", get_log)
+
+    async def on_shutdown(app: web.Application) -> None:
+        manager.stop_all_instances()
+
+    app.on_shutdown.append(on_shutdown)
+    return app
+
+
+def _watch_sentinel(manager: EngineProcessManager, instance_id: str) -> None:
+    """Arm crash detection for a just-created instance (needs a running
+    event loop, hence done in the handler, not the manager)."""
+    instance = manager.instances.get(instance_id)
+    if instance is not None:
+        try:
+            instance.start_sentinel_watcher(manager._on_instance_stopped)
+        except RuntimeError:
+            logger.warning("no running loop; sentinel watcher not armed")

@@ -1,0 +1,1 @@
+"""Bindings to the native (C++) components under the repo's `native/` tree."""

@@ -1,0 +1,74 @@
+"""ctypes binding to the C++ chip-telemetry shim (`native/tpuinfo/`).
+
+The reference delegates accelerator identity/telemetry to NVML/`nvidia-smi`;
+there is no TPU equivalent of "nvidia-smi for another process's HBM", so this
+shim is authored natively (SURVEY.md §2.9, §7): chip enumeration from the PCI
+tree / devfs and per-chip HBM usage where the runtime exposes it.
+
+The shared library is looked up at $FMA_TPUINFO_LIB, next to this file, or in
+the repo's native/build directory. All entry points raise RuntimeError when
+the shim isn't built — callers (ChipTranslator, requester) treat that as
+"fall back to mock/devfs".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import Dict, List, Optional
+
+_LIB = None
+_SEARCH = (
+    os.environ.get("FMA_TPUINFO_LIB", ""),
+    os.path.join(os.path.dirname(__file__), "libtpuinfo.so"),
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "build", "libtpuinfo.so"
+    ),
+)
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        for path in _SEARCH:
+            if path and os.path.exists(path):
+                lib = ctypes.CDLL(path)
+                lib.tpuinfo_query.restype = ctypes.c_void_p
+                lib.tpuinfo_query.argtypes = []
+                lib.tpuinfo_free.restype = None
+                lib.tpuinfo_free.argtypes = [ctypes.c_void_p]
+                _LIB = lib
+                break
+        else:
+            raise RuntimeError("libtpuinfo.so not built")
+    return _LIB
+
+
+def _query() -> Dict:
+    lib = _lib()
+    ptr = lib.tpuinfo_query()
+    if not ptr:
+        raise RuntimeError("tpuinfo_query returned NULL")
+    try:
+        raw = ctypes.string_at(ptr)
+    finally:
+        lib.tpuinfo_free(ptr)
+    return json.loads(raw.decode())
+
+
+def enumerate_chips() -> List[Dict]:
+    """[{chip_id, index, coords?, total_hbm_bytes?}] for local TPU chips."""
+    return _query().get("chips", [])
+
+
+def host_topology() -> Optional[str]:
+    return _query().get("topology") or None
+
+
+def hbm_usage() -> Dict[str, int]:
+    """chip_id -> bytes of HBM in use (0 when the runtime hides it)."""
+    return {
+        c["chip_id"]: int(c.get("hbm_used_bytes", 0))
+        for c in _query().get("chips", [])
+    }

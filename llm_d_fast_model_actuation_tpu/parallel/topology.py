@@ -55,9 +55,6 @@ class HostTopology:
     def by_id(self) -> Dict[str, ChipInfo]:
         return {c.chip_id: c for c in self.chips}
 
-    def by_index(self) -> Dict[int, ChipInfo]:
-        return {c.index: c for c in self.chips}
-
     def indices_for(self, chip_ids: Sequence[str]) -> List[int]:
         """chip IDs -> local indices (the TPU_VISIBLE_DEVICES value),
         preserving request order. KeyError on unknown ID."""

@@ -51,12 +51,15 @@ class EventBroadcaster:
         self._buf.append((revision, event))
         cond = self._cond
         if cond is not None:
-
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # no loop yet: watchers will see it on their next wake
             async def _notify() -> None:
                 async with cond:
                     cond.notify_all()
 
-            asyncio.get_event_loop().create_task(_notify())
+            loop.create_task(_notify())
 
     async def close(self) -> None:
         cond = self._condition()

@@ -1,0 +1,167 @@
+"""End-to-end: launcher process -> forked engine instance -> completions +
+sleep/wake over HTTP.
+
+This is the tier the reference covers with its kind e2e (CPU vLLM serving
+tiny models): a real launcher process (preloaded modules), a real forked
+engine child running the tiny model on CPU, driven purely through the REST
+surfaces the controllers use.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_http(url: str, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            r = requests.get(url, timeout=2)
+            if r.status_code == 200:
+                return
+            last = r.status_code
+        except requests.RequestException as e:
+            last = e
+        time.sleep(0.2)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+@pytest.fixture(scope="module")
+def launcher(tmp_path_factory):
+    port = free_port()
+    log_dir = str(tmp_path_factory.mktemp("launcher-logs"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "llm_d_fast_model_actuation_tpu.launcher.main",
+            "--mock-chips",
+            "--mock-chip-count",
+            "4",
+            "--mock-topology",
+            "2x2",
+            "--host",
+            "127.0.0.1",
+            "--port",
+            str(port),
+            "--log-dir",
+            log_dir,
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        wait_http(base + "/health", timeout=90)
+        yield base
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+@pytest.mark.e2e
+def test_full_instance_lifecycle(launcher):
+    engine_port = free_port()
+    r = requests.get(launcher + "/v2/vllm/instances")
+    chip_ids_resp = requests.get(launcher + "/")
+    assert r.json()["total_instances"] == 0 and chip_ids_resp.status_code == 200
+
+    # Create a named instance running the tiny model on CPU.
+    options = (
+        f"--model tiny --port {engine_port} --num-pages 32 --max-batch 2 "
+        f"--page-size 8 --max-model-len 64"
+    )
+    r = requests.put(
+        launcher + "/v2/vllm/instances/e2e-1",
+        json={"options": options, "env_vars": {"JAX_PLATFORMS": "cpu"}},
+        timeout=30,
+    )
+    assert r.status_code == 201, r.text
+    assert r.json()["status"] == "started"
+
+    engine = f"http://127.0.0.1:{engine_port}"
+    wait_http(engine + "/health", timeout=120)
+
+    # Completions through the engine.
+    r = requests.post(
+        engine + "/v1/completions",
+        json={"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    out1 = r.json()["choices"][0]["token_ids"]
+    assert len(out1) == 4
+
+    # Admin contract: sleep -> is_sleeping -> wake -> same result (greedy).
+    assert requests.get(engine + "/is_sleeping").json() == {"is_sleeping": False}
+    r = requests.post(engine + "/sleep", params={"level": "1"}, timeout=60)
+    assert r.status_code == 200 and r.json()["is_sleeping"] is True
+    assert requests.get(engine + "/is_sleeping").json() == {"is_sleeping": True}
+    r = requests.post(engine + "/wake_up", timeout=60)
+    assert r.status_code == 200 and r.json()["is_sleeping"] is False
+    r = requests.post(
+        engine + "/v1/completions",
+        json={"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        timeout=120,
+    )
+    assert r.json()["choices"][0]["token_ids"] == out1
+
+    # Launcher sees it running; logs are served; ranged read works.
+    r = requests.get(launcher + "/v2/vllm/instances/e2e-1")
+    assert r.json()["status"] == "running"
+    r = requests.get(
+        launcher + "/v2/vllm/instances/e2e-1/log",
+        headers={"Range": "bytes=0-63"},
+    )
+    assert r.status_code == 206 and len(r.content) <= 64
+
+    # Delete tears the child down.
+    r = requests.delete(launcher + "/v2/vllm/instances/e2e-1", timeout=30)
+    assert r.status_code == 200 and r.json()["status"] == "terminated"
+    assert requests.get(launcher + "/v2/vllm/instances").json()["total_instances"] == 0
+    time.sleep(0.3)
+    with pytest.raises(requests.RequestException):
+        requests.get(engine + "/health", timeout=2)
+
+
+@pytest.mark.e2e
+def test_chip_pinning_env_reaches_child(launcher):
+    """chip IDs -> TPU_VISIBLE_DEVICES is injected into the instance env."""
+    engine_port = free_port()
+    # discover chip ids from a fresh instance state (mock chips: tpu-mock-*)
+    r = requests.put(
+        launcher + "/v2/vllm/instances/pin-1",
+        json={
+            "options": f"--model tiny --port {engine_port} --num-pages 16 --page-size 8 --max-model-len 32",
+            "gpu_uuids": ["tpu-mock-0-1", "tpu-mock-1-1"],
+            "env_vars": {"JAX_PLATFORMS": "cpu"},
+        },
+        timeout=30,
+    )
+    assert r.status_code == 201, r.text
+    state = r.json()
+    assert state["gpu_uuids"] == ["tpu-mock-0-1", "tpu-mock-1-1"]
+    assert state["env_vars"]["TPU_VISIBLE_DEVICES"] == "1,3"
+    requests.delete(launcher + "/v2/vllm/instances/pin-1", timeout=30)

@@ -1,0 +1,139 @@
+"""EngineService (the engine HTTP server's core) — failure and sleep edges."""
+
+import asyncio
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_d_fast_model_actuation_tpu.engine.server import (
+    EngineService,
+    build_app,
+    parse_engine_options,
+)
+
+
+@pytest.fixture
+def service():
+    args = parse_engine_options(
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 --max-model-len 64"
+    )
+    svc = EngineService(args)
+    yield svc
+    svc.shutdown()
+
+
+def run_async(coro):
+    return asyncio.run(coro)
+
+
+async def _client(service, fn):
+    app = build_app(service)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_parse_engine_options_errors():
+    with pytest.raises(ValueError):
+        parse_engine_options("--model bogus")
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --what")
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --tensor-parallel-size 0")
+
+
+def test_completion_roundtrip(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 4}
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert len(body["choices"][0]["token_ids"]) == 4
+        assert body["usage"]["prompt_tokens"] == 3
+
+        # string prompts tokenize
+        r = await client.post(
+            "/v1/completions", json={"prompt": "hi", "max_tokens": 2}
+        )
+        assert r.status == 200
+
+        # bad bodies are 400s
+        r = await client.post("/v1/completions", data=b"junk")
+        assert r.status == 400
+        r = await client.post("/v1/completions", json={"prompt": []})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1] * 63, "max_tokens": 10}
+        )
+        assert r.status == 400  # exceeds max_model_len
+
+    run_async(_client(service, scenario))
+
+
+def test_level2_wake_aborts_inflight(service):
+    # slow each engine step down so the generation is reliably in flight
+    orig_step = service.engine.step
+
+    def slow_step():
+        time.sleep(0.05)
+        return orig_step()
+
+    service.engine.step = slow_step
+
+    async def scenario(client):
+        # a long generation in flight
+        task = asyncio.create_task(
+            client.post(
+                "/v1/completions", json={"prompt": [5, 6], "max_tokens": 40}
+            )
+        )
+        await asyncio.sleep(0.4)  # let it admit + start decoding
+        r = await client.post("/sleep", params={"level": "2"})
+        assert r.status == 200 and (await r.json())["level"] == 2
+        r = await client.post("/wake_up")
+        assert r.status == 200
+        resp = await asyncio.wait_for(task, timeout=30)
+        # the in-flight request must NOT succeed with garbage: 500 family
+        assert resp.status >= 500
+
+        # fresh requests after wake work
+        r = await client.post(
+            "/v1/completions", json={"prompt": [5, 6], "max_tokens": 3}
+        )
+        assert r.status == 200
+
+    run_async(_client(service, scenario))
+
+
+def test_sleep_escalation(service):
+    service.sleep(1)
+    assert service.sleeper.stats.bytes_offloaded > 0
+    info = service.sleep(2)  # escalate: host copy dropped
+    assert info["level"] == 2 and info["bytes_offloaded"] == 0
+    service.wake_up()
+    assert not service.sleeper.is_sleeping
+
+
+def test_engine_loop_failure_fails_health_and_requests(service):
+    async def scenario(client):
+        def boom():
+            raise RuntimeError("injected device failure")
+
+        service.engine.step = boom
+        task = asyncio.create_task(
+            client.post("/v1/completions", json={"prompt": [1], "max_tokens": 2})
+        )
+        resp = await asyncio.wait_for(task, timeout=10)
+        assert resp.status == 500
+
+        r = await client.get("/health")
+        assert r.status == 503
+        body = await r.json()
+        assert "injected device failure" in body["error"]
+
+    run_async(_client(service, scenario))
