@@ -1,0 +1,17 @@
+"""The control plane: dual-pods controller + launcher populator.
+
+The reference's controllers (`pkg/controller/dual-pods`, 3.7k LoC Go;
+`pkg/controller/launcher-populator`, 3.0k LoC Go) re-designed as asyncio
+reconcilers over a pluggable *cluster store*:
+
+  * :class:`~.store.InMemoryStore` — a kube-API-shaped ACID store with
+    resourceVersions, finalizers, deletion timestamps, label selection, and
+    watch streams. It is the test substrate (the reference needs a kind
+    cluster for the same coverage) and defines the exact interface a real
+    kube-API-backed store implements in deployment.
+  * binding state is externalized to object annotations exactly as the
+    reference does (controller restart recovery = re-reading annotations).
+"""
+
+from .store import Conflict, InMemoryStore, NotFound  # noqa: F401
+from .dualpods import DualPodsController, DualPodsConfig  # noqa: F401

@@ -1,0 +1,264 @@
+"""Controller-side clients for the three data-plane HTTP surfaces.
+
+The dual-pods controller talks to (reference SURVEY.md §3.2 boundaries):
+  (b) the requester stub's SPI (chip discovery, memory, readiness relay),
+  (c) the launcher REST API (instance CRUDL),
+  (d) the engine admin port (/sleep, /wake_up, /is_sleeping — the calls that
+      actually move tensors).
+
+`Transports` is the seam: the HTTP implementation resolves a Pod to its IP
+and speaks aiohttp; tests plug in-process fakes behind the same protocol.
+Every HTTP call is latency-instrumented (fma_http_latency_seconds), matching
+the reference's single doHTTP path (inference-server.go:2208-2253).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Protocol
+
+import aiohttp
+
+from ..api import constants as C
+from .metrics import HTTP_LATENCY
+
+
+class InstanceNotFound(Exception):
+    pass
+
+
+class LauncherHandle(Protocol):
+    async def create_named_instance(self, instance_id: str, config: Dict[str, Any]) -> Dict[str, Any]: ...
+    async def list_instances(self) -> Dict[str, Any]: ...
+    async def get_instance(self, instance_id: str) -> Dict[str, Any]: ...
+    async def delete_instance(self, instance_id: str) -> Dict[str, Any]: ...
+    async def health(self) -> bool: ...
+
+
+class SpiHandle(Protocol):
+    async def accelerators(self) -> List[str]: ...
+    async def accelerator_memory(self) -> Dict[str, int]: ...
+    async def become_ready(self) -> None: ...
+    async def become_unready(self) -> None: ...
+
+
+class EngineHandle(Protocol):
+    async def is_sleeping(self) -> bool: ...
+    async def sleep(self, level: int = 1) -> None: ...
+    async def wake_up(self) -> None: ...
+    async def healthy(self) -> bool: ...
+
+
+class Transports(Protocol):
+    def launcher(self, pod: Dict[str, Any]) -> LauncherHandle: ...
+    def requester_spi(self, pod: Dict[str, Any]) -> SpiHandle: ...
+    def engine_admin(self, pod: Dict[str, Any], port: int) -> EngineHandle: ...
+
+
+def pod_ip(pod: Dict[str, Any]) -> str:
+    ip = ((pod.get("status") or {}).get("podIP")) or ""
+    if not ip:
+        raise RuntimeError(f"pod {pod['metadata']['name']} has no IP yet")
+    return ip
+
+
+class _Http:
+    def __init__(self, session: Optional[aiohttp.ClientSession] = None) -> None:
+        self._session = session
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=30)
+            )
+        return self._session
+
+    async def call(
+        self, method: str, url: str, purpose: str, json_body=None
+    ):
+        s = await self.session()
+        t0 = time.monotonic()
+        try:
+            async with s.request(method, url, json=json_body) as resp:
+                body = await resp.read()
+                return resp.status, body
+        finally:
+            HTTP_LATENCY.labels(purpose=purpose, method=method).observe(
+                time.monotonic() - t0
+            )
+
+
+class HttpLauncherHandle:
+    def __init__(self, http: _Http, base: str) -> None:
+        self._http = http
+        self._base = base
+
+    async def create_named_instance(self, instance_id: str, config: Dict[str, Any]) -> Dict[str, Any]:
+        import json
+
+        status, body = await self._http.call(
+            "PUT",
+            f"{self._base}/v2/vllm/instances/{instance_id}",
+            "createInstance",
+            json_body=config,
+        )
+        if status not in (200, 201):
+            raise RuntimeError(f"create instance {instance_id}: {status} {body[:200]}")
+        return json.loads(body)
+
+    async def list_instances(self) -> Dict[str, Any]:
+        import json
+
+        status, body = await self._http.call(
+            "GET", f"{self._base}/v2/vllm/instances", "listInstances"
+        )
+        if status != 200:
+            raise RuntimeError(f"list instances: {status}")
+        return json.loads(body)
+
+    async def get_instance(self, instance_id: str) -> Dict[str, Any]:
+        import json
+
+        status, body = await self._http.call(
+            "GET", f"{self._base}/v2/vllm/instances/{instance_id}", "getInstance"
+        )
+        if status == 404:
+            raise InstanceNotFound(instance_id)
+        if status != 200:
+            raise RuntimeError(f"get instance: {status}")
+        return json.loads(body)
+
+    async def delete_instance(self, instance_id: str) -> Dict[str, Any]:
+        import json
+
+        status, body = await self._http.call(
+            "DELETE", f"{self._base}/v2/vllm/instances/{instance_id}", "deleteInstance"
+        )
+        if status == 404:
+            raise InstanceNotFound(instance_id)
+        if status != 200:
+            raise RuntimeError(f"delete instance: {status}")
+        return json.loads(body)
+
+    async def health(self) -> bool:
+        try:
+            status, _ = await self._http.call(
+                "GET", f"{self._base}/health", "launcherHealth"
+            )
+            return status == 200
+        except Exception:
+            return False
+
+
+class HttpSpiHandle:
+    def __init__(self, http: _Http, base: str) -> None:
+        self._http = http
+        self._base = base
+
+    async def accelerators(self) -> List[str]:
+        import json
+
+        from ..api import spi as spiapi
+
+        status, body = await self._http.call(
+            "GET", self._base + spiapi.ACCELERATOR_QUERY_PATH, "queryAccelerators"
+        )
+        if status != 200:
+            raise RuntimeError(f"accelerator query: {status}")
+        return list(json.loads(body))
+
+    async def accelerator_memory(self) -> Dict[str, int]:
+        import json
+
+        from ..api import spi as spiapi
+
+        status, body = await self._http.call(
+            "GET",
+            self._base + spiapi.ACCELERATOR_MEMORY_QUERY_PATH,
+            "queryAcceleratorMemory",
+        )
+        if status != 200:
+            raise RuntimeError(f"memory query: {status}")
+        return {k: int(v) for k, v in json.loads(body).items()}
+
+    async def become_ready(self) -> None:
+        from ..api import spi as spiapi
+
+        status, _ = await self._http.call(
+            "POST", self._base + spiapi.BECOME_READY_PATH, "becomeReady"
+        )
+        if status != 200:
+            raise RuntimeError(f"become-ready: {status}")
+
+    async def become_unready(self) -> None:
+        from ..api import spi as spiapi
+
+        status, _ = await self._http.call(
+            "POST", self._base + spiapi.BECOME_UNREADY_PATH, "becomeUnready"
+        )
+        if status != 200:
+            raise RuntimeError(f"become-unready: {status}")
+
+
+class HttpEngineHandle:
+    def __init__(self, http: _Http, base: str) -> None:
+        self._http = http
+        self._base = base
+
+    async def is_sleeping(self) -> bool:
+        import json
+
+        status, body = await self._http.call(
+            "GET", self._base + C.ENGINE_IS_SLEEPING_PATH, "querySleeping"
+        )
+        if status != 200:
+            raise RuntimeError(f"is_sleeping: {status}")
+        return bool(json.loads(body).get("is_sleeping"))
+
+    async def sleep(self, level: int = 1) -> None:
+        status, _ = await self._http.call(
+            "POST", f"{self._base}{C.ENGINE_SLEEP_PATH}?level={level}", "sleep"
+        )
+        if status != 200:
+            raise RuntimeError(f"sleep: {status}")
+
+    async def wake_up(self) -> None:
+        status, _ = await self._http.call(
+            "POST", self._base + C.ENGINE_WAKE_PATH, "wakeUp"
+        )
+        if status != 200:
+            raise RuntimeError(f"wake_up: {status}")
+
+    async def healthy(self) -> bool:
+        try:
+            status, _ = await self._http.call(
+                "GET", f"{self._base}/health", "engineHealth"
+            )
+            return status == 200
+        except Exception:
+            return False
+
+
+class HttpTransports:
+    """Production transports: Pod IP + well-known ports."""
+
+    def __init__(self) -> None:
+        self._http = _Http()
+
+    def launcher(self, pod: Dict[str, Any]) -> LauncherHandle:
+        return HttpLauncherHandle(
+            self._http, f"http://{pod_ip(pod)}:{C.LAUNCHER_SERVICE_PORT}"
+        )
+
+    def requester_spi(self, pod: Dict[str, Any]) -> SpiHandle:
+        port = (pod["metadata"].get("annotations") or {}).get(
+            C.ADMIN_PORT_ANNOTATION, C.ADMIN_PORT_DEFAULT
+        )
+        return HttpSpiHandle(self._http, f"http://{pod_ip(pod)}:{port}")
+
+    def engine_admin(self, pod: Dict[str, Any], port: int) -> EngineHandle:
+        return HttpEngineHandle(self._http, f"http://{pod_ip(pod)}:{port}")
+
+    async def close(self) -> None:
+        if self._http._session is not None and not self._http._session.closed:
+            await self._http._session.close()

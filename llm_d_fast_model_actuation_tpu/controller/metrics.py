@@ -1,0 +1,87 @@
+"""Prometheus metrics catalog — parity with the reference's docs/metrics.md.
+
+Reference metric names are kept verbatim (fma_*) so dashboards/alerts port
+unchanged. Registered on the default registry; `serve_metrics` exposes them.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import Counter, Gauge, Histogram
+
+# Actuation latency with path classification (controller.go:265-271):
+# hot  = provider existed with the instance awake,
+# warm = instance existed asleep (wake path),
+# cold = launcher or instance had to be created.
+ACTUATION_SECONDS = Histogram(
+    "fma_actuation_seconds",
+    "Time from requester creation to first readiness relay",
+    ["path", "instancesDeleted", "isc_name"],
+    buckets=(0, 1, 3, 5, 7.5, 10, 15, 30, 60, 120, 240, 480, 960, 1920),
+)
+
+LAUNCHER_CREATE_SECONDS = Histogram(
+    "fma_launcher_create_seconds",
+    "Latency of creating a launcher Pod",
+    ["lcfg_name"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2, 5),
+)
+
+HTTP_LATENCY = Histogram(
+    "fma_http_latency_seconds",
+    "Latency of controller-originated HTTP calls",
+    ["purpose", "method"],
+)
+
+DUALITY = Gauge(
+    "fma_duality",
+    "1 while a requester/provider pair is bound (join with per-chip metrics)",
+    ["isc_name", "chip", "node"],
+)
+
+REQUESTER_COUNT = Gauge(
+    "fma_requester_count",
+    "Number of server-requesting Pods per InferenceServerConfig",
+    ["isc_name"],
+)
+
+ISC_COUNT = Gauge(
+    "fma_isc_count",
+    "Number of InferenceServerConfigs per LauncherConfig",
+    ["launcher_config_name"],
+)
+
+LAUNCHER_POD_COUNT = Gauge(
+    "fma_launcher_pod_count",
+    "Launcher Pods by lifecycle phase",
+    ["lcfg_name", "phase"],
+)
+
+INNER_QUEUE_DEPTH = Gauge(
+    "fma_dpc_innerqueue_depth",
+    "Depth of the per-node serialized work queue",
+    ["node"],
+)
+
+INNER_QUEUE_ADDS = Counter(
+    "fma_dpc_innerqueue_adds_total",
+    "Items added to the per-node work queue",
+    ["node"],
+)
+
+INNER_QUEUE_RETRIES = Counter(
+    "fma_dpc_innerqueue_retries_total",
+    "Per-node queue item retries",
+    ["node"],
+)
+
+WORK_DURATION = Histogram(
+    "fma_dpc_innerqueue_work_duration_seconds",
+    "Per-item processing time in the per-node queue",
+    ["node"],
+)
+
+
+def serve_metrics(port: int = 8002) -> None:
+    from prometheus_client import start_http_server
+
+    start_http_server(port)

@@ -115,5 +115,6 @@ def _enumerate_real() -> HostTopology:
         return host
     raise RuntimeError(
         "no TPU chips found (native shim unavailable, no /dev/accel*); "
-        "use --mock-chips for hardware-less operation"
+        "use a mock backend (launcher: --mock-chips, requester: --backend "
+        "static/env) for hardware-less operation"
     )

@@ -1,0 +1,16 @@
+"""The requester stub: the process inside a server-requesting Pod.
+
+The requesting Pod holds the TPU allocation in the scheduler's eyes but does
+no inference; this stub (reference: `cmd/requester`, `pkg/server/requester`)
+serves two HTTP planes:
+
+  * **SPI server** (port $SPI_PORT, default 8081) — the dual-pods controller's
+    window into the Pod: which chips the Pod was allocated, their HBM usage,
+    readiness setters, and a relayed-log sink;
+  * **probes server** (port $PROBES_PORT, default 8080) — `/ready` backed by
+    the controller-set readiness bool; the kubelet's readiness probe target,
+    which is how engine readiness is relayed to everything watching the Pod.
+"""
+
+from .spi import LogSink, SpiServer  # noqa: F401
+from .probes import ProbesServer  # noqa: F401

@@ -1,0 +1,112 @@
+"""Requester stub main: SPI + probes servers in one process.
+
+Reference parity: cmd/requester/main.go:32-85 (real chips) and
+cmd/test-requester (emulated allocation for hardware-less e2e). Backends:
+
+  * ``--backend real``   — chips from the native tpuinfo shim (or /dev/accel
+    fallback), HBM usage from the shim;
+  * ``--backend env``    — chips from $TPU_VISIBLE_DEVICES + a chip-map file
+    (what the kube scheduler/device plugin would have granted);
+  * ``--backend static`` — explicit ``--chips a,b,c`` (tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+from typing import Dict, List
+
+from aiohttp import web
+
+from .probes import ProbesServer
+from .spi import LogSink, ReadyFlag, SpiServer
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_chips(args: argparse.Namespace) -> List[str]:
+    if args.backend == "static":
+        return [c for c in args.chips.split(",") if c]
+    if args.backend == "env":
+        from ..parallel.topology import ChipMap
+        import json
+
+        node = os.environ.get("NODE_NAME", "")
+        path = args.chip_map_path or os.environ.get("CHIP_MAP_PATH", "")
+        visible = os.environ.get("TPU_VISIBLE_DEVICES", "")
+        if not (node and path and visible):
+            raise RuntimeError(
+                "env backend needs NODE_NAME, CHIP_MAP_PATH and TPU_VISIBLE_DEVICES"
+            )
+        with open(path) as f:
+            cm = ChipMap.parse(json.load(f))
+        host = cm.host(node)
+        if host is None:
+            raise RuntimeError(f"node {node} not in chip map")
+        want = {int(i) for i in visible.split(",")}
+        return [c.chip_id for c in host.chips if c.index in want]
+    # real
+    from ..launcher.chiptranslator import _enumerate_real
+
+    return [c.chip_id for c in _enumerate_real().chips]
+
+
+def memory_backend(args: argparse.Namespace, chip_ids: List[str]):
+    if args.backend == "real":
+        def usage() -> Dict[str, int]:
+            from ..native import tpuinfo
+
+            all_usage = tpuinfo.hbm_usage()
+            return {c: all_usage.get(c, 0) for c in chip_ids}
+
+        return usage
+    return lambda: {c: 0 for c in chip_ids}
+
+
+async def serve(args: argparse.Namespace) -> None:
+    ready = ReadyFlag(False)
+    sink = LogSink()
+    chips = resolve_chips(args)
+    logger.info("requester stub: chips=%s", chips)
+    spi = SpiServer(chips, ready, memory_backend(args, chips), sink)
+    probes = ProbesServer(ready)
+
+    runners = []
+    for app, port in ((spi.build_app(), args.spi_port), (probes.build_app(), args.probes_port)):
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, port)
+        await site.start()
+        runners.append(runner)
+    logger.info("SPI on :%s, probes on :%s", args.spi_port, args.probes_port)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="fma-tpu-requester")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument(
+        "--spi-port", type=int, default=int(os.environ.get("SPI_PORT", "8081"))
+    )
+    p.add_argument(
+        "--probes-port",
+        type=int,
+        default=int(os.environ.get("PROBES_PORT", "8080")),
+    )
+    p.add_argument("--backend", choices=("real", "env", "static"), default="real")
+    p.add_argument("--chips", default="", help="comma-separated chip IDs (static)")
+    p.add_argument("--chip-map-path", default="")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(serve(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Probes server: /ready -> 200/503 from the controller-set readiness bool
+(reference: pkg/server/requester/probes/server.go:38-87). This is what the
+kubelet's readiness probe hits, turning controller relays into Pod Ready
+condition flips that HPA/EPP/users observe."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..api import spi as spiapi
+from .spi import ReadyFlag
+
+
+class ProbesServer:
+    def __init__(self, ready_flag: ReadyFlag) -> None:
+        self.ready = ready_flag
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+
+        async def ready(request: web.Request) -> web.Response:
+            if self.ready.get():
+                return web.Response(text="ready\n")
+            return web.Response(status=503, text="not ready\n")
+
+        app.router.add_get(spiapi.READY_PATH, ready)
+        return app

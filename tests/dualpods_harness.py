@@ -1,0 +1,282 @@
+"""In-process harness for dual-pods controller tests.
+
+Plays the roles the reference's kind-based e2e rig plays with containers
+(SURVEY.md §4.3): a fake scheduler (chip assignment), fake launcher fleet
+(protocol-faithful instance CRUDL), and fake engines (sleep/wake/health),
+all behind the same Transports seam the production HTTP clients implement.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.clients import InstanceNotFound
+from llm_d_fast_model_actuation_tpu.controller.dualpods import (
+    DualPodsConfig,
+    DualPodsController,
+)
+from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+
+
+class FakeEngine:
+    def __init__(self) -> None:
+        self.sleeping = False
+        self.healthy = True
+        self.sleep_calls = 0
+        self.wake_calls = 0
+
+
+@dataclass
+class FakeInstance:
+    instance_id: str
+    config: Dict[str, Any]
+    status: str = "running"
+    engine: FakeEngine = field(default_factory=FakeEngine)
+
+    def state(self) -> Dict[str, Any]:
+        return {
+            "instance_id": self.instance_id,
+            "status": self.status,
+            **{k: v for k, v in self.config.items()},
+        }
+
+
+class FakeLauncher:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.instances: Dict[str, FakeInstance] = {}
+        self.created: List[str] = []
+        self.deleted: List[str] = []
+
+    async def create_named_instance(self, instance_id, config):
+        if instance_id in self.instances:
+            raise RuntimeError("409 duplicate")
+        inst = FakeInstance(instance_id, dict(config))
+        self.instances[instance_id] = inst
+        self.created.append(instance_id)
+        return inst.state()
+
+    async def list_instances(self):
+        states = [i.state() for i in self.instances.values()]
+        return {
+            "total_instances": len(states),
+            "running_instances": sum(1 for s in states if s["status"] == "running"),
+            "instances": states,
+        }
+
+    async def get_instance(self, instance_id):
+        if instance_id not in self.instances:
+            raise InstanceNotFound(instance_id)
+        return self.instances[instance_id].state()
+
+    async def delete_instance(self, instance_id):
+        if instance_id not in self.instances:
+            raise InstanceNotFound(instance_id)
+        inst = self.instances.pop(instance_id)
+        self.deleted.append(instance_id)
+        inst.status = "terminated"
+        return inst.state()
+
+    async def health(self):
+        return True
+
+
+class FakeSpi:
+    def __init__(self, chips: List[str]) -> None:
+        self.chips = chips
+        self.ready = False
+        self.memory: Dict[str, int] = {}
+
+    async def accelerators(self):
+        return list(self.chips)
+
+    async def accelerator_memory(self):
+        return dict(self.memory)
+
+    async def become_ready(self):
+        self.ready = True
+
+    async def become_unready(self):
+        self.ready = False
+
+
+class FakeEngineHandle:
+    def __init__(self, launcher: FakeLauncher, port: int) -> None:
+        self._launcher = launcher
+        self._port = port
+
+    def _target(self) -> Optional[FakeInstance]:
+        for inst in self._launcher.instances.values():
+            ann = inst.config.get("annotations") or {}
+            if ann.get("inference-port") == str(self._port):
+                return inst
+        return None
+
+    async def is_sleeping(self) -> bool:
+        inst = self._target()
+        if inst is None:
+            raise RuntimeError(f"no instance on port {self._port}")
+        return inst.engine.sleeping
+
+    async def sleep(self, level: int = 1) -> None:
+        inst = self._target()
+        if inst is None:
+            raise RuntimeError(f"no instance on port {self._port}")
+        inst.engine.sleeping = True
+        inst.engine.sleep_calls += 1
+
+    async def wake_up(self) -> None:
+        inst = self._target()
+        if inst is None:
+            raise RuntimeError(f"no instance on port {self._port}")
+        inst.engine.sleeping = False
+        inst.engine.wake_calls += 1
+
+    async def healthy(self) -> bool:
+        inst = self._target()
+        return inst is not None and inst.engine.healthy and not inst.engine.sleeping
+
+
+class FakeTransports:
+    def __init__(self, harness: "Harness") -> None:
+        self._h = harness
+
+    def launcher(self, pod):
+        return self._h.launcher_for(pod["metadata"]["name"])
+
+    def requester_spi(self, pod):
+        return self._h.spi_for(pod["metadata"]["name"])
+
+    def engine_admin(self, pod, port):
+        return FakeEngineHandle(self._h.launcher_for(pod["metadata"]["name"]), port)
+
+
+class Harness:
+    def __init__(self, ns: str = "ns", **cfg_kwargs) -> None:
+        self.ns = ns
+        self.store = InMemoryStore()
+        self.launchers: Dict[str, FakeLauncher] = {}
+        self.spis: Dict[str, FakeSpi] = {}
+        self.transports = FakeTransports(self)
+
+        async def launcher_runtime(pod):
+            self.launchers.setdefault(pod["metadata"]["name"], FakeLauncher(pod["metadata"]["name"]))
+            # the "kubelet": give the pod an IP and mark it Ready
+            def run(p):
+                p.setdefault("status", {})["podIP"] = "10.0.0.1"
+                p["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+                return p
+
+            self.store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
+
+        self.controller = DualPodsController(
+            self.store,
+            self.transports,
+            DualPodsConfig(namespace=ns, launcher_runtime=launcher_runtime, **cfg_kwargs),
+        )
+
+    def launcher_for(self, name: str) -> FakeLauncher:
+        if name not in self.launchers:
+            self.launchers[name] = FakeLauncher(name)
+        return self.launchers[name]
+
+    def spi_for(self, name: str) -> FakeSpi:
+        if name not in self.spis:
+            self.spis[name] = FakeSpi([])
+        return self.spis[name]
+
+    # -- object factories ----------------------------------------------------
+
+    def add_isc(
+        self,
+        name: str,
+        lc_name: str = "lc1",
+        port: int = 8000,
+        options: str = "--model tiny",
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        return self.store.create(
+            {
+                "kind": "InferenceServerConfig",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "modelServerConfig": {
+                        "port": port,
+                        "options": options,
+                        **({"labels": labels} if labels else {}),
+                    },
+                    "launcherConfigName": lc_name,
+                },
+            }
+        )
+
+    def add_lc(self, name: str = "lc1", max_instances: int = 2) -> Dict[str, Any]:
+        return self.store.create(
+            {
+                "kind": "LauncherConfig",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "podTemplate": {
+                        "metadata": {},
+                        "spec": {"containers": [{"name": "launcher"}]},
+                    },
+                    "maxInstances": max_instances,
+                },
+            }
+        )
+
+    def add_requester(
+        self,
+        name: str,
+        isc_name: str,
+        node: str = "n1",
+        chips: Optional[List[str]] = None,
+    ) -> Dict[str, Any]:
+        self.spis[name] = FakeSpi(chips or ["chip-0"])
+        return self.store.create(
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": self.ns,
+                    "annotations": {C.INFERENCE_SERVER_CONFIG_ANNOTATION: isc_name},
+                },
+                "spec": {
+                    "nodeName": node,
+                    "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
+                },
+                "status": {
+                    "podIP": "10.0.0.9",
+                    "conditions": [{"type": "Ready", "status": "False"}],
+                },
+            }
+        )
+
+    # -- helpers -------------------------------------------------------------
+
+    def launcher_pods(self) -> List[Dict[str, Any]]:
+        return self.store.list(
+            "Pod", self.ns, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
+        )
+
+    def the_launcher_pod(self) -> Dict[str, Any]:
+        pods = self.launcher_pods()
+        assert len(pods) == 1, f"expected 1 launcher pod, got {len(pods)}"
+        return pods[0]
+
+    async def run(self, body) -> None:
+        await self.controller.start()
+        try:
+            await body()
+        finally:
+            await self.controller.stop()
+
+    async def settle(self, timeout: float = 20.0) -> None:
+        await self.controller.quiesce(timeout)
+
+
+def run_scenario(harness: Harness, body) -> None:
+    asyncio.run(harness.run(body))

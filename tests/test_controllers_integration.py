@@ -1,0 +1,93 @@
+"""Cross-controller integration: populator pre-creates launchers; the
+dual-pods controller must select them (template-hash compatibility) instead
+of creating its own — the core of proactive actuation (cold -> warm)."""
+
+import asyncio
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.populator import (
+    Populator,
+    PopulatorConfig,
+)
+
+from dualpods_harness import Harness, run_scenario
+
+
+def test_dualpods_selects_populated_launcher():
+    h = Harness()
+    h.add_lc("lc1", max_instances=2)
+    h.add_isc("iscA", "lc1")
+    h.store.create(
+        {
+            "kind": "Node",
+            "metadata": {"name": "n1", "labels": {"pool": "v5e"}},
+            "status": {"allocatable": {C.TPU_RESOURCE: "8"}},
+        }
+    )
+    h.store.create(
+        {
+            "kind": "LauncherPopulationPolicy",
+            "metadata": {"name": "p1", "namespace": h.ns},
+            "spec": {
+                "enhancedNodeSelector": {
+                    "labelSelector": {"matchLabels": {"pool": "v5e"}}
+                },
+                "countForLauncher": [
+                    {"launcherConfigName": "lc1", "launcherCount": 1}
+                ],
+            },
+        }
+    )
+
+    async def runtime(pod):
+        h.launchers.setdefault(
+            pod["metadata"]["name"],
+            h.launcher_for(pod["metadata"]["name"]),
+        )
+
+        def run(p):
+            p.setdefault("status", {})["podIP"] = "10.0.0.3"
+            p["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+            return p
+
+        h.store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
+
+    populator = Populator(
+        h.store, PopulatorConfig(namespace=h.ns, launcher_runtime=runtime)
+    )
+
+    async def body():
+        await populator.start()
+        try:
+            await populator.quiesce()
+            pre = h.launcher_pods()
+            assert len(pre) == 1  # populated proactively
+            pre_name = pre[0]["metadata"]["name"]
+
+            h.add_requester("reqA", "iscA", chips=["chip-0"])
+            await h.settle()
+            await populator.quiesce()
+
+            pods = h.launcher_pods()
+            bound = [
+                p
+                for p in pods
+                if C.REQUESTER_ANNOTATION in (p["metadata"].get("annotations") or {})
+            ]
+            assert len(bound) == 1
+            # the controller used the POPULATED launcher (warm path), it did
+            # not create its own
+            assert bound[0]["metadata"]["name"] == pre_name
+            # the populator backfills the now-bound launcher with a fresh
+            # unbound one (effective desired = max(policy, demand))
+            unbound = [p for p in pods if p not in bound]
+            assert len(unbound) == 1
+
+            # and the populator never reaps the bound one
+            assert C.REQUESTER_ANNOTATION in (
+                h.store.get("Pod", h.ns, pre_name)["metadata"]["annotations"]
+            )
+        finally:
+            await populator.stop()
+
+    run_scenario(h, body)

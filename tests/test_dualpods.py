@@ -1,0 +1,456 @@
+"""Dual-pods controller: the reference e2e suite (test/e2e/test-cases.sh)
+ported to the in-process harness.
+
+Case names track SURVEY.md §4.3's launcher-based suite:
+basic creation, wake fast path, shared launcher, switch instances, cap +
+reclaim, restart recovery, obsolete-instance GC (sleeping and awake),
+stopped-instance recovery, deletion relays, finalizers.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.dualpods import (
+    FINALIZER,
+    DualPodsController,
+    DualPodsConfig,
+)
+from llm_d_fast_model_actuation_tpu.utils.hashing import instance_id_for
+from llm_d_fast_model_actuation_tpu.api.types import EngineServerConfig
+
+from dualpods_harness import Harness, run_scenario
+
+
+def test_basic_creation_and_metadata():
+    h = Harness()
+    h.add_lc("lc1", max_instances=2)
+    h.add_isc("iscA", "lc1", port=8000, labels={"route-to": "iscA"})
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0", "chip-1"])
+        await h.settle()
+
+        lp = h.the_launcher_pod()
+        ann = lp["metadata"]["annotations"]
+        # bound pre-create, instance state persisted for restart recovery
+        assert ann[C.REQUESTER_ANNOTATION].startswith("reqA/")
+        assert ann[C.LAUNCHER_BASED_ANNOTATION] == "true"
+        iid = ann[C.INSTANCE_ID_ANNOTATION]
+        assert iid.startswith("I") and iid.endswith("i")
+        assert ann[C.SERVER_PORT_ANNOTATION] == "8000"
+        assert FINALIZER in lp["metadata"]["finalizers"]
+        # deferred routing labels applied once serving
+        assert lp["metadata"]["labels"]["route-to"] == "iscA"
+        assert lp["metadata"]["labels"][C.SLEEPING_LABEL] == "false"
+        assert lp["metadata"]["labels"][C.DUAL_LABEL] == "reqA"
+
+        # the fake launcher actually created the instance
+        fl = h.launcher_for(lp["metadata"]["name"])
+        assert fl.created == [iid]
+
+        # requester decorated + readiness relayed
+        req = h.store.get("Pod", h.ns, "reqA")
+        assert req["metadata"]["labels"][C.INSTANCE_LABEL] == iid
+        assert req["metadata"]["labels"][C.DUAL_LABEL] == lp["metadata"]["name"]
+        assert (
+            req["metadata"]["annotations"][C.ACCELERATORS_ANNOTATION]
+            == "chip-0,chip-1"
+        )
+        assert FINALIZER in req["metadata"]["finalizers"]
+        assert h.spis["reqA"].ready is True
+
+        # instance id is the deterministic hash of (config, chips)
+        esc = EngineServerConfig(port=8000, options="--model tiny", labels={"route-to": "iscA"})
+        assert iid == instance_id_for(esc, ["chip-1", "chip-0"])
+
+    run_scenario(h, body)
+
+
+def test_unbind_sleeps_and_deroutes():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1", labels={"route-to": "iscA"})
+
+    async def body():
+        h.add_requester("reqA", "iscA")
+        await h.settle()
+        lp = h.the_launcher_pod()
+        lname = lp["metadata"]["name"]
+        iid = lp["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+
+        h.store.delete("Pod", h.ns, "reqA")  # finalizer makes it Terminating
+        await h.settle()
+
+        # requester fully gone (finalizer removed after provider slept)
+        assert h.store.try_get("Pod", h.ns, "reqA") is None
+
+        lp = h.store.get("Pod", h.ns, lname)
+        ann = lp["metadata"]["annotations"]
+        lab = lp["metadata"]["labels"]
+        assert C.REQUESTER_ANNOTATION not in ann
+        assert C.INSTANCE_ID_ANNOTATION not in ann
+        assert lab[C.SLEEPING_LABEL] == "true"
+        assert C.DUAL_LABEL not in lab
+        assert "route-to" not in lab  # de-routed before sleep
+        assert FINALIZER not in (lp["metadata"].get("finalizers") or [])
+
+        # instance survived asleep (the whole point)
+        fl = h.launcher_for(lname)
+        assert iid in fl.instances
+        assert fl.instances[iid].engine.sleeping is True
+        assert fl.instances[iid].engine.sleep_calls == 1
+
+    run_scenario(h, body)
+
+
+def test_wake_fast_path_reuses_launcher_and_instance():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        # scale back up: same ISC, same chips
+        h.add_requester("reqA2", "iscA", chips=["chip-0"])
+        await h.settle()
+
+        # no second launcher pod; same instance, woken not recreated
+        assert len(h.launcher_pods()) == 1
+        lp = h.the_launcher_pod()
+        assert lp["metadata"]["name"] == lname
+        assert lp["metadata"]["annotations"][C.REQUESTER_ANNOTATION].startswith("reqA2/")
+        fl = h.launcher_for(lname)
+        assert fl.created == [iid]  # exactly one create, ever
+        assert fl.instances[iid].engine.wake_calls == 1
+        assert fl.instances[iid].engine.sleeping is False
+        assert h.spis["reqA2"].ready is True
+
+    run_scenario(h, body)
+
+
+def test_concurrent_requesters_get_separate_launchers():
+    """One launcher pod binds one requester at a time: two live requesters
+    need two launcher pods (selection skips bound launchers)."""
+    h = Harness()
+    h.add_lc("lc1", max_instances=2)
+    h.add_isc("iscA", "lc1", port=8000)
+    h.add_isc("iscB", "lc1", port=8100)
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        h.add_requester("reqB", "iscB", chips=["chip-1"])
+        await h.settle()
+
+        pods = h.launcher_pods()
+        assert len(pods) == 2
+        bound_to = {
+            p["metadata"]["annotations"][C.REQUESTER_ANNOTATION].split("/")[0]
+            for p in pods
+        }
+        assert bound_to == {"reqA", "reqB"}
+
+    run_scenario(h, body)
+
+
+def test_switch_instances_on_same_launcher():
+    """Reference 'switch instances' (test-cases.sh:512-554): requester for A
+    deleted, requester for B arrives with the same chips -> same launcher
+    hosts both instances, A asleep, B awake."""
+    h = Harness()
+    h.add_lc("lc1", max_instances=2)
+    h.add_isc("iscA", "lc1", port=8000)
+    h.add_isc("iscB", "lc1", port=8100)
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid_a = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        h.add_requester("reqB", "iscB", chips=["chip-0"])
+        await h.settle()
+
+        assert len(h.launcher_pods()) == 1
+        lp = h.the_launcher_pod()
+        assert lp["metadata"]["name"] == lname
+        assert lp["metadata"]["annotations"][C.REQUESTER_ANNOTATION].startswith("reqB/")
+        fl = h.launcher_for(lname)
+        assert len(fl.instances) == 2
+        iid_b = lp["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        assert iid_b != iid_a
+        assert fl.instances[iid_a].engine.sleeping is True
+        assert fl.instances[iid_b].engine.sleeping is False
+
+    run_scenario(h, body)
+
+
+def test_cap_reclaim_without_new_launcher():
+    """Reference (test-cases.sh:560-627): cap 1; the sleeping victim is
+    deleted to make room rather than creating a second launcher."""
+    h = Harness()
+    h.add_lc("lc1", max_instances=1)
+    h.add_isc("iscA", "lc1", port=8000)
+    h.add_isc("iscB", "lc1", port=8100)
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid_a = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        h.add_requester("reqB", "iscB", chips=["chip-0"])
+        await h.settle()
+
+        assert len(h.launcher_pods()) == 1  # no new launcher
+        fl = h.launcher_for(lname)
+        assert iid_a in fl.deleted  # LRU victim reclaimed
+        assert len(fl.instances) == 1
+        iid_b = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        assert iid_b in fl.instances
+
+    run_scenario(h, body)
+
+
+def test_port_conflict_reclaim():
+    """Same port as the sleeping instance: it is the victim even with cap
+    headroom."""
+    h = Harness()
+    h.add_lc("lc1", max_instances=4)
+    h.add_isc("iscA", "lc1", port=8000)
+    h.add_isc("iscB", "lc1", port=8000)  # same port, different ISC
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid_a = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        h.add_requester("reqB", "iscB", chips=["chip-1"])  # different chips
+        await h.settle()
+
+        assert len(h.launcher_pods()) == 1
+        fl = h.launcher_for(lname)
+        assert iid_a in fl.deleted  # port-conflict victim
+        assert len(fl.instances) == 1
+
+    run_scenario(h, body)
+
+
+def test_controller_restart_recovery():
+    """Reference (test-cases.sh:634-712): a fresh controller over the same
+    store recovers bindings from annotations; the wake fast path still works."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+
+    run_scenario(h, body)
+
+    # "restart": brand-new controller object over the same store/transports
+    h.controller = DualPodsController(
+        h.store, h.transports, DualPodsConfig(namespace=h.ns)
+    )
+
+    async def body2():
+        await h.settle()  # initial sync reconciles everything
+        lp = h.the_launcher_pod()
+        lname = lp["metadata"]["name"]
+        iid = lp["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        fl = h.launcher_for(lname)
+        assert fl.created == [iid]  # recovery did NOT recreate the instance
+
+        # unbind driven purely by recovered annotation state
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+        assert h.store.try_get("Pod", h.ns, "reqA") is None
+        assert fl.instances[iid].engine.sleeping is True
+
+    run_scenario(h, body2)
+
+
+def test_isc_update_gcs_obsolete_sleeping_instance():
+    """Reference (test-cases.sh:719-737)."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1", options="--model tiny")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid_old = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+        assert iid_old in h.launcher_for(lname).instances
+
+        # ISC spec changes -> sleeping instance is now obsolete
+        def bump(isc):
+            isc["spec"]["modelServerConfig"]["options"] = "--model tiny --seed 7"
+            return isc
+
+        h.store.mutate("InferenceServerConfig", h.ns, "iscA", bump)
+        await h.settle()
+        assert iid_old in h.launcher_for(lname).deleted
+        assert iid_old not in h.launcher_for(lname).instances
+
+    run_scenario(h, body)
+
+
+def test_obsolete_awake_instance_deleted_on_unbind():
+    """Reference (test-cases.sh:744-776): ISC changed while bound -> on
+    unbind the awake instance is deleted, not slept."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1", options="--model tiny")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+
+        def bump(isc):
+            isc["spec"]["modelServerConfig"]["options"] = "--model tiny --seed 9"
+            return isc
+
+        h.store.mutate("InferenceServerConfig", h.ns, "iscA", bump)
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        fl = h.launcher_for(lname)
+        assert iid in fl.deleted  # deleted, not slept
+        # eventually the new-hash instance for the updated ISC may be created
+        # by a future requester; right now the launcher is empty of iid
+        assert iid not in fl.instances
+
+    run_scenario(h, body)
+
+
+def test_stopped_instance_recovery():
+    """Reference (test-cases.sh:833-897): instance dies inside the launcher;
+    controller deletes the requester; the 'ReplicaSet' recreates it; rebind
+    creates a fresh instance."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lp = h.the_launcher_pod()
+        lname = lp["metadata"]["name"]
+        iid = lp["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        fl = h.launcher_for(lname)
+
+        # the engine process dies (crash): notifier flips the signature ann
+        fl.instances[iid].status = "stopped"
+        h.store.mutate(
+            "Pod",
+            h.ns,
+            lname,
+            lambda p: (
+                p["metadata"]["annotations"].__setitem__(
+                    C.INSTANCE_SIGNATURE_ANNOTATION, "changed"
+                )
+                or p
+            ),
+        )
+        await h.settle()
+
+        # requester was deleted (healing); emulate the ReplicaSet
+        assert h.store.try_get("Pod", h.ns, "reqA") is None
+        h.add_requester("reqA-2", "iscA", chips=["chip-0"])
+        await h.settle()
+
+        lp = h.the_launcher_pod()
+        assert lp["metadata"]["annotations"][C.REQUESTER_ANNOTATION].startswith("reqA-2/")
+        assert fl.created.count(iid) == 2  # recreated fresh
+        assert fl.instances[iid].status == "running"
+        assert h.spis["reqA-2"].ready
+
+    run_scenario(h, body)
+
+
+def test_provider_deletion_relays_to_requester():
+    """Reference: exogenous provider deletion -> requester deleted (fresh
+    pair comes from the RS)."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+
+        h.store.delete("Pod", h.ns, lname)  # exogenous (finalizer -> Terminating)
+        await h.settle()
+
+        assert h.store.try_get("Pod", h.ns, "reqA") is None  # relayed
+        assert h.store.try_get("Pod", h.ns, lname) is None  # finalizer released
+
+    run_scenario(h, body)
+
+
+def test_memory_budget_blocks_wake():
+    h = Harness(accelerator_sleeping_memory_limit_bytes=1000)
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        iid = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+
+        # another process hogs HBM beyond the sleeping budget
+        h.add_requester("reqB", "iscA", chips=["chip-0"])
+        h.spis["reqB"].memory = {"chip-0": 10_000}
+        fl = h.launcher_for(lname)
+        await asyncio.sleep(1.0)
+        assert fl.instances[iid].engine.sleeping is True  # wake blocked
+        assert not h.spis["reqB"].ready
+
+        h.spis["reqB"].memory = {"chip-0": 10}
+        await h.settle()
+        assert fl.instances[iid].engine.sleeping is False
+        assert h.spis["reqB"].ready
+
+    run_scenario(h, body)
+
+
+def test_status_annotation_on_bad_isc():
+    h = Harness()
+    h.add_lc("lc1")
+
+    async def body():
+        h.add_requester("reqA", "missing-isc", chips=["chip-0"])
+        await asyncio.sleep(0.5)
+        req = h.store.get("Pod", h.ns, "reqA")
+        status = json.loads(req["metadata"]["annotations"][C.STATUS_ANNOTATION])
+        assert any("missing-isc" in e for e in status["Errors"])
+
+    run_scenario(h, body)

@@ -1,0 +1,187 @@
+"""Regression tests for reclaim edge cases, committed-binding authority,
+and routing-metadata churn."""
+
+import asyncio
+import time
+
+from prometheus_client import REGISTRY
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+
+from dualpods_harness import Harness, run_scenario
+
+
+def test_live_port_conflict_forces_new_launcher():
+    """A launcher whose port-conflicting instance is BOUND is unusable: the
+    controller must create a second launcher, not double-book the port."""
+    h = Harness()
+    h.add_lc("lc1", max_instances=4)
+    h.add_isc("iscA", "lc1", port=8000)
+    h.add_isc("iscB", "lc1", port=8000)  # same port
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        # reqA stays live; reqB wants the same port
+        h.add_requester("reqB", "iscB", chips=["chip-1"])
+        await h.settle()
+
+        pods = h.launcher_pods()
+        assert len(pods) == 2  # forced a fresh launcher
+        by_req = {
+            p["metadata"]["annotations"][C.REQUESTER_ANNOTATION].split("/")[0]: p
+            for p in pods
+        }
+        assert set(by_req) == {"reqA", "reqB"}
+        # nothing was deleted from reqA's launcher
+        fl_a = h.launcher_for(by_req["reqA"]["metadata"]["name"])
+        assert fl_a.deleted == []
+
+    run_scenario(h, body)
+
+
+def test_isc_change_while_bound_keeps_committed_instance():
+    """ISC spec change while bound must NOT spawn a second instance; the
+    committed instance keeps serving until unbind."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1", options="--model tiny")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        fl = h.launcher_for(lname)
+        iid_old = h.the_launcher_pod()["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION]
+        assert fl.created == [iid_old]
+
+        def bump(isc):
+            isc["spec"]["modelServerConfig"]["options"] = "--model tiny --v2"
+            return isc
+
+        h.store.mutate("InferenceServerConfig", h.ns, "iscA", bump)
+        await h.settle()
+
+        # still exactly one instance, the committed one, still awake
+        assert fl.created == [iid_old]
+        assert list(fl.instances) == [iid_old]
+        assert fl.instances[iid_old].engine.sleeping is False
+        lp = h.the_launcher_pod()
+        assert lp["metadata"]["annotations"][C.INSTANCE_ID_ANNOTATION] == iid_old
+
+    run_scenario(h, body)
+
+
+def test_routing_label_churn_removes_stale_keys():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1", labels={"route-a": "1"})
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0"])
+        await h.settle()
+        lname = h.the_launcher_pod()["metadata"]["name"]
+        assert h.the_launcher_pod()["metadata"]["labels"]["route-a"] == "1"
+
+        def relabel(isc):
+            isc["spec"]["modelServerConfig"]["labels"] = {"route-b": "2"}
+            return isc
+
+        h.store.mutate("InferenceServerConfig", h.ns, "iscA", relabel)
+        await h.settle()
+
+        lab = h.store.get("Pod", h.ns, lname)["metadata"]["labels"]
+        assert "route-a" not in lab  # stale key removed
+        assert lab["route-b"] == "2"
+
+        # and unbind cleans the new set too
+        h.store.delete("Pod", h.ns, "reqA")
+        await h.settle()
+        lab = h.store.get("Pod", h.ns, lname)["metadata"]["labels"]
+        assert "route-a" not in lab and "route-b" not in lab
+
+    run_scenario(h, body)
+
+
+def test_populator_phase_flip_timer():
+    """A quiet cluster still flips unbound -> stuck_starting at the threshold
+    (event-driven timer, no sweep)."""
+    import pytest
+
+    from llm_d_fast_model_actuation_tpu.controller.populator import (
+        Populator,
+        PopulatorConfig,
+    )
+    from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+
+    store = InMemoryStore()
+    store.create(
+        {
+            "kind": "Node",
+            "metadata": {"name": "n1", "labels": {"pool": "v5e"}},
+            "status": {"allocatable": {C.TPU_RESOURCE: "8"}},
+        }
+    )
+    store.create(
+        {
+            "kind": "LauncherConfig",
+            "metadata": {"name": "lc1", "namespace": "ns"},
+            "spec": {
+                "podTemplate": {
+                    "metadata": {},
+                    "spec": {"containers": [{"name": "launcher"}]},
+                },
+                "maxInstances": 1,
+            },
+        }
+    )
+    store.create(
+        {
+            "kind": "LauncherPopulationPolicy",
+            "metadata": {"name": "p1", "namespace": "ns"},
+            "spec": {
+                "enhancedNodeSelector": {
+                    "labelSelector": {"matchLabels": {"pool": "v5e"}}
+                },
+                "countForLauncher": [{"launcherConfigName": "lc1", "launcherCount": 1}],
+            },
+        }
+    )
+
+    async def runtime(pod):
+        # scheduled (nodeName set by template) but NEVER becomes Ready
+        def run(p):
+            p.setdefault("status", {})["podIP"] = "10.0.0.5"
+            return p
+
+        store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
+
+    pop = Populator(
+        store,
+        PopulatorConfig(
+            namespace="ns",
+            launcher_runtime=runtime,
+            stuck_starting_threshold_s=0.6,
+            stuck_scheduling_threshold_s=0.3,
+        ),
+    )
+
+    def metric(phase):
+        return REGISTRY.get_sample_value(
+            "fma_launcher_pod_count", {"lcfg_name": "lc1", "phase": phase}
+        )
+
+    async def body():
+        await pop.start()
+        try:
+            await pop.quiesce()
+            assert metric("unbound") == 1
+            assert metric("stuck_starting") == 0
+            # no events at all; the flip must come from the scheduled timer
+            await asyncio.sleep(1.2)
+            assert metric("stuck_starting") == 1
+            assert metric("unbound") == 0
+        finally:
+            await pop.stop()
+
+    asyncio.run(body())

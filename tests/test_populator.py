@@ -1,0 +1,353 @@
+"""Launcher-populator: digest, reconcile, expectations, phases, statuses.
+
+Mirrors the reference's unit suites (pending_expectations_test.go,
+metrics_test.go, node-matcher_test.go) plus reconciliation scenarios from
+the e2e (populator count, malformed-LPP rejection, stale drift cleanup).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.api.types import (
+    EnhancedNodeSelector,
+    LauncherConfig,
+    ResourceRange,
+)
+from llm_d_fast_model_actuation_tpu.controller.populator import (
+    HANDS_OFF,
+    SATISFIED,
+    TIMED_OUT,
+    WAITING,
+    PendingExpectations,
+    Populator,
+    PopulatorConfig,
+    build_launcher_template,
+    node_matches,
+    specialize_to_node,
+)
+from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+
+
+# -- pure units ---------------------------------------------------------------
+
+
+def test_pending_expectations_lifecycle():
+    exp = PendingExpectations(timeout_s=0.2)
+    assert exp.check(set()) == SATISFIED
+    exp.expect_creation("u1")
+    assert exp.check(set()) == WAITING
+    assert exp.check({"u1"}) == SATISFIED
+    exp.expect_deletion("u2")
+    assert exp.check({"u2"}) == WAITING
+    assert exp.check(set()) == SATISFIED
+    # mixed + timeout
+    exp.expect_creation("u3")
+    time.sleep(0.25)
+    assert exp.check(set()) == TIMED_OUT
+    exp.reset()
+    assert exp.check(set()) == SATISFIED
+
+
+def test_node_matcher_resource_ranges():
+    sel = EnhancedNodeSelector(
+        match_labels={"pool": "v5e"},
+        allocatable_resources={C.TPU_RESOURCE: ResourceRange(min="4", max="8")},
+    )
+    node = {
+        "kind": "Node",
+        "metadata": {"name": "n1", "labels": {"pool": "v5e"}},
+        "status": {"allocatable": {C.TPU_RESOURCE: "8"}},
+    }
+    assert node_matches(node, sel)
+    node["status"]["allocatable"][C.TPU_RESOURCE] = "2"
+    assert not node_matches(node, sel)
+    node["status"]["allocatable"][C.TPU_RESOURCE] = "8"
+    node["metadata"]["labels"] = {}
+    assert not node_matches(node, sel)
+    # missing resource = no match
+    del node["status"]["allocatable"][C.TPU_RESOURCE]
+    node["metadata"]["labels"] = {"pool": "v5e"}
+    assert not node_matches(node, sel)
+
+
+def test_template_hash_stability():
+    lc = LauncherConfig.from_dict(
+        {
+            "metadata": {"name": "lc1"},
+            "spec": {
+                "podTemplate": {
+                    "metadata": {"labels": {"x": "y"}},
+                    "spec": {"containers": [{"name": "launcher"}]},
+                },
+                "maxInstances": 1,
+            },
+        }
+    )
+    _, h1 = build_launcher_template(lc)
+    _, h2 = build_launcher_template(lc)
+    assert h1 == h2
+    pod = specialize_to_node(lc, "n1", h1)
+    assert pod["spec"]["nodeName"] == "n1"
+    assert pod["metadata"]["annotations"][C.LAUNCHER_TEMPLATE_HASH_ANNOTATION] == h1
+    pod2 = specialize_to_node(lc, "n2", h1)
+    assert (
+        pod["metadata"]["annotations"][C.LAUNCHER_CONFIG_HASH_ANNOTATION]
+        != pod2["metadata"]["annotations"][C.LAUNCHER_CONFIG_HASH_ANNOTATION]
+    )
+
+
+# -- reconciliation harness ---------------------------------------------------
+
+
+class PopHarness:
+    def __init__(self, ns: str = "ns") -> None:
+        self.ns = ns
+        self.store = InMemoryStore()
+
+        async def runtime(pod):
+            def run(p):
+                p.setdefault("status", {})["podIP"] = "10.0.0.2"
+                p["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+                return p
+
+            self.store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
+
+        self.populator = Populator(
+            self.store,
+            PopulatorConfig(namespace=ns, launcher_runtime=runtime),
+        )
+
+    def add_node(self, name: str, labels=None, tpus: str = "8"):
+        return self.store.create(
+            {
+                "kind": "Node",
+                "metadata": {"name": name, "labels": labels or {"pool": "v5e"}},
+                "status": {"allocatable": {C.TPU_RESOURCE: tpus}},
+            }
+        )
+
+    def add_lc(self, name: str = "lc1", max_instances: int = 2, broken: bool = False):
+        spec = {} if broken else {"containers": [{"name": "launcher"}]}
+        return self.store.create(
+            {
+                "kind": "LauncherConfig",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "podTemplate": {"metadata": {}, "spec": spec},
+                    "maxInstances": max_instances,
+                },
+            }
+        )
+
+    def add_lpp(self, name: str, lc_counts, match_labels=None, resources=None):
+        sel = {"labelSelector": {"matchLabels": match_labels or {"pool": "v5e"}}}
+        if resources:
+            sel["allocatableResources"] = resources
+        return self.store.create(
+            {
+                "kind": "LauncherPopulationPolicy",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "enhancedNodeSelector": sel,
+                    "countForLauncher": [
+                        {"launcherConfigName": lc, "launcherCount": n}
+                        for lc, n in lc_counts
+                    ],
+                },
+            }
+        )
+
+    def launchers(self, node=None, lc=None):
+        sel = {C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
+        if lc:
+            sel[C.LAUNCHER_CONFIG_NAME_LABEL] = lc
+        return self.store.list(
+            "Pod",
+            self.ns,
+            selector=sel,
+            predicate=(lambda p: (p.get("spec") or {}).get("nodeName") == node)
+            if node
+            else None,
+        )
+
+    async def run(self, body):
+        await self.populator.start()
+        try:
+            await body()
+        finally:
+            await self.populator.stop()
+
+    async def settle(self):
+        await self.populator.quiesce()
+
+
+def run_pop(h: PopHarness, body):
+    asyncio.run(h.run(body))
+
+
+def test_populates_matching_nodes():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_node("n1")
+    h.add_node("n2")
+    h.add_node("gpu-node", labels={"pool": "h100"})
+    h.add_lpp("p1", [("lc1", 2)])
+
+    async def body():
+        await h.settle()
+        assert len(h.launchers(node="n1", lc="lc1")) == 2
+        assert len(h.launchers(node="n2", lc="lc1")) == 2
+        assert len(h.launchers(node="gpu-node")) == 0
+
+    run_pop(h, body)
+
+
+def test_max_across_lpps_and_scale_down():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_node("n1")
+    h.add_lpp("p1", [("lc1", 1)])
+    h.add_lpp("p2", [("lc1", 3)])
+
+    async def body():
+        await h.settle()
+        assert len(h.launchers(node="n1", lc="lc1")) == 3  # max(1, 3)
+
+        h.store.delete("LauncherPopulationPolicy", h.ns, "p2")
+        await h.settle()
+        assert len(h.launchers(node="n1", lc="lc1")) == 1  # down to max(1)
+
+    run_pop(h, body)
+
+
+def test_bound_launchers_never_reaped():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_node("n1")
+    h.add_lpp("p1", [("lc1", 2)])
+
+    async def body():
+        await h.settle()
+        pods = h.launchers(node="n1", lc="lc1")
+        assert len(pods) == 2
+        # bind one (as the dual-pods controller would)
+        h.store.mutate(
+            "Pod",
+            h.ns,
+            pods[0]["metadata"]["name"],
+            lambda p: (
+                p["metadata"]["annotations"].__setitem__(
+                    C.REQUESTER_ANNOTATION, "reqX/uid"
+                )
+                or p
+            ),
+        )
+        # scale policy to zero
+        h.store.delete("LauncherPopulationPolicy", h.ns, "p1")
+        await h.settle()
+        left = h.launchers(node="n1", lc="lc1")
+        assert len(left) == 1  # the bound one survives
+        assert (
+            C.REQUESTER_ANNOTATION in left[0]["metadata"]["annotations"]
+        )
+
+    run_pop(h, body)
+
+
+def test_template_drift_replaces_stale_unbound():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_node("n1")
+    h.add_lpp("p1", [("lc1", 1)])
+
+    async def body():
+        await h.settle()
+        old = h.launchers(node="n1", lc="lc1")
+        assert len(old) == 1
+        old_uid = old[0]["metadata"]["uid"]
+
+        def change(lc):
+            lc["spec"]["podTemplate"]["spec"]["containers"] = [
+                {"name": "launcher", "image": "new"}
+            ]
+            return lc
+
+        h.store.mutate("LauncherConfig", h.ns, "lc1", change)
+        await h.settle()
+        new = h.launchers(node="n1", lc="lc1")
+        assert len(new) == 1
+        assert new[0]["metadata"]["uid"] != old_uid  # replaced, not kept
+
+    run_pop(h, body)
+
+
+def test_malformed_lc_is_hands_off_with_status():
+    h = PopHarness()
+    h.add_lc("broken-lc", broken=True)
+    h.add_node("n1")
+    h.add_lpp("p1", [("broken-lc", 2)])
+
+    async def body():
+        await h.settle()
+        assert h.launchers(node="n1") == []  # hands off
+        lpp = h.store.get("LauncherPopulationPolicy", h.ns, "p1")
+        assert any(
+            "broken-lc" in e for e in (lpp.get("status") or {}).get("errors", [])
+        )
+        lc = h.store.get("LauncherConfig", h.ns, "broken-lc")
+        assert (lc.get("status") or {}).get("errors")
+
+    run_pop(h, body)
+
+
+def test_missing_lc_reported_on_lpp():
+    h = PopHarness()
+    h.add_node("n1")
+    h.add_lpp("p1", [("ghost-lc", 2)])
+
+    async def body():
+        await h.settle()
+        assert h.launchers(node="n1") == []
+        lpp = h.store.get("LauncherPopulationPolicy", h.ns, "p1")
+        assert any(
+            "ghost-lc" in e for e in (lpp.get("status") or {}).get("errors", [])
+        )
+
+    run_pop(h, body)
+
+
+def test_resource_range_selection():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_node("big", tpus="8")
+    h.add_node("small", tpus="2")
+    h.add_lpp(
+        "p1",
+        [("lc1", 1)],
+        resources={C.TPU_RESOURCE: {"min": "4"}},
+    )
+
+    async def body():
+        await h.settle()
+        assert len(h.launchers(node="big", lc="lc1")) == 1
+        assert h.launchers(node="small", lc="lc1") == []
+
+    run_pop(h, body)
+
+
+def test_node_arrival_triggers_population():
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_lpp("p1", [("lc1", 1)])
+
+    async def body():
+        await h.settle()
+        assert h.launchers() == []
+        h.add_node("late-node")
+        await h.settle()
+        assert len(h.launchers(node="late-node", lc="lc1")) == 1
+
+    run_pop(h, body)

@@ -1,0 +1,151 @@
+"""Requester stub: SPI contract, log chunk dedup, probes relay.
+
+Mirrors the reference's real-HTTP-server tests
+(pkg/server/requester/coordination/server_test.go:85-199, probes/server_test.go).
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_d_fast_model_actuation_tpu.api import spi as spiapi
+from llm_d_fast_model_actuation_tpu.requester.probes import ProbesServer
+from llm_d_fast_model_actuation_tpu.requester.spi import LogSink, ReadyFlag, SpiServer
+
+
+def run_async(coro):
+    return asyncio.run(coro)
+
+
+def test_log_sink_dedup():
+    s = LogSink()
+    assert s.add_chunk(0, b"hello ")[0] == 200
+    assert s.length == 6
+    # exact continuation
+    assert s.add_chunk(6, b"world")[0] == 200
+    assert s.content() == b"hello world"
+    # overlap: only the new tail is kept
+    code, msg = s.add_chunk(6, b"world! more")
+    assert code == 200
+    assert s.content() == b"hello world! more"
+    # fully-contained chunk: nothing new
+    code, msg = s.add_chunk(0, b"hello")
+    assert code == 200 and "nothing new" in msg
+    assert s.content() == b"hello world! more"
+    # gap: 400
+    assert s.add_chunk(100, b"x")[0] == 400
+    assert s.add_chunk(-1, b"x")[0] == 400
+
+
+def test_spi_endpoints():
+    ready = ReadyFlag(False)
+    spi = SpiServer(
+        ["tpu-a", "tpu-b"],
+        ready,
+        memory_usage=lambda: {"tpu-a": 123, "tpu-b": 456},
+    )
+    probes = ProbesServer(ready)
+
+    async def scenario():
+        spi_client = TestClient(TestServer(spi.build_app()))
+        probes_client = TestClient(TestServer(probes.build_app()))
+        await spi_client.start_server()
+        await probes_client.start_server()
+        try:
+            r = await spi_client.get(spiapi.ACCELERATOR_QUERY_PATH)
+            assert await r.json() == ["tpu-a", "tpu-b"]
+
+            r = await spi_client.get(spiapi.ACCELERATOR_MEMORY_QUERY_PATH)
+            assert await r.json() == {"tpu-a": 123, "tpu-b": 456}
+
+            # readiness relay: probes flips with become-(un)ready
+            r = await probes_client.get(spiapi.READY_PATH)
+            assert r.status == 503
+            r = await spi_client.post(spiapi.BECOME_READY_PATH)
+            assert r.status == 200
+            r = await probes_client.get(spiapi.READY_PATH)
+            assert r.status == 200
+            r = await spi_client.post(spiapi.BECOME_UNREADY_PATH)
+            assert r.status == 200
+            assert (await probes_client.get(spiapi.READY_PATH)).status == 503
+
+            # set-log protocol over HTTP
+            r = await spi_client.post(
+                spiapi.SET_LOG_PATH,
+                params={spiapi.LOG_START_POS_PARAM: "0"},
+                data=b"line1\n",
+            )
+            assert r.status == 200
+            r = await spi_client.post(
+                spiapi.SET_LOG_PATH,
+                params={spiapi.LOG_START_POS_PARAM: "3"},
+                data=b"e1\nline2\n",
+            )
+            assert r.status == 200
+            assert spi.log_sink.content() == b"line1\nline2\n"
+            r = await spi_client.post(
+                spiapi.SET_LOG_PATH,
+                params={spiapi.LOG_START_POS_PARAM: "999"},
+                data=b"gap",
+            )
+            assert r.status == 400
+            r = await spi_client.post(spiapi.SET_LOG_PATH, data=b"no param")
+            assert r.status == 400
+            r = await spi_client.post(
+                spiapi.SET_LOG_PATH,
+                params={spiapi.LOG_START_POS_PARAM: "xyz"},
+                data=b"bad",
+            )
+            assert r.status == 400
+        finally:
+            await spi_client.close()
+            await probes_client.close()
+
+    run_async(scenario())
+
+
+def test_memory_backend_failure_is_500():
+    def broken():
+        raise RuntimeError("telemetry down")
+
+    spi = SpiServer(["c"], ReadyFlag(), memory_usage=broken)
+
+    async def scenario():
+        client = TestClient(TestServer(spi.build_app()))
+        await client.start_server()
+        try:
+            r = await client.get(spiapi.ACCELERATOR_MEMORY_QUERY_PATH)
+            assert r.status == 500
+            assert "telemetry down" in await r.text()
+        finally:
+            await client.close()
+
+    run_async(scenario())
+
+
+def test_static_backend_resolution():
+    from llm_d_fast_model_actuation_tpu.requester.main import resolve_chips
+    import argparse
+
+    args = argparse.Namespace(backend="static", chips="a,b,c", chip_map_path="")
+    assert resolve_chips(args) == ["a", "b", "c"]
+
+
+def test_env_backend_resolution(tmp_path, monkeypatch):
+    import json
+
+    from llm_d_fast_model_actuation_tpu.parallel.topology import ChipMap, HostTopology
+    from llm_d_fast_model_actuation_tpu.requester.main import resolve_chips
+    import argparse
+
+    cm = ChipMap()
+    host = HostTopology.make("2x2", node="n9")
+    cm.set_host("n9", host)
+    path = tmp_path / "map.json"
+    path.write_text(json.dumps(cm.dump()))
+    monkeypatch.setenv("NODE_NAME", "n9")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "1,3")
+    args = argparse.Namespace(backend="env", chips="", chip_map_path=str(path))
+    got = resolve_chips(args)
+    assert got == [host.chips[1].chip_id, host.chips[3].chip_id]
