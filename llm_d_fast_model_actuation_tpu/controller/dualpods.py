@@ -137,6 +137,10 @@ class DualPodsController:
         self.cfg = cfg or DualPodsConfig()
         self.server_data: Dict[str, ServerData] = {}  # requester uid ->
         self.launcher_data: Dict[str, LauncherData] = {}  # launcher pod name ->
+        # provider pod name -> duality label sets currently at 1, so unbind
+        # can zero exactly what bind raised (reference: duality<-0 on unbind,
+        # inference-server.go:764-780).
+        self._duality_up: Dict[str, List[Tuple[str, str, str]]] = {}
         self._queues: Dict[str, asyncio.Queue] = {}
         self._workers: Dict[str, asyncio.Task] = {}
         self._unsub: Optional[Callable[[], None]] = None
@@ -334,6 +338,8 @@ class DualPodsController:
             except (NotFound, Conflict):
                 pass
             self._remove_finalizer("Pod", ns, provider["metadata"]["name"])
+            for key in self._duality_up.pop(provider["metadata"]["name"], []):
+                M.DUALITY.labels(isc_name=key[0], chip=key[1], node=key[2]).set(0)
             return
 
         if provider is not None and pod_in_trouble(provider):
@@ -717,12 +723,13 @@ class DualPodsController:
                         instancesDeleted=str(sd.instances_deleted),
                         isc_name=isc_name,
                     ).observe(time.monotonic() - sd.start_time)
-                    for chip in sd.chip_ids or []:
+                    node = req["spec"].get("nodeName", "")
+                    keys = [(isc_name, chip, node) for chip in sd.chip_ids or []]
+                    for key in keys:
                         M.DUALITY.labels(
-                            isc_name=isc_name,
-                            chip=chip,
-                            node=req["spec"].get("nodeName", ""),
+                            isc_name=key[0], chip=key[1], node=key[2]
                         ).set(1)
+                    self._duality_up[pname] = keys
         else:
             self._apply_sleeping_label(ns, pname, "false")
             self._ensure_req_state(ns, req, sd, pname)
@@ -817,6 +824,8 @@ class DualPodsController:
             self.store.mutate("Pod", ns, pname, apply)
         except NotFound:
             pass
+        for key in self._duality_up.pop(pname, []):
+            M.DUALITY.labels(isc_name=key[0], chip=key[1], node=key[2]).set(0)
         logger.info("unbound provider %s", pname)
 
     def _instance_obsolete(

@@ -167,6 +167,7 @@ class Populator:
         self._stopping = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._inflight = 0
+        self._active_keys: Set[Tuple[str, str]] = set()
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
@@ -368,12 +369,23 @@ class Populator:
     async def _key_worker(self) -> None:
         while not self._stopping:
             node, lc = await self._key_queue.get()
+            key = (node, lc)
+            # Per-key serialization: two workers reconciling the same
+            # (node, lc) would both count the same world and double-create
+            # across the awaits inside _reconcile_key. Defer to the holder
+            # and run again once it is done.
+            if key in self._active_keys:
+                self._requeue_later(node, lc, 0.05)
+                self._key_queue.task_done()
+                continue
+            self._active_keys.add(key)
             self._inflight += 1
             try:
                 await self._reconcile_key(node, lc)
             except Exception:
                 logger.exception("reconcile (%s, %s) failed", node, lc)
             finally:
+                self._active_keys.discard(key)
                 self._inflight -= 1
                 self._key_queue.task_done()
 

@@ -126,8 +126,33 @@ class EngineService:
             seed=args.seed,
         )
         self.sleeper = attach_sleep(self.engine)
+        self._publisher = self._make_publisher()
+        self._publish_usage()
         self._thread = threading.Thread(target=self._run, daemon=True, name="engine-loop")
         self._thread.start()
+
+    def _make_publisher(self):
+        chip_ids = [c for c in os.environ.get("FMA_CHIP_IDS", "").split(",") if c]
+        if not chip_ids:
+            return None
+        from ..native.hbm_publisher import HbmUsagePublisher
+
+        return HbmUsagePublisher(chip_ids)
+
+    def _publish_usage(self) -> None:
+        """Report live HBM bytes to the cooperative usage protocol so the
+        requester SPI / controller budget check see this process the way the
+        reference sees a CUDA process through nvidia-smi."""
+        if self._publisher is None:
+            return
+        if self.sleeper.is_sleeping:
+            self._publisher.set_uniform(0)
+        else:
+            state = {"p": self.engine.params, "kv": self.engine.pool.as_tuple()}
+            import jax
+
+            nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
+            self._publisher.set_uniform(nbytes)
 
     # -- engine thread -------------------------------------------------------
 
@@ -184,7 +209,9 @@ class EngineService:
 
     def sleep(self, level: int) -> Dict[str, Any]:
         with self._lock:
-            return self.sleeper.sleep(level)
+            out = self.sleeper.sleep(level)
+        self._publish_usage()
+        return out
 
     def wake_up(self) -> Dict[str, Any]:
         with self._lock:
@@ -226,6 +253,7 @@ class EngineService:
                 out = self.sleeper.wake_up(reinit=reinit)
             else:
                 out = self.sleeper.wake_up()
+        self._publish_usage()
         self._new_work.set()
         return out
 
@@ -233,6 +261,8 @@ class EngineService:
         self._stop = True
         self._new_work.set()
         self._thread.join(timeout=5)
+        if self._publisher is not None:
+            self._publisher.clear()
 
 
 def _tokenize(prompt: Any) -> List[int]:

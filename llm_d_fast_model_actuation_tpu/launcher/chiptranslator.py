@@ -108,7 +108,9 @@ def _enumerate_real() -> HostTopology:
         logger.debug("native tpuinfo unavailable: %s", e)
     # /dev/accel* fallback (TPU VMs expose one accel device per chip)
     accels = sorted(
-        int(name[5:]) for name in os.listdir("/dev") if name.startswith("accel")
+        int(name[5:])
+        for name in os.listdir("/dev")
+        if name.startswith("accel") and name[5:].isdigit()
     ) if os.path.isdir("/dev") else []
     if accels:
         host = HostTopology.make(_default_topology(len(accels)), node="local")

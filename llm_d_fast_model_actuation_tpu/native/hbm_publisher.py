@@ -1,0 +1,60 @@
+"""Engine side of the cooperative HBM-usage protocol.
+
+The TPU runtime — unlike NVML (reference:
+pkg/server/requester/coordination/server.go:100, which reads another
+process's GPU memory via `nvidia-smi`) — exposes no cross-process device
+memory query. So usage telemetry is cooperative: each engine process
+publishes its live per-chip HBM byte count as a decimal string at
+
+    $FMA_TPUINFO_USAGE_DIR/<chip_id>/<pid>     (default /run/fma-tpu/hbm)
+
+and the native shim (`native/tpuinfo/tpuinfo.cpp`) sums live writers per
+chip, pruning files of dead pids. The requester SPI's accelerator-memory
+query and the controller's pre-wake budget check then work exactly like the
+reference's NVML path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable
+
+DEFAULT_USAGE_DIR = "/run/fma-tpu/hbm"
+
+
+def usage_dir() -> str:
+    return os.environ.get("FMA_TPUINFO_USAGE_DIR", DEFAULT_USAGE_DIR)
+
+
+class HbmUsagePublisher:
+    """Publishes this process's per-chip HBM usage; one file per chip."""
+
+    def __init__(self, chip_ids: Iterable[str], root: str | None = None) -> None:
+        self._chip_ids = list(chip_ids)
+        self._root = root or usage_dir()
+        self._pid = os.getpid()
+
+    def set(self, bytes_by_chip: Dict[str, int]) -> None:
+        for chip_id in self._chip_ids:
+            path = os.path.join(self._root, chip_id)
+            try:
+                os.makedirs(path, exist_ok=True)
+                tmp = os.path.join(path, f".{self._pid}.tmp")
+                with open(tmp, "w") as f:
+                    f.write(str(int(bytes_by_chip.get(chip_id, 0))))
+                os.replace(tmp, os.path.join(path, str(self._pid)))
+            except OSError:
+                pass  # telemetry is best-effort; never fail the engine for it
+
+    def set_uniform(self, total_bytes: int) -> None:
+        """Spread `total_bytes` evenly over this engine's chips (the common
+        case: SPMD-sharded state uses the same HBM on every chip)."""
+        n = max(1, len(self._chip_ids))
+        self.set({cid: total_bytes // n for cid in self._chip_ids})
+
+    def clear(self) -> None:
+        for chip_id in self._chip_ids:
+            try:
+                os.unlink(os.path.join(self._root, chip_id, str(self._pid)))
+            except OSError:
+                pass

@@ -79,6 +79,9 @@ class HostTopology:
             "TPU_CHIPS_PER_PROCESS_BOUNDS": _chips_bounds(
                 [c.coords for c in chips], self.topology.dims
             ),
+            # Engine-side identity for the cooperative HBM-usage protocol
+            # (native/hbm_publisher.py) — the chips this process accounts to.
+            "FMA_CHIP_IDS": ",".join(c.chip_id for c in chips),
         }
         return env
 
