@@ -39,6 +39,15 @@ from ..api.types import EngineServerConfig, InferenceServerConfig, LauncherConfi
 from ..utils.hashing import canonical_json, instance_id_for, sha256_hex, template_hash
 from . import metrics as M
 from .clients import InstanceNotFound, Transports
+from .directpath import (
+    DIRECT_PROVIDER_COMPONENT,
+    LAST_USED_ANNOTATION,
+    NOMINAL_HASH_ANNOTATION,
+    ProviderData,
+    load_chip_map,
+    nominal_provider_pod,
+    render_server_patch,
+)
 from .store import Conflict, InMemoryStore, NotFound
 
 logger = logging.getLogger(__name__)
@@ -117,6 +126,8 @@ class DualPodsConfig:
     #: Hook invoked after the controller creates a launcher Pod object —
     #: deployment glue (or the test harness) makes the pod actually run.
     launcher_runtime: Optional[Callable[[Dict[str, Any]], Awaitable[None]]] = None
+    #: Same for direct (server-patch path) provider Pods.
+    provider_runtime: Optional[Callable[[Dict[str, Any]], Awaitable[None]]] = None
 
 
 class Retry(Exception):
@@ -212,6 +223,13 @@ class DualPodsController:
                     self._enqueue(node, ("requester", ns, req.split("/")[0]))
                 else:
                     self._enqueue(node, ("launcher-sweep", ns, name))
+            elif lab.get(C.COMPONENT_LABEL) == DIRECT_PROVIDER_COMPONENT:
+                req = ann.get(C.REQUESTER_ANNOTATION, "")
+                if req:
+                    node = ((obj.get("spec") or {}).get("nodeSelector") or {}).get(
+                        "kubernetes.io/hostname", ""
+                    )
+                    self._enqueue(node, ("requester", ns, req.split("/")[0]))
         elif kind == InferenceServerConfig.KIND:
             self._enqueue("", ("isc-changed", ns, name))
 
@@ -290,14 +308,23 @@ class DualPodsController:
 
     def _providers_for(self, ns: str, req_name: str) -> List[Dict[str, Any]]:
         def is_bound_to(pod: Dict[str, Any]) -> bool:
+            if (pod["metadata"].get("labels") or {}).get(C.COMPONENT_LABEL) not in (
+                C.LAUNCHER_COMPONENT,
+                DIRECT_PROVIDER_COMPONENT,
+            ):
+                return False
             v = (pod["metadata"].get("annotations") or {}).get(
                 C.REQUESTER_ANNOTATION, ""
             )
             return v.split("/")[0] == req_name
 
-        return self.store.list(
-            "Pod", ns, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT},
-            predicate=is_bound_to,
+        return self.store.list("Pod", ns, predicate=is_bound_to)
+
+    @staticmethod
+    def _is_direct(pod: Dict[str, Any]) -> bool:
+        return (
+            (pod["metadata"].get("labels") or {}).get(C.COMPONENT_LABEL)
+            == DIRECT_PROVIDER_COMPONENT
         )
 
     async def _reconcile_requester(self, ns: str, name: str) -> None:
@@ -367,6 +394,23 @@ class DualPodsController:
 
         ann = req["metadata"].get("annotations") or {}
         isc_name = ann.get(C.INFERENCE_SERVER_CONFIG_ANNOTATION, "")
+        patch_tmpl = ann.get(C.SERVER_PATCH_ANNOTATION, "")
+        if isc_name and patch_tmpl:
+            self._set_status(
+                ns,
+                name,
+                ["server-patch and inference-server-config are mutually exclusive"],
+            )
+            return
+        # A provider of the wrong kind (requester annotations were switched
+        # between the two paths while bound) can't be driven by either state
+        # machine — unbind it and start clean.
+        if provider is not None and self._is_direct(provider) != bool(patch_tmpl):
+            await self._ensure_unbound(ns, provider)
+            provider = None
+        if patch_tmpl:
+            await self._reconcile_direct(ns, req, provider, patch_tmpl, node, sd)
+            return
         if not isc_name:
             self._set_status(ns, name, ["no inference-server-config annotation"])
             return
@@ -759,11 +803,277 @@ class DualPodsController:
                 after=1.0,
             )
 
+    # ------------------------------------------------- direct path (M2 scope)
+
+    async def _reconcile_direct(
+        self,
+        ns: str,
+        req: Dict[str, Any],
+        provider: Optional[Dict[str, Any]],
+        patch_tmpl: str,
+        node: str,
+        sd: ServerData,
+    ) -> None:
+        """Server-patch path: derive the nominal provider from the requester,
+        reuse a sleeping twin or create one (getNominalServerProvidingPod +
+        the direct branch of infSvrItem.process, inference-server.go:617-668)."""
+        name = req["metadata"]["name"]
+        chip_map = load_chip_map(self.store, ns)
+        try:
+            patch = render_server_patch(patch_tmpl, ProviderData(node_name=node))
+            nominal = nominal_provider_pod(req, patch, node, sd.chip_ids or [], chip_map)
+        except ValueError as e:
+            self._set_status(ns, name, [f"server-patch: {e}"])
+            return
+        want_hash = nominal["metadata"]["annotations"][NOMINAL_HASH_ANNOTATION]
+        if provider is not None:
+            # The committed binding is authoritative while bound: drive the
+            # engine at the port recorded at bind time, not at whatever the
+            # (possibly edited) patch renders to now.
+            committed = (provider["metadata"].get("annotations") or {}).get(
+                C.SERVER_PORT_ANNOTATION
+            )
+            sd.server_port = int(
+                committed
+                or nominal["metadata"]["annotations"][C.SERVER_PORT_ANNOTATION]
+            )
+        else:
+            sd.server_port = int(
+                nominal["metadata"]["annotations"][C.SERVER_PORT_ANNOTATION]
+            )
+
+        if provider is None:
+            twin = self._find_sleeping_twin(ns, node, want_hash)
+            if twin is not None:
+                sd.path = sd.path or "warm"
+                provider = await self._bind_direct(ns, req, twin)
+            else:
+                self._enforce_sleeper_budget(ns, node, sd.chip_ids or [])
+                provider = await self._create_direct_provider(ns, req, nominal, sd)
+            if provider is None:
+                raise Retry("direct provider not available yet", after=0.2)
+
+        await self._reconcile_bound_direct(ns, req, provider, sd)
+
+    def _find_sleeping_twin(
+        self, ns: str, node: str, want_hash: str
+    ) -> Optional[Dict[str, Any]]:
+        """Unbound sleeping direct provider with the same nominal hash on the
+        same node (the `nominal` index lookup, inference-server.go:1848-1860)."""
+        def match(pod: Dict[str, Any]) -> bool:
+            m = pod["metadata"]
+            ann = m.get("annotations") or {}
+            return (
+                (m.get("labels") or {}).get(C.COMPONENT_LABEL)
+                == DIRECT_PROVIDER_COMPONENT
+                and not _deleting(pod)
+                and C.REQUESTER_ANNOTATION not in ann
+                and ann.get(NOMINAL_HASH_ANNOTATION) == want_hash
+                and ((pod.get("spec") or {}).get("nodeSelector") or {}).get(
+                    "kubernetes.io/hostname"
+                )
+                == node
+            )
+
+        twins = self.store.list("Pod", ns, predicate=match)
+        return twins[0] if twins else None
+
+    async def _bind_direct(
+        self, ns: str, req: Dict[str, Any], twin: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        name = twin["metadata"]["name"]
+        rm = req["metadata"]
+        try:
+            def apply(pod: Dict[str, Any]) -> Dict[str, Any]:
+                if C.REQUESTER_ANNOTATION in (pod["metadata"].get("annotations") or {}):
+                    raise Conflict(f"{name} got bound concurrently")
+                _ann(pod)[C.REQUESTER_ANNOTATION] = f"{rm['name']}/{rm['uid']}"
+                _labels(pod)[C.DUAL_LABEL] = rm["name"]
+                fins = _meta(pod).setdefault("finalizers", [])
+                if FINALIZER not in fins:
+                    fins.append(FINALIZER)
+                return pod
+
+            bound = self.store.mutate("Pod", ns, name, apply)
+        except (Conflict, NotFound) as e:
+            raise Retry(f"bind twin {name}: {e}", after=0.1)
+        logger.info("bound %s -> sleeping twin %s", rm["name"], name)
+        return bound
+
+    async def _create_direct_provider(
+        self,
+        ns: str,
+        req: Dict[str, Any],
+        nominal: Dict[str, Any],
+        sd: ServerData,
+    ) -> Optional[Dict[str, Any]]:
+        rm = req["metadata"]
+        pod = nominal
+        pod["metadata"]["namespace"] = ns
+        pod["metadata"]["name"] = f"{rm['name']}-provider-{int(time.time()*1000)%100000}"
+        ann = _ann(pod)
+        ann[C.REQUESTER_ANNOTATION] = f"{rm['name']}/{rm['uid']}"
+        _labels(pod)[C.DUAL_LABEL] = rm["name"]
+        fins = _meta(pod).setdefault("finalizers", [])
+        if FINALIZER not in fins:
+            fins.append(FINALIZER)
+        created = self.store.create(pod)
+        if self.cfg.provider_runtime is not None:
+            await self.cfg.provider_runtime(created)
+        sd.path = "cold"
+        logger.info("created direct provider %s for %s", pod["metadata"]["name"], rm["name"])
+        return self.store.try_get("Pod", ns, pod["metadata"]["name"])
+
+    def _enforce_sleeper_budget(
+        self, ns: str, node: str, chip_ids: List[str]
+    ) -> None:
+        """At most `sleeper_limit` sleeping direct providers per chip: evict
+        least-recently-used sleepers until the new provider fits
+        (enforceSleeperBudget, inference-server.go:1353-1427)."""
+        limit = self.cfg.sleeper_limit
+        if limit <= 0:
+            return
+
+        def is_sleeper(pod: Dict[str, Any]) -> bool:
+            m = pod["metadata"]
+            return (
+                (m.get("labels") or {}).get(C.COMPONENT_LABEL)
+                == DIRECT_PROVIDER_COMPONENT
+                and (m.get("labels") or {}).get(C.SLEEPING_LABEL) == "true"
+                and C.REQUESTER_ANNOTATION not in (m.get("annotations") or {})
+                and not _deleting(pod)
+                and ((pod.get("spec") or {}).get("nodeSelector") or {}).get(
+                    "kubernetes.io/hostname"
+                )
+                == node
+            )
+
+        sleepers = self.store.list("Pod", ns, predicate=is_sleeper)
+
+        def chips_of(pod: Dict[str, Any]) -> Set[str]:
+            raw = (pod["metadata"].get("annotations") or {}).get(
+                C.ACCELERATORS_ANNOTATION, ""
+            )
+            return {c for c in raw.split(",") if c}
+
+        def last_used(pod: Dict[str, Any]) -> float:
+            try:
+                return float(
+                    (pod["metadata"].get("annotations") or {}).get(
+                        LAST_USED_ANNOTATION, "0"
+                    )
+                )
+            except ValueError:
+                return 0.0
+
+        for chip in chip_ids:
+            on_chip = [p for p in sleepers if chip in chips_of(p)]
+            on_chip.sort(key=last_used)
+            while len(on_chip) >= limit:
+                victim = on_chip.pop(0)
+                vname = victim["metadata"]["name"]
+                try:
+                    self.store.delete("Pod", ns, vname)
+                    logger.info("sleeper budget: evicted %s (chip %s)", vname, chip)
+                except NotFound:
+                    pass
+                sleepers = [p for p in sleepers if p["metadata"]["name"] != vname]
+
+    async def _reconcile_bound_direct(
+        self,
+        ns: str,
+        req: Dict[str, Any],
+        provider: Dict[str, Any],
+        sd: ServerData,
+    ) -> None:
+        pname = provider["metadata"]["name"]
+        engine = self.transports.engine_admin(provider, sd.server_port)
+        try:
+            sleeping = await engine.is_sleeping()
+        except Exception as e:
+            raise Retry(f"is_sleeping({pname}): {e}", after=0.3)
+        if sleeping:
+            await self._check_memory_budget(req, sd)
+            try:
+                await engine.wake_up()
+            except Exception as e:
+                raise Retry(f"wake_up({pname}): {e}", after=0.3)
+            sd.path = sd.path or "warm"
+        sd.sleeping = False
+
+        healthy = await engine.healthy()
+        self._apply_sleeping_label(ns, pname, "false")
+        self._ensure_req_state(ns, req, sd, pname)
+        if not healthy:
+            if sd.readiness_relayed is True:
+                try:
+                    await self.transports.requester_spi(req).become_unready()
+                except Exception:
+                    pass
+                sd.readiness_relayed = False
+            raise Retry("direct engine not serving yet", after=0.3)
+        if sd.readiness_relayed is not True:
+            try:
+                await self.transports.requester_spi(req).become_ready()
+            except Exception as e:
+                raise Retry(f"become-ready: {e}", after=0.2)
+            sd.readiness_relayed = True
+            if not sd.first_ready_relayed:
+                sd.first_ready_relayed = True
+                M.ACTUATION_SECONDS.labels(
+                    path=sd.path or "hot",
+                    instancesDeleted=str(sd.instances_deleted),
+                    isc_name="direct",
+                ).observe(time.monotonic() - sd.start_time)
+                node = req["spec"].get("nodeName", "")
+                keys = [("direct", chip, node) for chip in sd.chip_ids or []]
+                for key in keys:
+                    M.DUALITY.labels(
+                        isc_name=key[0], chip=key[1], node=key[2]
+                    ).set(1)
+                self._duality_up[pname] = keys
+
+    async def _ensure_unbound_direct(self, ns: str, provider: Dict[str, Any]) -> None:
+        """Sleep the engine and keep the Pod as a sleeping twin."""
+        pname = provider["metadata"]["name"]
+        ann = provider["metadata"].get("annotations") or {}
+        if C.REQUESTER_ANNOTATION not in ann:
+            return
+        port = int(ann.get(C.SERVER_PORT_ANNOTATION, "0") or 0)
+        engine = self.transports.engine_admin(provider, port)
+        try:
+            await engine.sleep(1)
+        except Exception as e:
+            logger.warning("sleep of direct provider %s failed: %s", pname, e)
+
+        def apply(pod: Dict[str, Any]) -> Dict[str, Any]:
+            a = _ann(pod)
+            a.pop(C.REQUESTER_ANNOTATION, None)
+            a[LAST_USED_ANNOTATION] = str(time.time())
+            lab = _labels(pod)
+            lab.pop(C.DUAL_LABEL, None)
+            lab[C.SLEEPING_LABEL] = "true"
+            fins = pod["metadata"].get("finalizers") or []
+            if FINALIZER in fins:
+                fins.remove(FINALIZER)
+            return pod
+
+        try:
+            self.store.mutate("Pod", ns, pname, apply)
+        except NotFound:
+            pass
+        for key in self._duality_up.pop(pname, []):
+            M.DUALITY.labels(isc_name=key[0], chip=key[1], node=key[2]).set(0)
+        logger.info("unbound direct provider %s (now a sleeping twin)", pname)
+
     # ---------------------------------------------------------------- unbind
 
     async def _ensure_unbound(self, ns: str, provider: Dict[str, Any]) -> None:
         """Sleep (or GC) the instance, then clear binding metadata in one
         update (ensureUnbound, inference-server.go:1669-1764)."""
+        if self._is_direct(provider):
+            await self._ensure_unbound_direct(ns, provider)
+            return
         pname = provider["metadata"]["name"]
         ann = provider["metadata"].get("annotations") or {}
         if C.REQUESTER_ANNOTATION not in ann:

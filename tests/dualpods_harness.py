@@ -140,6 +140,27 @@ class FakeEngineHandle:
         return inst is not None and inst.engine.healthy and not inst.engine.sleeping
 
 
+class DirectEngineHandle:
+    """Admin handle for a direct provider's (single) engine."""
+
+    def __init__(self, engine: FakeEngine) -> None:
+        self._e = engine
+
+    async def is_sleeping(self) -> bool:
+        return self._e.sleeping
+
+    async def sleep(self, level: int = 1) -> None:
+        self._e.sleeping = True
+        self._e.sleep_calls += 1
+
+    async def wake_up(self) -> None:
+        self._e.sleeping = False
+        self._e.wake_calls += 1
+
+    async def healthy(self) -> bool:
+        return self._e.healthy and not self._e.sleeping
+
+
 class FakeTransports:
     def __init__(self, harness: "Harness") -> None:
         self._h = harness
@@ -151,6 +172,13 @@ class FakeTransports:
         return self._h.spi_for(pod["metadata"]["name"])
 
     def engine_admin(self, pod, port):
+        from llm_d_fast_model_actuation_tpu.controller.directpath import (
+            DIRECT_PROVIDER_COMPONENT,
+        )
+
+        labels = pod["metadata"].get("labels") or {}
+        if labels.get(C.COMPONENT_LABEL) == DIRECT_PROVIDER_COMPONENT:
+            return DirectEngineHandle(self._h.direct_engine_for(pod["metadata"]["name"]))
         return FakeEngineHandle(self._h.launcher_for(pod["metadata"]["name"]), port)
 
 
@@ -172,16 +200,39 @@ class Harness:
 
             self.store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
 
+        self.direct_engines: Dict[str, FakeEngine] = {}
+
+        async def provider_runtime(pod):
+            # the "kubelet" for direct providers: engine comes up awake
+            self.direct_engines.setdefault(pod["metadata"]["name"], FakeEngine())
+
+            def run(p):
+                p.setdefault("status", {})["podIP"] = "10.0.0.2"
+                p["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+                return p
+
+            self.store.mutate("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"], run)
+
         self.controller = DualPodsController(
             self.store,
             self.transports,
-            DualPodsConfig(namespace=ns, launcher_runtime=launcher_runtime, **cfg_kwargs),
+            DualPodsConfig(
+                namespace=ns,
+                launcher_runtime=launcher_runtime,
+                provider_runtime=provider_runtime,
+                **cfg_kwargs,
+            ),
         )
 
     def launcher_for(self, name: str) -> FakeLauncher:
         if name not in self.launchers:
             self.launchers[name] = FakeLauncher(name)
         return self.launchers[name]
+
+    def direct_engine_for(self, name: str) -> FakeEngine:
+        if name not in self.direct_engines:
+            self.direct_engines[name] = FakeEngine()
+        return self.direct_engines[name]
 
     def spi_for(self, name: str) -> FakeSpi:
         if name not in self.spis:
@@ -253,6 +304,50 @@ class Harness:
                     "conditions": [{"type": "Ready", "status": "False"}],
                 },
             }
+        )
+
+    def add_direct_requester(
+        self,
+        name: str,
+        patch: str,
+        node: str = "n1",
+        chips: Optional[List[str]] = None,
+        port: int = 8000,
+    ) -> Dict[str, Any]:
+        self.spis[name] = FakeSpi(chips or ["chip-0"])
+        return self.store.create(
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": self.ns,
+                    "annotations": {C.SERVER_PATCH_ANNOTATION: patch},
+                },
+                "spec": {
+                    "nodeName": node,
+                    "containers": [
+                        {
+                            "name": C.INFERENCE_SERVER_CONTAINER_NAME,
+                            "image": "requester-stub",
+                            "readinessProbe": {"httpGet": {"port": port, "path": "/health"}},
+                            "resources": {"limits": {C.TPU_RESOURCE: "1"}},
+                        }
+                    ],
+                },
+                "status": {
+                    "podIP": "10.0.0.9",
+                    "conditions": [{"type": "Ready", "status": "False"}],
+                },
+            }
+        )
+
+    def direct_provider_pods(self) -> List[Dict[str, Any]]:
+        from llm_d_fast_model_actuation_tpu.controller.directpath import (
+            DIRECT_PROVIDER_COMPONENT,
+        )
+
+        return self.store.list(
+            "Pod", self.ns, selector={C.COMPONENT_LABEL: DIRECT_PROVIDER_COMPONENT}
         )
 
     # -- helpers -------------------------------------------------------------

@@ -1,0 +1,443 @@
+"""Direct-provider (server-patch) path tests.
+
+Mirrors the reference's direct-path e2e suite (`test/e2e/run.sh`,
+SURVEY.md §4.3): pair creation, requester deletion leaves a sleeping twin,
+twin reuse ("Successful re-use"), sleeper-limit LRU eviction, provider
+deletion relay — plus unit tests of the patch/merge/hash machinery
+(pkg/controller/dual-pods/inference-server.go:1842-1946,
+pkg/controller/utils/pod-helper.go:85-140).
+"""
+
+import json
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.directpath import (
+    DIRECT_PROVIDER_COMPONENT,
+    NOMINAL_HASH_ANNOTATION,
+    ProviderData,
+    de_individualize,
+    engine_port_of,
+    nominal_provider_pod,
+    render_server_patch,
+    strategic_merge,
+)
+
+from dualpods_harness import Harness, run_scenario
+
+PATCH = json.dumps(
+    {
+        "spec": {
+            "containers": [
+                {
+                    "name": C.INFERENCE_SERVER_CONTAINER_NAME,
+                    "image": "tpu-engine:latest",
+                    "args": ["--model", "llama-3-8b", "--node", "{{.NodeName}}"],
+                }
+            ]
+        }
+    }
+)
+
+
+# ------------------------------------------------------------- pure functions
+
+
+def test_render_server_patch_substitutes_fields():
+    doc = render_server_patch(PATCH, ProviderData(node_name="worker-7"))
+    assert doc["spec"]["containers"][0]["args"][-1] == "worker-7"
+
+
+def test_render_server_patch_unknown_field_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown field"):
+        render_server_patch('{"x": "{{.Nope}}"}', ProviderData(node_name="n"))
+
+
+def test_strategic_merge_containers_by_name():
+    base = {
+        "containers": [
+            {"name": "a", "image": "old", "env": [{"name": "K", "value": "1"}]},
+            {"name": "b", "image": "keep"},
+        ]
+    }
+    patch = {
+        "containers": [
+            {"name": "a", "image": "new", "env": [{"name": "K2", "value": "2"}]},
+            {"name": "c", "image": "added"},
+        ]
+    }
+    out = strategic_merge(base, patch)
+    by_name = {c["name"]: c for c in out["containers"]}
+    assert by_name["a"]["image"] == "new"
+    # env merged by name, not replaced
+    assert {e["name"] for e in by_name["a"]["env"]} == {"K", "K2"}
+    assert by_name["b"]["image"] == "keep"
+    assert "c" in by_name
+
+
+def test_strategic_merge_delete_directive_and_null():
+    base = {"containers": [{"name": "a"}, {"name": "b"}], "hostNetwork": True}
+    patch = {
+        "containers": [{"name": "b", "$patch": "delete"}],
+        "hostNetwork": None,
+    }
+    out = strategic_merge(base, patch)
+    assert [c["name"] for c in out["containers"]] == ["a"]
+    assert "hostNetwork" not in out
+
+
+def test_de_individualize_strips_api_access_and_ephemerals():
+    pod = {
+        "spec": {
+            "nodeName": "n1",
+            "ephemeralContainers": [{"name": "debug"}],
+            "volumes": [{"name": "kube-api-access-xyz"}, {"name": "data"}],
+            "containers": [
+                {
+                    "name": "c",
+                    "volumeMounts": [
+                        {"name": "kube-api-access-xyz", "mountPath": "/var/run"},
+                        {"name": "data", "mountPath": "/data"},
+                    ],
+                }
+            ],
+        }
+    }
+    spec = de_individualize(pod)
+    assert "ephemeralContainers" not in spec
+    assert "nodeName" not in spec
+    assert [v["name"] for v in spec["volumes"]] == ["data"]
+    assert [m["name"] for m in spec["containers"][0]["volumeMounts"]] == ["data"]
+
+
+def test_engine_port_from_readiness_probe():
+    spec = {
+        "containers": [
+            {
+                "name": C.INFERENCE_SERVER_CONTAINER_NAME,
+                "readinessProbe": {"httpGet": {"port": 9009}},
+            }
+        ]
+    }
+    assert engine_port_of(spec) == 9009
+    assert engine_port_of({"containers": []}) == 8000
+
+
+def test_nominal_pod_injects_tpu_env_and_zeroes_resources():
+    req = {
+        "metadata": {"name": "r", "labels": {"app": "x"}},
+        "spec": {
+            "nodeName": "n1",
+            "containers": [
+                {
+                    "name": C.INFERENCE_SERVER_CONTAINER_NAME,
+                    "resources": {"limits": {C.TPU_RESOURCE: "2"}},
+                    "readinessProbe": {"httpGet": {"port": 8000}},
+                }
+            ],
+        },
+    }
+    patch = render_server_patch(PATCH, ProviderData(node_name="n1"))
+    pod = nominal_provider_pod(req, patch, "n1", ["chip-1", "chip-0"], None)
+    c = pod["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in c["env"]}
+    # sorted-rank fallback: chip-0 -> 0, chip-1 -> 1, request order preserved
+    assert env[C.TPU_VISIBLE_DEVICES_ENV] == "1,0"
+    assert env[C.TPU_PROCESS_BOUNDS_ENV] == "1,1,2"
+    assert c["resources"]["limits"][C.TPU_RESOURCE] == "0"
+    assert pod["spec"]["nodeSelector"]["kubernetes.io/hostname"] == "n1"
+    assert pod["metadata"]["labels"][C.COMPONENT_LABEL] == DIRECT_PROVIDER_COMPONENT
+    assert NOMINAL_HASH_ANNOTATION in pod["metadata"]["annotations"]
+
+
+def test_nominal_hash_deterministic_and_node_sensitive():
+    req = {
+        "metadata": {"name": "r"},
+        "spec": {
+            "nodeName": "n1",
+            "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
+        },
+    }
+    patch = render_server_patch(PATCH, ProviderData(node_name="n1"))
+    h1 = nominal_provider_pod(req, patch, "n1", ["c0"], None)["metadata"][
+        "annotations"
+    ][NOMINAL_HASH_ANNOTATION]
+    h2 = nominal_provider_pod(req, patch, "n1", ["c0"], None)["metadata"][
+        "annotations"
+    ][NOMINAL_HASH_ANNOTATION]
+    h3 = nominal_provider_pod(req, patch, "n2", ["c0"], None)["metadata"][
+        "annotations"
+    ][NOMINAL_HASH_ANNOTATION]
+    assert h1 == h2 != h3
+
+
+# ------------------------------------------------------------ controller flow
+
+
+def test_direct_pair_creation():
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        provs = h.direct_provider_pods()
+        assert len(provs) == 1
+        p = provs[0]
+        ann = p["metadata"]["annotations"]
+        assert ann[C.REQUESTER_ANNOTATION].startswith("req1/")
+        assert p["metadata"]["labels"][C.DUAL_LABEL] == "req1"
+        env = {
+            e["name"]: e["value"]
+            for e in p["spec"]["containers"][0]["env"]
+        }
+        assert env[C.TPU_VISIBLE_DEVICES_ENV] == "0"
+        assert h.spis["req1"].ready, "readiness must be relayed"
+        req = h.store.get("Pod", h.ns, "req1")
+        assert req["metadata"]["labels"][C.DUAL_LABEL] == p["metadata"]["name"]
+
+    run_scenario(h, body)
+
+
+def test_requester_deletion_leaves_sleeping_twin():
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        prov = h.direct_provider_pods()[0]
+        h.store.delete("Pod", h.ns, "req1")
+        await h.settle()
+        assert h.store.try_get("Pod", h.ns, "req1") is None
+        twin = h.store.get("Pod", h.ns, prov["metadata"]["name"])
+        assert twin["metadata"]["labels"][C.SLEEPING_LABEL] == "true"
+        assert C.REQUESTER_ANNOTATION not in twin["metadata"]["annotations"]
+        assert h.direct_engines[prov["metadata"]["name"]].sleeping
+
+    run_scenario(h, body)
+
+
+def test_sleeping_twin_reuse_wakes_without_new_pod():
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        prov_name = h.direct_provider_pods()[0]["metadata"]["name"]
+        h.store.delete("Pod", h.ns, "req1")
+        await h.settle()
+        engine = h.direct_engines[prov_name]
+        assert engine.sleeping
+
+        h.add_direct_requester("req2", PATCH, chips=["chip-0"])
+        await h.settle()
+        provs = h.direct_provider_pods()
+        assert len(provs) == 1, "twin must be reused, not a new pod"
+        assert provs[0]["metadata"]["name"] == prov_name
+        assert provs[0]["metadata"]["annotations"][C.REQUESTER_ANNOTATION].startswith(
+            "req2/"
+        )
+        assert not engine.sleeping and engine.wake_calls == 1
+        assert h.spis["req2"].ready
+
+    run_scenario(h, body)
+
+
+def test_different_patch_gets_new_provider():
+    h = Harness()
+    other = PATCH.replace("llama-3-8b", "qwen-0.5b")
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        h.store.delete("Pod", h.ns, "req1")
+        await h.settle()
+        h.add_direct_requester("req2", other, chips=["chip-1"])
+        await h.settle()
+        provs = h.direct_provider_pods()
+        assert len(provs) == 2
+        bound = [
+            p
+            for p in provs
+            if (p["metadata"]["annotations"]).get(C.REQUESTER_ANNOTATION, "").startswith("req2/")
+        ]
+        assert len(bound) == 1
+
+    run_scenario(h, body)
+
+
+def test_sleeper_budget_lru_eviction():
+    h = Harness(sleeper_limit=1)
+    other = PATCH.replace("llama-3-8b", "qwen-0.5b")
+
+    async def body():
+        # sleeper #1 on chip-0
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        first = h.direct_provider_pods()[0]["metadata"]["name"]
+        h.store.delete("Pod", h.ns, "req1")
+        await h.settle()
+
+        # a different config on the same chip: budget (1) forces eviction
+        h.add_direct_requester("req2", other, chips=["chip-0"])
+        await h.settle()
+        provs = h.direct_provider_pods()
+        names = [p["metadata"]["name"] for p in provs]
+        assert first not in names, "LRU sleeper must be evicted"
+        assert len(provs) == 1
+
+    run_scenario(h, body)
+
+
+def test_sleeper_budget_respects_limit_two():
+    h = Harness(sleeper_limit=2)
+    other = PATCH.replace("llama-3-8b", "qwen-0.5b")
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        first = h.direct_provider_pods()[0]["metadata"]["name"]
+        h.store.delete("Pod", h.ns, "req1")
+        await h.settle()
+
+        h.add_direct_requester("req2", other, chips=["chip-0"])
+        await h.settle()
+        names = [p["metadata"]["name"] for p in h.direct_provider_pods()]
+        assert first in names, "limit 2 keeps one sleeper + one new provider"
+        assert len(names) == 2
+
+    run_scenario(h, body)
+
+
+def test_direct_provider_deletion_relays_to_requester():
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        prov = h.direct_provider_pods()[0]
+        h.store.delete("Pod", h.ns, prov["metadata"]["name"])
+        await h.settle()
+        assert h.store.try_get("Pod", h.ns, "req1") is None
+        assert h.store.try_get("Pod", h.ns, prov["metadata"]["name"]) is None
+
+    run_scenario(h, body)
+
+
+def test_mutually_exclusive_annotations_rejected():
+    h = Harness()
+
+    async def body():
+        pod = h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        h.store.mutate(
+            "Pod",
+            h.ns,
+            "req1",
+            lambda p: (
+                p["metadata"]["annotations"].update(
+                    {C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc1"}
+                )
+                or p
+            ),
+        )
+        await h.settle()
+        req = h.store.get("Pod", h.ns, "req1")
+        status = json.loads(req["metadata"]["annotations"][C.STATUS_ANNOTATION])
+        assert any("mutually exclusive" in e for e in status["Errors"])
+        assert not h.direct_provider_pods()
+
+    run_scenario(h, body)
+
+
+def test_chip_map_drives_visible_devices():
+    h = Harness()
+
+    async def body():
+        h.store.create(
+            {
+                "kind": "ConfigMap",
+                "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": h.ns},
+                "data": {
+                    "n1": "topology: 2x2\n0 chip-a 0,0\n1 chip-b 1,0\n2 chip-c 0,1\n3 chip-d 1,1\n"
+                },
+            }
+        )
+        h.add_direct_requester("req1", PATCH, chips=["chip-d", "chip-b"])
+        await h.settle()
+        p = h.direct_provider_pods()[0]
+        env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
+        assert env[C.TPU_VISIBLE_DEVICES_ENV] == "3,1"
+
+    run_scenario(h, body)
+
+
+def test_patch_edit_while_bound_keeps_committed_port():
+    """The committed binding is authoritative: editing the server-patch (and
+    thus the engine port) while bound must not wedge the reconcile loop."""
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"], port=8000)
+        await h.settle()
+        prov = h.direct_provider_pods()[0]
+        assert prov["metadata"]["annotations"][C.SERVER_PORT_ANNOTATION] == "8000"
+
+        def bump_port(p):
+            new_patch = json.loads(PATCH)
+            new_patch["spec"]["containers"][0]["readinessProbe"] = {
+                "httpGet": {"port": 9009}
+            }
+            p["metadata"]["annotations"][C.SERVER_PATCH_ANNOTATION] = json.dumps(new_patch)
+            return p
+
+        h.store.mutate("Pod", h.ns, "req1", bump_port)
+        await h.settle()
+        # still bound, still driven at the committed port, still ready
+        sd = next(iter(h.controller.server_data.values()))
+        assert sd.server_port == 8000
+        assert h.spis["req1"].ready
+
+    run_scenario(h, body)
+
+
+def test_unparsable_patch_surfaces_status_error():
+    h = Harness()
+
+    async def body():
+        h.add_direct_requester("req1", "{foo: [", chips=["chip-0"])
+        await h.settle()
+        req = h.store.get("Pod", h.ns, "req1")
+        status = json.loads(req["metadata"]["annotations"][C.STATUS_ANNOTATION])
+        assert any("server-patch" in e for e in status["Errors"])
+        assert not h.direct_provider_pods()
+
+    run_scenario(h, body)
+
+
+def test_annotation_switch_unbinds_mismatched_provider():
+    """Switching a requester from server-patch to inference-server-config
+    while bound must unbind the direct provider, not drive it as a launcher."""
+    h = Harness()
+
+    async def body():
+        h.add_lc("lc1")
+        h.add_isc("isc1", "lc1")
+        h.add_direct_requester("req1", PATCH, chips=["chip-0"])
+        await h.settle()
+        direct = h.direct_provider_pods()[0]
+
+        def switch(p):
+            ann = p["metadata"]["annotations"]
+            del ann[C.SERVER_PATCH_ANNOTATION]
+            ann[C.INFERENCE_SERVER_CONFIG_ANNOTATION] = "isc1"
+            return p
+
+        h.store.mutate("Pod", h.ns, "req1", switch)
+        await h.settle()
+        twin = h.store.get("Pod", h.ns, direct["metadata"]["name"])
+        assert C.REQUESTER_ANNOTATION not in twin["metadata"]["annotations"]
+        assert twin["metadata"]["labels"][C.SLEEPING_LABEL] == "true"
+        # and the launcher path took over
+        assert len(h.launcher_pods()) == 1
+
+    run_scenario(h, body)
