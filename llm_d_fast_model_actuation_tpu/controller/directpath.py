@@ -171,14 +171,22 @@ def de_individualize(pod: Dict[str, Any]) -> Dict[str, Any]:
 
 def engine_port_of(pod_spec: Dict[str, Any]) -> int:
     """Engine port = the inference-server container's readiness-probe HTTP
-    port (pod-helper.go:112-140); falls back to its first containerPort."""
+    port (pod-helper.go:112-140). The probe port is a kube IntOrString: an
+    int, a numeric string, or a named port resolved against the container's
+    ports list; falls back to the first containerPort."""
     for c in pod_spec.get("containers", []):
         if c.get("name") != C.INFERENCE_SERVER_CONTAINER_NAME:
             continue
+        ports = c.get("ports") or []
         probe = ((c.get("readinessProbe") or {}).get("httpGet") or {}).get("port")
         if isinstance(probe, int):
             return probe
-        ports = c.get("ports") or []
+        if isinstance(probe, str):
+            if probe.isdigit():
+                return int(probe)
+            for p in ports:  # named port
+                if p.get("name") == probe and isinstance(p.get("containerPort"), int):
+                    return p["containerPort"]
         if ports and isinstance(ports[0].get("containerPort"), int):
             return ports[0]["containerPort"]
     return 8000
@@ -187,15 +195,22 @@ def engine_port_of(pod_spec: Dict[str, Any]) -> int:
 def chip_indices(
     chip_ids: Sequence[str], node: str, chip_map: Optional[ChipMap]
 ) -> List[int]:
-    """chip IDs -> local indices via the chip map; without a map entry the
-    sorted-rank fallback keeps hardware-less tests deterministic."""
+    """chip IDs -> local indices via the chip map.
+
+    When the node HAS a chip-map entry, an unknown chip ID is a hard error —
+    silently guessing indices would point TPU_VISIBLE_DEVICES at chips the
+    requester does not hold. The sorted-rank fallback applies only when no
+    map entry exists at all (hardware-less tests).
+    """
     if chip_map is not None:
         host = chip_map.host(node)
         if host is not None:
             try:
                 return host.indices_for(chip_ids)
-            except KeyError:
-                pass
+            except KeyError as e:
+                raise ValueError(
+                    f"chip id {e.args[0]!r} not in the chip map for node {node}"
+                ) from e
     ranked = {cid: i for i, cid in enumerate(sorted(set(chip_ids)))}
     return [ranked[cid] for cid in chip_ids]
 
@@ -212,6 +227,9 @@ def nominal_provider_pod(
     The returned Pod has no name/namespace yet; its nominal-hash annotation
     is the identity used for sleeping-twin lookup.
     """
+    # normalize: the SPI may report the same chip set in any order, and the
+    # order must not leak into the rendered spec (and thus the nominal hash)
+    chip_ids = sorted(chip_ids)
     base = de_individualize(req)
     spec = strategic_merge(base, patch.get("spec") or {})
 

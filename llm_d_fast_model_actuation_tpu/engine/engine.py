@@ -39,6 +39,9 @@ class EngineConfig:
     num_pages: int = 2048
     max_seq_len: int = 0  # 0 -> model.max_seq_len
     eos_token_id: int = -1  # -1 = never stop on EOS
+    #: Attention implementation: "reference" (pure XLA) or "pallas"
+    #: (hand-written TPU kernels; interpreter mode off-TPU).
+    attention_impl: str = "reference"
 
     @property
     def seq_len(self) -> int:
@@ -79,7 +82,13 @@ class InferenceEngine:
     ) -> None:
         self.cfg = cfg
         self.mesh = mesh
+        # thread the attention impl through the model config (per-engine, not
+        # a process global — two engines must not clobber each other)
         m = cfg.model
+        if m.attention_impl != cfg.attention_impl:
+            import dataclasses
+
+            m = dataclasses.replace(m, attention_impl=cfg.attention_impl)
         if params is None:
             params = llama.init_params(jax.random.key(seed), m)
         if mesh is not None:

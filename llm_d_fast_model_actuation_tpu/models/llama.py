@@ -41,6 +41,9 @@ class LlamaConfig:
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    #: Attention implementation ("reference" | "pallas"); per-model so two
+    #: engines in one process can't clobber each other's choice.
+    attention_impl: str = "reference"
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -228,7 +231,7 @@ def prefill(
         q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
         kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
         vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
-        attn = causal_prefill_attention(q, k, v, seq_lens)
+        attn = causal_prefill_attention(q, k, v, seq_lens, impl=cfg.attention_impl)
         x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -272,7 +275,9 @@ def decode_step(
         q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [b, heads/kvh, hd]
         kp = _scatter_decode(kp, k, page_table, positions, page_size)
         vp = _scatter_decode(vp, v, page_table, positions, page_size)
-        attn = paged_decode_attention(q, kp, vp, page_table, seq_lens)
+        attn = paged_decode_attention(
+            q, kp, vp, page_table, seq_lens, impl=cfg.attention_impl
+        )
         x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])

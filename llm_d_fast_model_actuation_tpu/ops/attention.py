@@ -18,9 +18,29 @@ All softmax math is fp32 regardless of the io dtype.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+#: Selected implementation: "reference" (pure XLA) or "pallas" (TPU kernels,
+#: interpreter mode off-TPU). Read at trace time — switch before (re-)jitting.
+_IMPL = "reference"
+
+
+def set_attention_impl(impl: str) -> None:
+    global _IMPL
+    if impl not in ("reference", "pallas"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    _IMPL = impl
+
+
+def get_attention_impl() -> str:
+    return _IMPL
+
+
+def _pallas_interpret() -> bool:
+    return jax.default_backend() != "tpu"
 
 
 def _repeat_kv(x: jnp.ndarray, n_rep: int, axis: int) -> jnp.ndarray:
@@ -35,8 +55,18 @@ def causal_prefill_attention(
     k: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
     v: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
     seq_lens: jnp.ndarray,  # [batch] int32: valid prefix length per row
+    impl: "str | None" = None,  # None -> module default
 ) -> jnp.ndarray:
     """Causal self-attention over a (right-padded) prefill batch."""
+    if (impl or _IMPL) == "pallas":
+        from .pallas import causal_prefill_attention_pallas
+
+        s = q.shape[1]
+        block_q = next((bq for bq in (128, 64, 32, 16, 8) if s % bq == 0), None)
+        if block_q is not None:
+            return causal_prefill_attention_pallas(
+                q, k, v, seq_lens, block_q=block_q, interpret=_pallas_interpret()
+            )
     b, s, h, d = q.shape
     kvh = k.shape[2]
     k = _repeat_kv(k, h // kvh, axis=2)
@@ -63,6 +93,7 @@ def paged_decode_attention(
     v_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
     page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
     seq_lens: jnp.ndarray,  # [batch] int32 (length INCLUDING the new token)
+    impl: "str | None" = None,  # None -> module default
 ) -> jnp.ndarray:
     """One decode step of attention against the paged cache.
 
@@ -71,6 +102,12 @@ def paged_decode_attention(
     pages_per_seq * page_size is static, so the whole step is one fused
     region under jit — no dynamic shapes.
     """
+    if (impl or _IMPL) == "pallas":
+        from .pallas import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, page_table, seq_lens, interpret=_pallas_interpret()
+        )
     b, h, d = q.shape
     pages_per_seq = page_table.shape[1]
     page_size = k_pages.shape[1]

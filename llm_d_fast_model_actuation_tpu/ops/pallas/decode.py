@@ -1,0 +1,182 @@
+"""Paged decode attention as a Pallas TPU kernel.
+
+One decode step against the paged KV cache. The XLA reference in
+`ops/attention.py` gathers ALL `pages_per_seq` pages for every sequence and
+materializes GQA-repeated K/V — O(batch * ctx_max) HBM traffic regardless of
+actual sequence lengths. This kernel reads only the pages each sequence
+actually occupies (`ceil(seq_len / page_size)` of them), double-buffering the
+HBM->VMEM page DMA behind the per-page flash-attention accumulation, and
+never materializes repeated KV heads. Decode is HBM-bandwidth-bound, so
+bytes-not-read is time-not-spent.
+
+Layout contract (shared with the engine's KV pool):
+  k_pages, v_pages: [num_pages, page_size, kv_heads, head_dim]  (HBM)
+  page_table:       [batch, pages_per_seq] int32  (scalar-prefetched)
+  seq_lens:         [batch] int32, length INCLUDING the new token
+  q:                [batch, heads, head_dim]
+
+For best MXU/VPU utilization pick page_size a multiple of 128 on real TPU
+(the engine's `page_size` knob); smaller pages still work, padded to lanes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [batch, pages_per_seq] SMEM
+    seq_lens_ref,  # [batch] SMEM
+    # inputs
+    q_ref,  # [1, heads, head_dim] VMEM
+    k_hbm,  # [num_pages, page_size, kv_heads, head_dim] HBM/ANY
+    v_hbm,  # same
+    # output
+    o_ref,  # [1, heads, head_dim] VMEM
+    # scratch
+    k_buf,  # [2, page_size, kv_heads, head_dim] VMEM
+    v_buf,  # same
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    group = num_heads // num_kv_heads
+    seq_len = seq_lens_ref[b]
+    num_pages = jax.lax.div(seq_len + page_size - 1, page_size)
+
+    def page_dma(buf, hbm, slot, p, sem_row):
+        return pltpu.make_async_copy(
+            hbm.at[page_table_ref[b, p]],
+            buf.at[slot],
+            sems.at[sem_row, slot],
+        )
+
+    @pl.when(num_pages > 0)
+    def _():
+        page_dma(k_buf, k_hbm, 0, 0, 0).start()
+        page_dma(v_buf, v_hbm, 0, 0, 1).start()
+
+    q = q_ref[0].astype(jnp.float32) * (head_dim**-0.5)  # [heads, head_dim]
+
+    # Online-softmax state is carried per KV head (tuples over the static
+    # kv-head axis) — in-kernel scatter is not lowerable on TPU, whole-array
+    # replacement is.
+    def body(p, carry):
+        ms, ls, accs = carry  # tuples of [group,1], [group,1], [group,d]
+        slot = jax.lax.rem(p, 2)
+
+        @pl.when(p + 1 < num_pages)
+        def _():
+            nxt = jax.lax.rem(p + 1, 2)
+            page_dma(k_buf, k_hbm, nxt, p + 1, 0).start()
+            page_dma(v_buf, v_hbm, nxt, p + 1, 1).start()
+
+        page_dma(k_buf, k_hbm, slot, p, 0).wait()
+        page_dma(v_buf, v_hbm, slot, p, 1).wait()
+
+        # tokens beyond seq_len in the (last) page are masked out
+        tok0 = p * page_size
+        tok_idx = tok0 + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = tok_idx < seq_len  # [1, page_size]
+
+        new_ms, new_ls, new_accs = [], [], []
+        for g in range(num_kv_heads):
+            qg = q[g * group : (g + 1) * group]  # [group, head_dim]
+            kg = k_buf[slot, :, g, :].astype(jnp.float32)  # [page, head_dim]
+            vg = v_buf[slot, :, g, :].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                qg,
+                kg,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [group, page_size]
+            logits = jnp.where(valid, logits, NEG_INF)
+
+            m_cur = jnp.maximum(ms[g], logits.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(ms[g] - m_cur)
+            probs = jnp.exp(logits - m_cur)
+            l_cur = ls[g] * alpha + probs.sum(axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                probs,
+                vg,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [group, head_dim]
+            new_ms.append(m_cur)
+            new_ls.append(l_cur)
+            new_accs.append(accs[g] * alpha + pv)
+        return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+    m0 = tuple(jnp.full((group, 1), NEG_INF, jnp.float32) for _ in range(num_kv_heads))
+    l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(num_kv_heads))
+    acc0 = tuple(
+        jnp.zeros((group, head_dim), jnp.float32) for _ in range(num_kv_heads)
+    )
+    ms, ls, accs = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+
+    l = jnp.concatenate(ls, axis=0)  # [heads, 1]
+    acc = jnp.concatenate(accs, axis=0)  # [heads, head_dim]
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # [batch, heads, head_dim]
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
+    seq_lens: jnp.ndarray,  # [batch] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    batch, num_heads, head_dim = q.shape
+    _, page_size, num_kv_heads, _ = k_pages.shape
+
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        head_dim=head_dim,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec(
+                (1, num_heads, head_dim),
+                lambda b, *_: (b, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, num_heads, head_dim),
+            lambda b, *_: (b, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, num_kv_heads, head_dim), k_pages.dtype),
+            pltpu.VMEM((2, page_size, num_kv_heads, head_dim), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), q, k_pages, v_pages)

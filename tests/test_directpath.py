@@ -142,8 +142,9 @@ def test_nominal_pod_injects_tpu_env_and_zeroes_resources():
     pod = nominal_provider_pod(req, patch, "n1", ["chip-1", "chip-0"], None)
     c = pod["spec"]["containers"][0]
     env = {e["name"]: e["value"] for e in c["env"]}
-    # sorted-rank fallback: chip-0 -> 0, chip-1 -> 1, request order preserved
-    assert env[C.TPU_VISIBLE_DEVICES_ENV] == "1,0"
+    # chip set is normalized to sorted order (SPI report order must not leak
+    # into the spec/hash): chip-0 -> 0, chip-1 -> 1
+    assert env[C.TPU_VISIBLE_DEVICES_ENV] == "0,1"
     assert env[C.TPU_PROCESS_BOUNDS_ENV] == "1,1,2"
     assert c["resources"]["limits"][C.TPU_RESOURCE] == "0"
     assert pod["spec"]["nodeSelector"]["kubernetes.io/hostname"] == "n1"
@@ -366,7 +367,8 @@ def test_chip_map_drives_visible_devices():
         await h.settle()
         p = h.direct_provider_pods()[0]
         env = {e["name"]: e["value"] for e in p["spec"]["containers"][0]["env"]}
-        assert env[C.TPU_VISIBLE_DEVICES_ENV] == "3,1"
+        # chips normalized to sorted order (chip-b, chip-d) -> map indices 1, 3
+        assert env[C.TPU_VISIBLE_DEVICES_ENV] == "1,3"
 
     run_scenario(h, body)
 
@@ -441,3 +443,70 @@ def test_annotation_switch_unbinds_mismatched_provider():
         assert len(h.launcher_pods()) == 1
 
     run_scenario(h, body)
+
+
+def test_chip_order_does_not_change_nominal_hash():
+    """Two requesters holding the same chip set in different SPI report order
+    must produce the same nominal hash (twin reuse depends on it)."""
+    from llm_d_fast_model_actuation_tpu.controller.directpath import (
+        nominal_provider_pod as npp,
+    )
+
+    req = {
+        "metadata": {"name": "r"},
+        "spec": {
+            "nodeName": "n1",
+            "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
+        },
+    }
+    patch = render_server_patch(PATCH, ProviderData(node_name="n1"))
+    h1 = npp(req, patch, "n1", ["c0", "c1"], None)["metadata"]["annotations"][
+        NOMINAL_HASH_ANNOTATION
+    ]
+    h2 = npp(req, patch, "n1", ["c1", "c0"], None)["metadata"]["annotations"][
+        NOMINAL_HASH_ANNOTATION
+    ]
+    assert h1 == h2
+
+
+def test_unknown_chip_in_map_surfaces_status_error():
+    """A chip the SPI reports that is missing from the node's chip map must
+    fail loudly, not fall back to guessed indices."""
+    h = Harness()
+
+    async def body():
+        h.store.create(
+            {
+                "kind": "ConfigMap",
+                "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": h.ns},
+                "data": {"n1": "topology: 1x2\n0 chip-a 0,0\n1 chip-b 1,0\n"},
+            }
+        )
+        h.add_direct_requester("req1", PATCH, chips=["chip-zz"])
+        await h.settle()
+        req = h.store.get("Pod", h.ns, "req1")
+        status = json.loads(req["metadata"]["annotations"][C.STATUS_ANNOTATION])
+        assert any("chip-zz" in e for e in status["Errors"])
+        assert not h.direct_provider_pods()
+
+    run_scenario(h, body)
+
+
+def test_engine_port_int_or_string():
+    spec = {
+        "containers": [
+            {
+                "name": C.INFERENCE_SERVER_CONTAINER_NAME,
+                "ports": [
+                    {"name": "metrics", "containerPort": 9090},
+                    {"name": "serve", "containerPort": 8000},
+                ],
+                "readinessProbe": {"httpGet": {"port": "serve"}},
+            }
+        ]
+    }
+    assert engine_port_of(spec) == 8000
+    spec["containers"][0]["readinessProbe"]["httpGet"]["port"] = "9009"
+    assert engine_port_of(spec) == 9009
+    del spec["containers"][0]["readinessProbe"]
+    assert engine_port_of(spec) == 9090  # first containerPort fallback
