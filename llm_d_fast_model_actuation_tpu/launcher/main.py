@@ -51,6 +51,13 @@ def main(argv=None) -> None:
         "--compile-cache-dir", default="/tmp/fma-tpu-xla-cache"
     )
     p.add_argument("--no-preload", action="store_true")
+    p.add_argument(
+        "--notify-pod",
+        action="store_true",
+        help="run the state-change reflector in-process (instead of the "
+        "notifier sidecar): patch the launcher Pod's instance-signature "
+        "annotation on every instance state change (needs POD_NAME/NAMESPACE)",
+    )
     args = p.parse_args(argv)
 
     logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
@@ -69,6 +76,40 @@ def main(argv=None) -> None:
     )
     manager = EngineProcessManager(translator, log_dir=args.log_dir)
     app = build_app(manager)
+
+    if args.notify_pod:
+        import asyncio
+
+        from .notifier import InstanceStateNotifier, kubectl_patcher
+
+        pod_name = os.environ.get("POD_NAME", "")
+        namespace = os.environ.get("NAMESPACE", "")
+        if not pod_name or not namespace:
+            p.error("--notify-pod needs POD_NAME and NAMESPACE env (Downward API)")
+
+        async def lister():
+            return manager.get_all_instances_status().get("instances", [])
+
+        async def watcher(since_revision: int):
+            # cursor = since_revision (tracked by the notifier), so events
+            # published between connect and first read are replayed
+            return manager.broadcaster.subscribe(since_revision=since_revision)
+
+        notifier = InstanceStateNotifier(
+            lister, kubectl_patcher(pod_name, namespace), watcher=watcher
+        )
+
+        async def start_notifier(app):
+            app["notifier_task"] = asyncio.get_running_loop().create_task(
+                notifier.run()
+            )
+
+        async def stop_notifier(app):
+            notifier.stop()
+            app["notifier_task"].cancel()
+
+        app.on_startup.append(start_notifier)
+        app.on_cleanup.append(stop_notifier)
     logger.info(
         "launcher serving on %s:%s (%s chips, mode %s)",
         args.host,

@@ -1,0 +1,243 @@
+"""State-change reflector: instance state -> launcher Pod annotation.
+
+A crash inside a launcher is node-local; the controller watches the kube
+API, not launcher internals. The reflector closes that gap by stamping a
+signature of the launcher's instance set onto the launcher Pod's
+`vllm-instance-signature` annotation — any instance-state change becomes a
+Pod-update event the controller's informer sees (reference sidecar:
+inference_server/launcher/launcher_pod_notifier.py:16-198).
+
+TPU-first delta: the reference polls `/v2/vllm/instances` every 2 s. Here
+the reflector consumes the launcher's revisioned NDJSON watch stream, so a
+crash is reflected within one event round-trip with zero idle polling; a
+broken stream degrades to periodic polling until the launcher returns.
+
+Ordering invariant (no lost-update window): the watch stream is CONNECTED
+before each list+patch, so every state change is either (a) already visible
+to the list, or (b) delivered as an event after the connection — there is no
+gap in which a change can slip through unreflected.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import logging
+import shutil
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
+
+from ..api.constants import INSTANCE_SIGNATURE_ANNOTATION as SIGNATURE_ANNOTATION
+
+logger = logging.getLogger(__name__)
+
+#: `watcher(since_revision)` -> a CONNECTED async iterator of watch events.
+#: Connection (or revision-cursor capture) must be effective at return time.
+WatcherFactory = Callable[[int], Awaitable[AsyncIterator[Any]]]
+
+
+def instance_signature(states: List[Dict[str, Any]]) -> str:
+    """SHA-256 over the sorted (instance_id, status) pairs
+    (launcher_pod_notifier.py's signature, kept byte-compatible in spirit)."""
+    pairs = sorted((s.get("instance_id", ""), s.get("status", "")) for s in states)
+    return hashlib.sha256(json.dumps(pairs).encode()).hexdigest()
+
+
+class InstanceStateNotifier:
+    """Watches a launcher and patches the signature on change.
+
+    `lister` returns the launcher's instance states; `watcher` (optional,
+    see :data:`WatcherFactory`) yields watch events — used only as change
+    triggers, the list is always the source of truth; `patch` applies the
+    new signature to the launcher Pod (kube patch in production, a store
+    mutate in tests).
+    """
+
+    def __init__(
+        self,
+        lister: Callable[[], Awaitable[List[Dict[str, Any]]]],
+        patch: Callable[[str], Awaitable[None]],
+        watcher: Optional[WatcherFactory] = None,
+        poll_interval_s: float = 2.0,
+    ) -> None:
+        self._lister = lister
+        self._patch = patch
+        self._watcher = watcher
+        self._poll_interval_s = poll_interval_s
+        self._last_signature: Optional[str] = None
+        self._last_revision = 0
+        self._stopping = False
+
+    async def reflect_once(self) -> Optional[str]:
+        """List, compute, patch-if-changed. Returns the new signature when a
+        patch was made, else None."""
+        states = await self._lister()
+        sig = instance_signature(states)
+        if sig == self._last_signature:
+            return None
+        await self._patch(sig)
+        self._last_signature = sig
+        logger.info("instance signature -> %s (%d instances)", sig[:12], len(states))
+        return sig
+
+    async def run(self) -> None:
+        """Event loop. Each cycle: connect the watch stream FIRST, then
+        reflect (so nothing slips between list and subscribe), then reflect
+        again on every event. Falls back to polling without a watcher."""
+        while not self._stopping:
+            stream: Optional[AsyncIterator[Any]] = None
+            if self._watcher is not None:
+                try:
+                    stream = await self._watcher(self._last_revision)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    logger.warning("watch connect failed (%s); polling", e)
+
+            await self._reflect_guarded()
+
+            if stream is None:
+                await asyncio.sleep(self._poll_interval_s)
+                continue
+            try:
+                async for event in stream:
+                    rev = (event.get("object") or {}).get("revision") if isinstance(
+                        event, dict
+                    ) else None
+                    if isinstance(rev, int):
+                        self._last_revision = max(self._last_revision, rev)
+                    await self._reflect_guarded()
+                    if self._stopping:
+                        break
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.warning("watch stream broke (%s); resyncing", e)
+                await asyncio.sleep(min(self._poll_interval_s, 1.0))
+
+    async def _reflect_guarded(self) -> None:
+        try:
+            await self.reflect_once()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.warning("reflect failed: %s", e)
+
+    def stop(self) -> None:
+        self._stopping = True
+
+
+# ---------------------------------------------------------------- sidecar glue
+
+
+class HttpSource:
+    """Launcher REST access for the sidecar: one shared ClientSession for the
+    lifetime of the notifier (not one per call)."""
+
+    def __init__(self, base_url: str) -> None:
+        self._base = base_url.rstrip("/")
+        self._session = None  # type: ignore[assignment]
+
+    async def _ensure_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=None, sock_read=None)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def lister(self) -> List[Dict[str, Any]]:
+        session = await self._ensure_session()
+        async with session.get(f"{self._base}/v2/vllm/instances") as resp:
+            resp.raise_for_status()
+            body = await resp.json()
+        return body.get("instances", [])
+
+    async def watcher(self, since_revision: int) -> AsyncIterator[Any]:
+        """Connect the watch stream before returning (see the notifier's
+        ordering invariant). A 410 on a resume revision falls back to
+        watching from now — the caller reflects right after we return, which
+        covers everything up to this connection."""
+        session = await self._ensure_session()
+        url = f"{self._base}/v2/vllm/instances/watch"
+        params = {"since": str(since_revision)} if since_revision > 0 else None
+        resp = await session.get(url, params=params)
+        if resp.status == 410:
+            resp.release()
+            resp = await session.get(url)
+        resp.raise_for_status()
+
+        async def gen() -> AsyncIterator[Any]:
+            try:
+                async for line in resp.content:
+                    if line.strip():
+                        yield json.loads(line)
+            finally:
+                resp.release()
+
+        return gen()
+
+
+def kubectl_patcher(pod_name: str, namespace: str):
+    """Annotate the launcher Pod via kubectl (the sidecar has a service
+    account; this avoids requiring a python kube client in the image)."""
+    if shutil.which("kubectl") is None:
+        raise RuntimeError("kubectl not found; provide a custom patcher")
+
+    async def patch(signature: str) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            "kubectl",
+            "annotate",
+            "pod",
+            pod_name,
+            "-n",
+            namespace,
+            f"{SIGNATURE_ANNOTATION}={signature}",
+            "--overwrite",
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        _, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl annotate failed: {err.decode()[:500]}")
+
+    return patch
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    import argparse
+    import os
+
+    parser = argparse.ArgumentParser(description="launcher state-change reflector")
+    parser.add_argument("--launcher-url", default="http://127.0.0.1:8001")
+    parser.add_argument("--pod-name", default=os.environ.get("POD_NAME", ""))
+    parser.add_argument("--namespace", default=os.environ.get("NAMESPACE", ""))
+    parser.add_argument("--poll-interval", type=float, default=2.0)
+    args = parser.parse_args(argv)
+    if not args.pod_name or not args.namespace:
+        parser.error("--pod-name and --namespace (or POD_NAME/NAMESPACE env) required")
+
+    source = HttpSource(args.launcher_url)
+    notifier = InstanceStateNotifier(
+        lister=source.lister,
+        patch=kubectl_patcher(args.pod_name, args.namespace),
+        watcher=source.watcher,
+        poll_interval_s=args.poll_interval,
+    )
+
+    async def run() -> None:
+        try:
+            await notifier.run()
+        finally:
+            await source.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -23,6 +23,7 @@ class EventBroadcaster:
     def __init__(self, max_buffer: int = 1000) -> None:
         self._buf: Deque[Tuple[int, Any]] = deque(maxlen=max_buffer)
         self._cond: asyncio.Condition | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._closed = False
 
     def _condition(self) -> asyncio.Condition:
@@ -30,6 +31,7 @@ class EventBroadcaster:
         # before the event loop starts).
         if self._cond is None:
             self._cond = asyncio.Condition()
+            self._loop = asyncio.get_running_loop()
         return self._cond
 
     @property
@@ -47,19 +49,34 @@ class EventBroadcaster:
             cond.notify_all()
 
     def publish_nowait(self, revision: int, event: Any) -> None:
-        """Publish from synchronous code running on the loop's thread."""
+        """Publish from synchronous code — on the loop's thread OR any other
+        thread (e.g. an executor running a blocking instance stop). Watchers
+        are woken via the loop the condition is bound to."""
         self._buf.append((revision, event))
-        cond = self._cond
-        if cond is not None:
-            try:
-                loop = asyncio.get_running_loop()
-            except RuntimeError:
-                return  # no loop yet: watchers will see it on their next wake
-            async def _notify() -> None:
-                async with cond:
-                    cond.notify_all()
+        cond, loop = self._cond, self._loop
+        if cond is None or loop is None:
+            return  # no watcher loop yet: they'll see it on first subscribe
 
+        async def _notify() -> None:
+            async with cond:
+                cond.notify_all()
+
+        def _schedule() -> None:
             loop.create_task(_notify())
+
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            _schedule()
+        else:
+            try:
+                loop.call_soon_threadsafe(_schedule)
+            except RuntimeError:
+                # bound loop already closed (shutdown): the event stays in the
+                # buffer; there is no watcher loop left to wake
+                pass
 
     async def close(self) -> None:
         cond = self._condition()
@@ -86,8 +103,11 @@ class EventBroadcaster:
                     raise RevisionTooOld(
                         f"revision {cursor} evicted (oldest retained {oldest})"
                     )
-                pending = [e for (rev, e) in self._buf if rev > cursor]
-                newest = self.latest_revision
+                # snapshot first: publish_nowait may append from another
+                # thread while we iterate
+                snapshot = list(self._buf)
+                pending = [e for (rev, e) in snapshot if rev > cursor]
+                newest = snapshot[-1][0] if snapshot else None
                 if not pending:
                     if self._closed:
                         return

@@ -1,0 +1,179 @@
+"""State-change reflector tests (reference launcher_pod_notifier.py).
+
+Covers signature semantics, patch-on-change-only, and the full loop against
+a real launcher REST server: instance created -> crash -> signature patched
+without polling (watch-driven).
+"""
+
+import asyncio
+import json
+import time
+from typing import List
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.launcher.instance import InstanceConfig
+from llm_d_fast_model_actuation_tpu.launcher.manager import EngineProcessManager
+from llm_d_fast_model_actuation_tpu.launcher.notifier import (
+    InstanceStateNotifier,
+    instance_signature,
+)
+
+from test_launcher import _with_client, crashing_kickoff, run_async, translator  # noqa: F401
+
+
+def test_signature_order_insensitive_and_status_sensitive():
+    a = [
+        {"instance_id": "i1", "status": "running"},
+        {"instance_id": "i2", "status": "running"},
+    ]
+    b = list(reversed(a))
+    assert instance_signature(a) == instance_signature(b)
+    c = [
+        {"instance_id": "i1", "status": "stopped"},
+        {"instance_id": "i2", "status": "running"},
+    ]
+    assert instance_signature(a) != instance_signature(c)
+    assert instance_signature([]) != instance_signature(a)
+
+
+def test_reflect_once_patches_only_on_change():
+    states = [[{"instance_id": "x", "status": "running"}]]
+    patches: List[str] = []
+
+    async def lister():
+        return states[0]
+
+    async def patch(sig):
+        patches.append(sig)
+
+    n = InstanceStateNotifier(lister, patch)
+
+    async def scenario():
+        assert await n.reflect_once() is not None
+        assert await n.reflect_once() is None  # unchanged -> no patch
+        states[0] = [{"instance_id": "x", "status": "stopped"}]
+        assert await n.reflect_once() is not None
+
+    run_async(scenario())
+    assert len(patches) == 2
+    assert patches[0] != patches[1]
+
+
+def test_patch_failure_does_not_swallow_the_change():
+    """If the patch fails, the signature is not recorded as applied — the
+    next reflect retries it."""
+    calls = {"n": 0}
+    patches: List[str] = []
+
+    async def lister():
+        return [{"instance_id": "x", "status": "running"}]
+
+    async def patch(sig):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("kube api hiccup")
+        patches.append(sig)
+
+    n = InstanceStateNotifier(lister, patch)
+
+    async def scenario():
+        with pytest.raises(RuntimeError):
+            await n.reflect_once()
+        assert await n.reflect_once() is not None
+
+    run_async(scenario())
+    assert len(patches) == 1
+
+
+def test_watch_driven_reflection_of_crash(translator, tmp_path):  # noqa: F811
+    """End to end against the real REST app: CREATE then crash; the notifier
+    (driven by the watch stream, no polling) patches the signature for each
+    state transition."""
+    manager = EngineProcessManager(
+        translator, log_dir=str(tmp_path), kickoff=crashing_kickoff
+    )
+    patches: List[str] = []
+
+    async def scenario(client):
+        async def lister():
+            resp = await client.get("/v2/vllm/instances")
+            return (await resp.json())["instances"]
+
+        async def watcher(since):
+            params = {"since": str(since)} if since else None
+            resp = await client.get("/v2/vllm/instances/watch", params=params)
+            assert resp.status == 200
+
+            async def gen():
+                async for line in resp.content:
+                    if line.strip():
+                        yield json.loads(line)
+
+            return gen()
+
+        async def patch(sig):
+            patches.append(sig)
+
+        notifier = InstanceStateNotifier(lister, patch, watcher=watcher)
+        task = asyncio.get_running_loop().create_task(notifier.run())
+        try:
+            await asyncio.sleep(0.1)  # initial reflect (empty set)
+            r = await client.put("/v2/vllm/instances/N", json={"options": "x"})
+            assert r.status == 201
+            # a fast crash may coalesce CREATED+STOPPED into one reflect, so
+            # only the final signature is asserted, not the patch count
+            want = instance_signature([{"instance_id": "N", "status": "stopped"}])
+            deadline = time.time() + 10
+            while (not patches or patches[-1] != want) and time.time() < deadline:
+                await asyncio.sleep(0.05)
+        finally:
+            notifier.stop()
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    try:
+        run_async(_with_client(manager, scenario))
+    finally:
+        manager.stop_all_instances(timeout=2)
+
+    assert len(patches) >= 2  # at least: empty set, then the stopped state
+    assert len(set(patches)) == len(patches), "each patch must be a new signature"
+    # final signature reflects the stopped instance
+    assert patches[-1] == instance_signature(
+        [{"instance_id": "N", "status": "stopped"}]
+    )
+
+
+def test_delete_event_reaches_watchers_from_executor_thread(translator, tmp_path):  # noqa: F811
+    """stop_instance runs in an executor (the REST handler keeps the loop
+    live during the blocking SIGTERM/join) — the DELETED event published from
+    that thread must still wake watch streams."""
+    manager = EngineProcessManager(
+        translator, log_dir=str(tmp_path), kickoff=crashing_kickoff
+    )
+
+    async def scenario(client):
+        resp = await client.get("/v2/vllm/instances/watch")
+        r = await client.put("/v2/vllm/instances/D", json={"options": "x"})
+        assert r.status == 201
+        # drain CREATED (+ maybe STOPPED from the crashing kickoff)
+        line = await asyncio.wait_for(resp.content.readline(), timeout=5)
+        assert json.loads(line)["type"] == "CREATED"
+        d = await client.delete("/v2/vllm/instances/D")
+        assert d.status == 200
+        deadline = time.time() + 5
+        saw_deleted = False
+        while time.time() < deadline and not saw_deleted:
+            line = await asyncio.wait_for(resp.content.readline(), timeout=5)
+            if line.strip():
+                saw_deleted = json.loads(line)["type"] == "DELETED"
+        assert saw_deleted
+
+    try:
+        run_async(_with_client(manager, scenario))
+    finally:
+        manager.stop_all_instances(timeout=2)
