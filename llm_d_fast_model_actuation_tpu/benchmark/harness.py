@@ -1,0 +1,166 @@
+"""Benchmark driver over the dual-pods control plane.
+
+`ActuationBenchmark` wraps a simulated cluster (the package fakes with
+injected latencies — reference mode "simulated",
+benchmark_base.py:34-99) and exposes the operations scenarios compose:
+deploy a pair, wait for readiness, scale down, and classify each actuation
+as hot / warm / cold the way the controller's `fma_actuation_seconds`
+path label does (controller.go:265-271).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..api import constants as C
+from ..testing import Harness, SimLatencies
+
+
+@dataclass
+class BenchmarkConfig:
+    mode: str = "simulated"
+    #: Simulated latencies, defaulted to the reference's published envelope:
+    #: ~3 s wake for 64 GiB (README.md:16-26), tens-of-seconds engine cold
+    #: start, scaled down 100x so scenario runs stay fast (scale factor is
+    #: reported, timings multiply back up).
+    time_scale: float = 0.01
+    launcher_start_s: float = 20.0
+    instance_create_s: float = 40.0
+    wake_s: float = 3.0
+    sleep_s: float = 2.0
+    readiness_poll_s: float = 0.01
+
+    def latencies(self) -> SimLatencies:
+        s = self.time_scale
+        return SimLatencies(
+            launcher_start_s=self.launcher_start_s * s,
+            instance_create_s=self.instance_create_s * s,
+            wake_s=self.wake_s * s,
+            sleep_s=self.sleep_s * s,
+        )
+
+
+@dataclass
+class PairResult:
+    name: str
+    t_actuation_s: float
+    path: str  # hot | warm | cold
+
+
+@dataclass
+class ScenarioReport:
+    scenario: str
+    mode: str
+    time_scale: float
+    pairs: List[PairResult] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def summary(self) -> Dict[str, Any]:
+        """The reference's metric vocabulary (benchmark.md:37-46)."""
+        times = [p.t_actuation_s for p in self.pairs]
+        unscaled = [t / self.time_scale for t in times] if self.time_scale else times
+        by_path: Dict[str, int] = {}
+        for p in self.pairs:
+            by_path[p.path] = by_path.get(p.path, 0) + 1
+        n = max(1, len(self.pairs))
+        out = {
+            "scenario": self.scenario,
+            "mode": self.mode,
+            "pairs": len(self.pairs),
+            "T_actuation_s": {
+                "min": min(unscaled, default=0.0),
+                "max": max(unscaled, default=0.0),
+                "avg": statistics.fmean(unscaled) if unscaled else 0.0,
+                "median": statistics.median(unscaled) if unscaled else 0.0,
+            },
+            "Hot_hit_rate": by_path.get("hot", 0) / n,
+            "Warm_hit_rate": by_path.get("warm", 0) / n,
+            "Cold_rate": by_path.get("cold", 0) / n,
+            "paths": by_path,
+        }
+        out.update(self.extra)
+        return out
+
+
+class ActuationBenchmark:
+    """One benchmark session over one simulated cluster."""
+
+    def __init__(self, cfg: Optional[BenchmarkConfig] = None, **harness_kwargs) -> None:
+        self.cfg = cfg or BenchmarkConfig()
+        if self.cfg.mode != "simulated":
+            raise NotImplementedError(
+                f"mode {self.cfg.mode!r}: only 'simulated' runs without a cluster; "
+                "point the controller Transports at a live stack for the rest"
+            )
+        self.harness = Harness(latencies=self.cfg.latencies(), **harness_kwargs)
+        self._counter = 0
+
+    # -- cluster ops ---------------------------------------------------------
+
+    def deploy_config(
+        self, isc_name: str, lc_name: str = "bench-lc", port: int = 8000, options: str = ""
+    ) -> None:
+        h = self.harness
+        if h.store.try_get("LauncherConfig", h.ns, lc_name) is None:
+            h.add_lc(lc_name, max_instances=4)
+        h.add_isc(isc_name, lc_name, port=port, options=options or f"--model {isc_name}")
+
+    async def actuate(
+        self,
+        isc_name: str,
+        node: str = "n1",
+        chips: Optional[List[str]] = None,
+        timeout_s: float = 60.0,
+    ) -> PairResult:
+        """Create a requester and wait until its readiness is relayed —
+        T_actuation as the reference defines it (requester create -> Ready).
+        Raises TimeoutError rather than hanging on a wedged reconcile."""
+        h = self.harness
+        self._counter += 1
+        name = f"req-{isc_name}-{self._counter:06d}"
+        t0 = time.monotonic()
+        h.add_requester(name, isc_name, node=node, chips=chips or ["chip-0"])
+        while not h.spis[name].ready:
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"{name} not ready after {timeout_s}s "
+                    f"(status: {h.store.try_get('Pod', h.ns, name)})"
+                )
+            await asyncio.sleep(self.cfg.readiness_poll_s)
+        elapsed = time.monotonic() - t0
+        sd = self._server_data_for(name)
+        return PairResult(name=name, t_actuation_s=elapsed, path=sd.path or "hot")
+
+    async def scale_down(self, keep: int = 0) -> None:
+        """Delete requesters, oldest-`keep` retained; instances go to sleep
+        on their launchers. Creation order = the zero-padded actuation
+        counter in the name (lexicographic name order breaks past 9)."""
+        h = self.harness
+        reqs = [
+            p
+            for p in h.store.list("Pod", h.ns)
+            if C.INFERENCE_SERVER_CONFIG_ANNOTATION
+            in (p["metadata"].get("annotations") or {})
+        ]
+        reqs.sort(key=lambda p: p["metadata"]["name"].rsplit("-", 1)[-1])
+        for pod in reqs[keep:]:
+            h.store.delete("Pod", h.ns, pod["metadata"]["name"])
+        await h.settle()
+
+    def _server_data_for(self, req_name: str):
+        h = self.harness
+        pod = h.store.get("Pod", h.ns, req_name)
+        return h.controller.server_data[pod["metadata"]["uid"]]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def __aenter__(self) -> "ActuationBenchmark":
+        await self.harness.controller.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.harness.controller.stop()

@@ -1,0 +1,133 @@
+"""Benchmark scenarios (reference: inference_server/benchmark/scenarios.py).
+
+  baseline    — cold deploy N pairs, measure T_actuation (scenarios.py:26+)
+  scaling     — scale up, down to 1, up again; the second scale-up should be
+                warm/hot hits against sleeping instances (hit-rate tracking)
+  new_variant — switch through a sequence of model configs on the same
+                chips, measuring each switch (the dual-pods headline: model
+                change in seconds)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .harness import ActuationBenchmark, BenchmarkConfig, ScenarioReport
+
+
+async def run_baseline(
+    n_pairs: int = 4, cfg: Optional[BenchmarkConfig] = None
+) -> Dict[str, Any]:
+    cfg = cfg or BenchmarkConfig()
+    async with ActuationBenchmark(cfg) as bench:
+        report = ScenarioReport("baseline", cfg.mode, cfg.time_scale)
+        bench.deploy_config("baseline-model")
+        for i in range(n_pairs):
+            report.pairs.append(
+                await bench.actuate("baseline-model", chips=[f"chip-{i}"])
+            )
+        return report.summary()
+
+
+async def run_scaling(
+    n_up: int = 4, cfg: Optional[BenchmarkConfig] = None
+) -> Dict[str, Any]:
+    cfg = cfg or BenchmarkConfig()
+    async with ActuationBenchmark(cfg) as bench:
+        report = ScenarioReport("scaling", cfg.mode, cfg.time_scale)
+        bench.deploy_config("scale-model")
+
+        first_up = [
+            await bench.actuate("scale-model", chips=[f"chip-{i}"])
+            for i in range(n_up)
+        ]
+        await bench.scale_down(keep=1)
+        second_up = [
+            await bench.actuate("scale-model", chips=[f"chip-{i}"])
+            for i in range(1, n_up)
+        ]
+        report.pairs = second_up  # hit-rate is about the RE-scale-up
+        report.extra = {
+            "first_up_cold": sum(1 for p in first_up if p.path == "cold"),
+            "second_up_warm_or_hot": sum(
+                1 for p in second_up if p.path in ("warm", "hot")
+            ),
+        }
+        return report.summary()
+
+
+async def run_new_variant(
+    models: Optional[List[str]] = None, cfg: Optional[BenchmarkConfig] = None
+) -> Dict[str, Any]:
+    models = models or ["llama-3-8b", "qwen-0.5b", "tinyllama-1.1b"]
+    cfg = cfg or BenchmarkConfig()
+    async with ActuationBenchmark(cfg) as bench:
+        report = ScenarioReport("new_variant", cfg.mode, cfg.time_scale)
+        # one port per variant: same-port instances on one launcher conflict
+        # (a sleeping engine still holds its port), so same-port variants
+        # would reclaim each other instead of sleeping side by side
+        for i, m in enumerate(models):
+            bench.deploy_config(m, port=8000 + i)
+        # switch through variants on the same chip set: each switch deletes
+        # the old requester and actuates the next model
+        for i, m in enumerate(models):
+            if i > 0:
+                await bench.scale_down(keep=0)
+            report.pairs.append(await bench.actuate(m, chips=["chip-0"]))
+        # a second full cycle: every variant now has a sleeping instance
+        cycle2: List[Any] = []
+        for m in models:
+            await bench.scale_down(keep=0)
+            cycle2.append(await bench.actuate(m, chips=["chip-0"]))
+        report.extra = {
+            "cycle2_warm_or_hot": sum(1 for p in cycle2 if p.path in ("warm", "hot")),
+            "cycle2_pairs": len(cycle2),
+        }
+        report.pairs.extend(cycle2)
+        return report.summary()
+
+
+async def run_all(
+    cfg: Optional[BenchmarkConfig] = None, pairs: int = 4
+) -> Dict[str, Any]:
+    return {
+        "baseline": await run_baseline(pairs, cfg=cfg),
+        "scaling": await run_scaling(pairs, cfg=cfg),
+        "new_variant": await run_new_variant(cfg=cfg),
+    }
+
+
+def main(argv=None) -> None:
+    import argparse
+    import asyncio
+    import json
+
+    p = argparse.ArgumentParser(prog="fma-tpu-benchmark")
+    p.add_argument(
+        "--scenario",
+        choices=["baseline", "scaling", "new_variant", "all"],
+        default="all",
+    )
+    p.add_argument(
+        "--pairs",
+        type=int,
+        default=4,
+        help="pair count for baseline/scaling (new_variant is sized by its model list)",
+    )
+    p.add_argument("--time-scale", type=float, default=0.01)
+    args = p.parse_args(argv)
+
+    cfg = BenchmarkConfig(time_scale=args.time_scale)
+    if args.scenario == "baseline":
+        out = asyncio.run(run_baseline(args.pairs, cfg))
+    elif args.scenario == "scaling":
+        out = asyncio.run(run_scaling(args.pairs, cfg))
+    elif args.scenario == "new_variant":
+        out = asyncio.run(run_new_variant(cfg=cfg))
+    else:
+        out = asyncio.run(run_all(cfg, args.pairs))
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

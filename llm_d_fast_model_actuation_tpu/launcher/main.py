@@ -106,7 +106,12 @@ def main(argv=None) -> None:
 
         async def stop_notifier(app):
             notifier.stop()
-            app["notifier_task"].cancel()
+            task = app["notifier_task"]
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
 
         app.on_startup.append(start_notifier)
         app.on_cleanup.append(stop_notifier)

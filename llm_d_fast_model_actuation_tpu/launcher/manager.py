@@ -15,6 +15,7 @@ ledger gives it the node-local truth to verify against).
 from __future__ import annotations
 
 import logging
+import threading
 import uuid as uuidlib
 from typing import Any, Dict, List, Optional
 
@@ -70,6 +71,10 @@ class EngineProcessManager:
         self.ledger = ChipLedger()
         self.broadcaster = EventBroadcaster()
         self._revision = 0
+        # create/sentinel publish on the loop thread; stop_instance publishes
+        # from the REST handler's executor thread — revision minting and the
+        # buffer append must be one atomic step or a watcher can skip events
+        self._rev_lock = threading.Lock()
         self._kickoff = kickoff
 
     # -- revisions -----------------------------------------------------------
@@ -82,10 +87,14 @@ class EngineProcessManager:
         self._revision += 1
         return self._revision
 
-    def _publish(self, event_type: str, obj: Dict[str, Any]) -> None:
-        rev = obj.get("revision") or self._next_revision()
-        obj["revision"] = rev
-        self.broadcaster.publish_nowait(rev, {"type": event_type, "object": obj})
+    def _publish(self, event_type: str, obj: Dict[str, Any]) -> int:
+        """Mint-and-append atomically (cross-thread safe); returns the
+        revision stamped on the event."""
+        with self._rev_lock:
+            rev = self._next_revision()
+            obj["revision"] = rev
+            self.broadcaster.publish_nowait(rev, {"type": event_type, "object": obj})
+        return rev
 
     # -- CRUDL ---------------------------------------------------------------
 
@@ -119,9 +128,9 @@ class EngineProcessManager:
             )
         result = instance.start()
         self.instances[iid] = instance
-        instance.last_revision = self._next_revision()
+        published = dict(result)
+        instance.last_revision = self._publish("CREATED", published)
         result["revision"] = instance.last_revision
-        self._publish("CREATED", dict(result))
         logger.info("created instance %s (rev %s)", iid, instance.last_revision)
         return result
 
@@ -131,10 +140,9 @@ class EngineProcessManager:
         if instance is None:
             return
         self.ledger.release(instance_id)
-        instance.last_revision = self._next_revision()
         obj = instance.get_status()
         obj["exit_code"] = exitcode
-        self._publish("STOPPED", obj)
+        instance.last_revision = self._publish("STOPPED", obj)
         logger.warning(
             "instance %s stopped itself (exit code %s)", instance_id, exitcode
         )
@@ -147,8 +155,8 @@ class EngineProcessManager:
         result = instance.stop(timeout=timeout)
         del self.instances[instance_id]
         self.ledger.release(instance_id)
-        result["revision"] = self._next_revision()
-        self._publish("DELETED", dict(result))
+        published = dict(result)
+        result["revision"] = self._publish("DELETED", published)
         logger.info("stopped instance %s", instance_id)
         return result
 

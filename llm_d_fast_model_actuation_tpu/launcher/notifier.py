@@ -92,6 +92,10 @@ class InstanceStateNotifier:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
+                    if type(e).__name__ == "RevisionTooOld":
+                        # resume cursor evicted: restart from the buffer
+                        # start; the reflect below covers current state
+                        self._last_revision = 0
                     logger.warning("watch connect failed (%s); polling", e)
 
             await self._reflect_guarded()
@@ -112,6 +116,8 @@ class InstanceStateNotifier:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                if type(e).__name__ == "RevisionTooOld":
+                    self._last_revision = 0
                 logger.warning("watch stream broke (%s); resyncing", e)
                 await asyncio.sleep(min(self._poll_interval_s, 1.0))
 

@@ -1,0 +1,46 @@
+"""Benchmark harness tests (reference scenarios.py semantics).
+
+Run with zeroed latencies (time_scale=0) so they're instant; the scenario
+logic — path classification, hit rates, report shape — is what's under test.
+"""
+
+import asyncio
+
+from llm_d_fast_model_actuation_tpu.benchmark import (
+    BenchmarkConfig,
+    run_baseline,
+    run_new_variant,
+    run_scaling,
+)
+
+
+def _cfg() -> BenchmarkConfig:
+    return BenchmarkConfig(time_scale=0.0, readiness_poll_s=0.005)
+
+
+def test_baseline_all_cold():
+    out = asyncio.run(run_baseline(3, _cfg()))
+    assert out["pairs"] == 3
+    assert out["Cold_rate"] == 1.0, out
+    assert out["T_actuation_s"]["min"] >= 0
+
+
+def test_scaling_second_up_hits_sleeping_instances():
+    out = asyncio.run(run_scaling(4, _cfg()))
+    # the re-scale-up binds launchers holding the sleeping instances
+    assert out["second_up_warm_or_hot"] == 3, out
+    assert out["Warm_hit_rate"] + out["Hot_hit_rate"] == 1.0, out
+    assert out["first_up_cold"] == 4
+
+
+def test_new_variant_second_cycle_warm():
+    out = asyncio.run(run_new_variant(["m1", "m2"], _cfg()))
+    assert out["cycle2_pairs"] == 2
+    assert out["cycle2_warm_or_hot"] == 2, out
+
+
+def test_simulated_latencies_scale_timings():
+    cfg = BenchmarkConfig(time_scale=0.002, readiness_poll_s=0.002)
+    out = asyncio.run(run_baseline(1, cfg))
+    # cold path = launcher start + instance create >= 60 s unscaled
+    assert out["T_actuation_s"]["min"] >= 50, out
