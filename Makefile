@@ -1,0 +1,35 @@
+# Build/test entry points (reference: Makefile:21-140).
+
+PYTHON ?= python
+IMAGE_REGISTRY ?= ghcr.io/example
+IMAGE_TAG ?= latest
+
+.PHONY: test test-fast native bench lint images dryrun clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q -x
+
+native:
+	$(MAKE) -C native
+
+bench:
+	timeout 590 $(PYTHON) bench.py
+
+# simulated actuation benchmark (no cluster, no TPU)
+bench-actuation:
+	$(PYTHON) -m llm_d_fast_model_actuation_tpu.benchmark --scenario all
+
+dryrun:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+images:
+	docker build -f deploy/dockerfiles/Dockerfile.launcher -t $(IMAGE_REGISTRY)/fma-tpu-launcher:$(IMAGE_TAG) .
+	docker build -f deploy/dockerfiles/Dockerfile.requester -t $(IMAGE_REGISTRY)/fma-tpu-requester:$(IMAGE_TAG) .
+	docker build -f deploy/dockerfiles/Dockerfile.controller -t $(IMAGE_REGISTRY)/fma-tpu-controller:$(IMAGE_TAG) .
+
+clean:
+	rm -rf native/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,95 @@
+"""Control-plane CLI: `python -m llm_d_fast_model_actuation_tpu.controller`.
+
+Subcommands mirror the reference's two controller binaries
+(cmd/dual-pods-controller/main.go:40-119, cmd/launcher-populator/
+main.go:42-140) and the chart's args (deploy/chart). The cluster store
+backend is selected by --store:
+
+  memory  — in-process store (demo / single-process integration runs; the
+            launcher/requester/engine transports are still real HTTP)
+  kube    — watch/patch against a kube-apiserver. Not wired yet: the
+            kube-backed ClusterStore (same interface as InMemoryStore) is
+            the remaining deployment gap; the flag reserves the contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+
+def _common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--namespace", required=True, help="namespace to watch (controllers are namespace-scoped)")
+    p.add_argument("--store", choices=["memory", "kube"], default="kube")
+    p.add_argument("--metrics-port", type=int, default=8002)
+    p.add_argument("--log-level", default="info")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="fma-tpu-controllers")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    dpc = sub.add_parser("dual-pods-controller", help="bind requesters to providers")
+    _common(dpc)
+    dpc.add_argument("--sleeper-limit", type=int, default=1)
+    dpc.add_argument("--accelerator-sleeping-memory-limit-bytes", type=int, default=0)
+
+    pop = sub.add_parser("launcher-populator", help="proactive launcher population")
+    _common(pop)
+    pop.add_argument("--expectation-timeout", type=float, default=5.0)
+    pop.add_argument("--stuck-scheduling-threshold", type=float, default=120.0)
+    pop.add_argument("--stuck-starting-threshold", type=float, default=450.0)
+
+    args = p.parse_args(argv)
+    logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
+
+    if args.store == "kube":
+        p.error(
+            "--store=kube is not wired yet (the kube-backed ClusterStore is the "
+            "remaining deployment gap); run with --store=memory for in-process use"
+        )
+
+    from .metrics import serve_metrics
+    from .store import InMemoryStore
+
+    store = InMemoryStore()
+    serve_metrics(args.metrics_port)
+
+    async def run() -> None:
+        if args.cmd == "dual-pods-controller":
+            from .clients import HttpTransports
+            from .dualpods import DualPodsConfig, DualPodsController
+
+            ctl = DualPodsController(
+                store,
+                HttpTransports(),
+                DualPodsConfig(
+                    namespace=args.namespace,
+                    sleeper_limit=args.sleeper_limit,
+                    accelerator_sleeping_memory_limit_bytes=args.accelerator_sleeping_memory_limit_bytes,
+                ),
+            )
+        else:
+            from .populator import Populator, PopulatorConfig
+
+            ctl = Populator(
+                store,
+                PopulatorConfig(
+                    namespace=args.namespace,
+                    expectation_timeout_s=args.expectation_timeout,
+                    stuck_scheduling_threshold_s=args.stuck_scheduling_threshold,
+                    stuck_starting_threshold_s=args.stuck_starting_threshold,
+                ),
+            )
+        await ctl.start()
+        try:
+            await asyncio.Event().wait()  # serve forever
+        finally:
+            await ctl.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

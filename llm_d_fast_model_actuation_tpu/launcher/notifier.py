@@ -28,6 +28,7 @@ import shutil
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
 from ..api.constants import INSTANCE_SIGNATURE_ANNOTATION as SIGNATURE_ANNOTATION
+from ..utils.events import RevisionTooOld
 
 logger = logging.getLogger(__name__)
 
@@ -92,7 +93,7 @@ class InstanceStateNotifier:
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
-                    if type(e).__name__ == "RevisionTooOld":
+                    if isinstance(e, RevisionTooOld):
                         # resume cursor evicted: restart from the buffer
                         # start; the reflect below covers current state
                         self._last_revision = 0
@@ -116,7 +117,7 @@ class InstanceStateNotifier:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
-                if type(e).__name__ == "RevisionTooOld":
+                if isinstance(e, RevisionTooOld):
                     self._last_revision = 0
                 logger.warning("watch stream broke (%s); resyncing", e)
                 await asyncio.sleep(min(self._poll_interval_s, 1.0))

@@ -1,0 +1,136 @@
+"""Admission rules (the Python mirror of deploy/policies/*.yaml) and CRD
+structural validation."""
+
+from llm_d_fast_model_actuation_tpu import admission as adm
+from llm_d_fast_model_actuation_tpu.api import constants as C
+
+FMA_SA = "system:serviceaccount:prod:release-fma-controllers"
+USER = "kubernetes-admin"
+
+
+def _pod(ann=None, labels=None):
+    return {
+        "kind": "Pod",
+        "metadata": {
+            "name": "p",
+            "annotations": dict(ann or {}),
+            "labels": dict(labels or {}),
+        },
+    }
+
+
+def test_sa_pattern():
+    assert adm.is_fma_controller(FMA_SA)
+    assert adm.is_fma_controller("system:serviceaccount:ns:-fma-controllers")
+    assert not adm.is_fma_controller("system:serviceaccount:ns:other")
+    assert not adm.is_fma_controller(USER)
+
+
+def test_protected_annotation_frozen_for_users():
+    old = _pod(ann={C.REQUESTER_ANNOTATION: "r1/u1"})
+    new = _pod(ann={C.REQUESTER_ANNOTATION: "hacker/u9"})
+    assert adm.validate_pod_update(old, new, USER)
+    assert not adm.validate_pod_update(old, new, FMA_SA)
+    # removing the key is also a change
+    assert adm.validate_pod_update(old, _pod(), USER)
+    # untouched protected keys admit
+    assert not adm.validate_pod_update(old, old, USER)
+
+
+def test_protected_labels_frozen_for_users():
+    old = _pod(labels={C.SLEEPING_LABEL: "true"})
+    new = _pod(labels={C.SLEEPING_LABEL: "false"})
+    assert adm.validate_pod_update(old, new, USER)
+    assert not adm.validate_pod_update(old, new, FMA_SA)
+
+
+def test_bound_requester_actuation_frozen():
+    old = _pod(
+        ann={C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc1"},
+        labels={C.DUAL_LABEL: "provider-x"},
+    )
+    new = _pod(
+        ann={C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc2"},
+        labels={C.DUAL_LABEL: "provider-x"},
+    )
+    errs = adm.validate_pod_update(old, new, USER)
+    assert any("frozen while the requester is bound" in e for e in errs)
+    # unbound requester may change it
+    old_unbound = _pod(ann={C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc1"})
+    new_unbound = _pod(ann={C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc2"})
+    assert not adm.validate_pod_update(old_unbound, new_unbound, USER)
+
+
+def test_isc_validation():
+    good = {
+        "kind": "InferenceServerConfig",
+        "spec": {
+            "modelServerConfig": {
+                "port": 8000,
+                "accelerator": {"chips": 8, "topology": "2x4"},
+            }
+        },
+    }
+    assert adm.validate(good) == []
+    bad_port = {"kind": "InferenceServerConfig", "spec": {"modelServerConfig": {"port": 0}}}
+    assert adm.validate(bad_port)
+    mismatch = {
+        "kind": "InferenceServerConfig",
+        "spec": {
+            "modelServerConfig": {
+                "port": 8000,
+                "accelerator": {"chips": 4, "topology": "2x4"},
+            }
+        },
+    }
+    assert any("8 chips" in e for e in adm.validate(mismatch))
+    assert adm.validate({"kind": "InferenceServerConfig", "spec": {}})
+
+
+def test_lc_and_lpp_validation():
+    assert adm.validate(
+        {"kind": "LauncherConfig", "spec": {"podTemplate": {}, "maxInstances": 2}}
+    ) == []
+    assert adm.validate({"kind": "LauncherConfig", "spec": {"maxInstances": 0}})
+
+    good_lpp = {
+        "kind": "LauncherPopulationPolicy",
+        "spec": {
+            "nodeSelector": {
+                "labelSelector": {"matchLabels": {"pool": "tpu"}},
+                "allocatableResources": {C.TPU_RESOURCE: {"min": "4", "max": "8"}},
+            },
+            "countForLauncher": [{"launcherConfigName": "lc1", "launcherCount": 2}],
+        },
+    }
+    assert adm.validate(good_lpp) == []
+    bad_range = {
+        "kind": "LauncherPopulationPolicy",
+        "spec": {
+            "nodeSelector": {"allocatableResources": {"x": {"min": "9", "max": "1"}}},
+            "countForLauncher": [{"launcherConfigName": "lc1", "launcherCount": 1}],
+        },
+    }
+    assert any("min > max" in e for e in adm.validate(bad_range))
+
+
+def test_review_shape():
+    out = adm.review(
+        {
+            "operation": "UPDATE",
+            "object": _pod(ann={C.STATUS_ANNOTATION: "tampered"}),
+            "oldObject": _pod(),
+            "userInfo": {"username": USER},
+        }
+    )
+    assert out["allowed"] is False and "status" in out
+    out2 = adm.review(
+        {
+            "operation": "CREATE",
+            "object": {
+                "kind": "LauncherConfig",
+                "spec": {"podTemplate": {}},
+            },
+        }
+    )
+    assert out2["allowed"] is True

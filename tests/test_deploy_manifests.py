@@ -1,0 +1,76 @@
+"""Deploy-layer sanity: CRDs/policies parse, schemas cover the API types,
+chart templates reference flags the CLI actually has."""
+
+import pathlib
+import re
+
+import yaml
+
+DEPLOY = pathlib.Path(__file__).resolve().parent.parent / "deploy"
+
+
+def _load_all(path):
+    return [d for d in yaml.safe_load_all(path.read_text()) if d]
+
+
+def test_crds_parse_and_name_the_kinds():
+    kinds = {}
+    for f in (DEPLOY / "crds").glob("*.yaml"):
+        for doc in _load_all(f):
+            assert doc["kind"] == "CustomResourceDefinition"
+            names = doc["spec"]["names"]
+            kinds[names["kind"]] = names
+            v = doc["spec"]["versions"][0]
+            assert v["name"] == "v1alpha1"
+            assert "openAPIV3Schema" in v["schema"]
+    assert set(kinds) == {
+        "InferenceServerConfig",
+        "LauncherConfig",
+        "LauncherPopulationPolicy",
+    }
+    assert kinds["InferenceServerConfig"]["shortNames"] == ["isc"]
+    assert kinds["LauncherConfig"]["shortNames"] == ["lcfg"]
+    assert kinds["LauncherPopulationPolicy"]["shortNames"] == ["lpp"]
+
+
+def test_isc_crd_has_tpu_accelerator_schema():
+    doc = _load_all(DEPLOY / "crds" / "inferenceserverconfig.yaml")[0]
+    schema = doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    msc = schema["properties"]["spec"]["properties"]["modelServerConfig"]
+    acc = msc["properties"]["accelerator"]["properties"]
+    assert acc["chips"]["minimum"] == 1
+    assert "topology" in acc
+
+
+def test_admission_policies_cover_protected_keys():
+    """The CEL lists must stay in sync with the Python source of truth."""
+    from llm_d_fast_model_actuation_tpu import admission as adm
+
+    text = (DEPLOY / "policies" / "fma-immutable-fields.yaml").read_text()
+    for key in adm.PROTECTED_ANNOTATIONS:
+        assert key in text, f"policy missing protected annotation {key}"
+    for key in adm.PROTECTED_LABELS:
+        assert key in text, f"policy missing protected label {key}"
+    bound = (DEPLOY / "policies" / "fma-bound-serverreqpod.yaml").read_text()
+    for key in adm.BOUND_ACTUATION_ANNOTATIONS:
+        assert key in bound, f"bound policy missing {key}"
+
+
+def test_chart_args_match_controller_cli():
+    """Every --flag the chart passes must exist in the controller CLI."""
+    import llm_d_fast_model_actuation_tpu.controller.__main__ as cli
+
+    src = pathlib.Path(cli.__file__).read_text()
+    chart_dir = DEPLOY / "chart" / "fma-tpu-controllers" / "templates"
+    for tmpl in chart_dir.glob("*.yaml"):
+        for flag in re.findall(r"--([a-z-]+)=", tmpl.read_text()):
+            assert f"--{flag}" in src, f"{tmpl.name} passes unknown flag --{flag}"
+
+
+def test_controller_cli_gates_kube_store():
+    import pytest
+
+    from llm_d_fast_model_actuation_tpu.controller.__main__ import main
+
+    with pytest.raises(SystemExit):
+        main(["dual-pods-controller", "--namespace", "ns"])  # kube store gated
