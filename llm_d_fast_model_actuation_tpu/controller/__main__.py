@@ -7,9 +7,10 @@ backend is selected by --store:
 
   memory  — in-process store (demo / single-process integration runs; the
             launcher/requester/engine transports are still real HTTP)
-  kube    — watch/patch against a kube-apiserver. Not wired yet: the
-            kube-backed ClusterStore (same interface as InMemoryStore) is
-            the remaining deployment gap; the flag reserves the contract.
+  kube    — list+watch informer cache + REST writes against a
+            kube-apiserver (kubestore.KubeStore): in-cluster service-account
+            wiring by default, or --kube-api-url/--kube-token-file for an
+            explicit endpoint.
 """
 
 from __future__ import annotations
@@ -22,6 +23,9 @@ import logging
 def _common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--namespace", required=True, help="namespace to watch (controllers are namespace-scoped)")
     p.add_argument("--store", choices=["memory", "kube"], default="kube")
+    p.add_argument("--kube-api-url", default="", help="apiserver URL (default: in-cluster)")
+    p.add_argument("--kube-token-file", default="", help="bearer token file (with --kube-api-url)")
+    p.add_argument("--kube-ca-file", default="", help="CA bundle (with --kube-api-url)")
     p.add_argument("--metrics-port", type=int, default=8002)
     p.add_argument("--log-level", default="info")
 
@@ -44,19 +48,39 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
     logging.basicConfig(level=getattr(logging, args.log_level.upper(), logging.INFO))
 
-    if args.store == "kube":
-        p.error(
-            "--store=kube is not wired yet (the kube-backed ClusterStore is the "
-            "remaining deployment gap); run with --store=memory for in-process use"
-        )
-
     from .metrics import serve_metrics
-    from .store import InMemoryStore
 
-    store = InMemoryStore()
+    if args.store == "kube":
+        from .kubestore import KubeStore
+
+        if args.kube_api_url:
+            token = None
+            if args.kube_token_file:
+                with open(args.kube_token_file) as f:
+                    token = f.read().strip()
+            store = KubeStore(
+                args.kube_api_url,
+                args.namespace,
+                token=token,
+                ca_file=args.kube_ca_file or None,
+            )
+        else:
+            try:
+                store = KubeStore.in_cluster(args.namespace)
+            except (KeyError, OSError) as e:
+                p.error(
+                    f"not running in-cluster ({e}); pass --kube-api-url or "
+                    "--store=memory"
+                )
+    else:
+        from .store import InMemoryStore
+
+        store = InMemoryStore()
     serve_metrics(args.metrics_port)
 
     async def run() -> None:
+        if hasattr(store, "start"):
+            await store.start()
         if args.cmd == "dual-pods-controller":
             from .clients import HttpTransports
             from .dualpods import DualPodsConfig, DualPodsController
@@ -87,6 +111,8 @@ def main(argv=None) -> None:
             await asyncio.Event().wait()  # serve forever
         finally:
             await ctl.stop()
+            if hasattr(store, "stop"):
+                await store.stop()
 
     asyncio.run(run())
 

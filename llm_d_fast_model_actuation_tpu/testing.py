@@ -209,11 +209,12 @@ class Harness:
         self,
         ns: str = "ns",
         latencies: Optional[SimLatencies] = None,
+        store: Optional[Any] = None,
         **cfg_kwargs,
     ) -> None:
         self.ns = ns
         self.latencies = latencies or SimLatencies()
-        self.store = InMemoryStore()
+        self.store = store if store is not None else InMemoryStore()
         self.launchers: Dict[str, FakeLauncher] = {}
         self.spis: Dict[str, FakeSpi] = {}
         self.transports = FakeTransports(self)

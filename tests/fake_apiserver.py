@@ -1,0 +1,218 @@
+"""A minimal kube-apiserver: the REST/watch surface KubeStore speaks,
+backed by the InMemoryStore (which already implements kube's optimistic
+concurrency + finalizer semantics).
+
+Runs in its own thread with its own event loop so KubeStore's blocking
+writes (urllib, issued from the test's loop) can't deadlock the server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from aiohttp import web
+
+from llm_d_fast_model_actuation_tpu.controller.kubestore import KIND_PATHS
+from llm_d_fast_model_actuation_tpu.controller.store import (
+    AlreadyExists,
+    Conflict,
+    InMemoryStore,
+    NotFound,
+)
+
+_PLURAL_TO_KIND = {plural: kind for kind, (_, plural, _ns) in KIND_PATHS.items()}
+
+
+def _parse(path: str) -> Optional[Tuple[str, str, Optional[str]]]:
+    """path -> (kind, namespace, name|None)."""
+    parts = [p for p in path.split("/") if p]
+    # strip api prefix: ("api","v1") or ("apis", group, version)
+    if parts[:2] == ["api", "v1"]:
+        rest = parts[2:]
+    elif parts[:1] == ["apis"] and len(parts) >= 3:
+        rest = parts[3:]
+    else:
+        return None
+    ns = ""
+    if rest[:1] == ["namespaces"] and len(rest) >= 3:
+        ns, rest = rest[1], rest[2:]
+    if not rest or rest[0] not in _PLURAL_TO_KIND:
+        return None
+    kind = _PLURAL_TO_KIND[rest[0]]
+    name = rest[1] if len(rest) > 1 else None
+    return kind, ns, name
+
+
+class FakeApiServer:
+    def __init__(self, store: Optional[InMemoryStore] = None) -> None:
+        self.store = store or InMemoryStore()
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self.port = 0
+        # kube watch semantics: ?resourceVersion=N replays events with
+        # rv > N, so nothing is lost between a list and the watch connect
+        self._log: list = []  # (rv_int, event, obj)
+        self._log_lock = threading.Lock()
+        self._queues: list = []  # (asyncio.Queue, loop)
+
+        def on_commit(event: str, obj: Dict[str, Any]) -> None:
+            rv = int((obj.get("metadata") or {}).get("resourceVersion", "0") or 0)
+            with self._log_lock:
+                self._log.append((rv, event, obj))
+                targets = list(self._queues)
+            for queue, loop in targets:
+                loop.call_soon_threadsafe(queue.put_nowait, (event, obj))
+
+        self.store.subscribe(on_commit)
+
+    # -- handlers (run on the server thread's loop) ---------------------------
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        parsed = _parse(request.path)
+        if parsed is None:
+            return web.json_response({"kind": "Status", "message": "not found"}, status=404)
+        kind, ns, name = parsed
+        try:
+            if request.method == "GET" and name is None:
+                if request.query.get("watch") == "1":
+                    return await self._watch(request, kind, ns)
+                items = self.store.list(kind, ns or None)
+                return web.json_response(
+                    {
+                        "kind": f"{kind}List",
+                        "items": items,
+                        "metadata": {
+                            "resourceVersion": str(
+                                max(
+                                    [
+                                        int(i["metadata"]["resourceVersion"])
+                                        for i in items
+                                    ]
+                                    or [0]
+                                )
+                            )
+                        },
+                    }
+                )
+            if request.method == "GET":
+                return web.json_response(self.store.get(kind, ns, name))
+            if request.method == "POST":
+                obj = await request.json()
+                obj.setdefault("kind", kind)
+                obj.setdefault("metadata", {}).setdefault("namespace", ns)
+                return web.json_response(self.store.create(obj), status=201)
+            if request.method == "PUT":
+                obj = await request.json()
+                obj.setdefault("kind", kind)
+                return web.json_response(self.store.update(obj))
+            if request.method == "DELETE":
+                body: Dict[str, Any] = {}
+                if request.can_read_body:
+                    try:
+                        body = await request.json()
+                    except Exception:
+                        body = {}
+                pre = body.get("preconditions") or {}
+                self.store.delete(
+                    kind,
+                    ns,
+                    name,
+                    expect_uid=pre.get("uid"),
+                    expect_rv=pre.get("resourceVersion"),
+                )
+                remaining = self.store.try_get(kind, ns, name)
+                if remaining is not None:  # terminating (finalizers)
+                    return web.json_response(remaining)
+                return web.json_response({"kind": "Status", "status": "Success"})
+        except NotFound as e:
+            return web.json_response(
+                {"kind": "Status", "reason": "NotFound", "message": str(e)}, status=404
+            )
+        except AlreadyExists as e:
+            return web.json_response(
+                {"kind": "Status", "reason": "AlreadyExists", "message": str(e)},
+                status=409,
+            )
+        except Conflict as e:
+            return web.json_response(
+                {"kind": "Status", "reason": "Conflict", "message": str(e)}, status=409
+            )
+        return web.json_response({"kind": "Status"}, status=405)
+
+    async def _watch(self, request: web.Request, kind: str, ns: str) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/json", "Transfer-Encoding": "chunked"}
+        )
+        await resp.prepare(request)
+        try:
+            since = int(request.query.get("resourceVersion", "0") or 0)
+        except ValueError:
+            since = 0
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+
+        # atomically: replay the backlog > since into the queue, then attach
+        # for live events (no gap, no duplication)
+        with self._log_lock:
+            backlog = [(ev, obj) for (rv, ev, obj) in self._log if rv > since]
+            self._queues.append((queue, loop))
+        for item in backlog:
+            queue.put_nowait(item)
+
+        def matches(obj: Dict[str, Any]) -> bool:
+            m = obj.get("metadata") or {}
+            return obj.get("kind") == kind and (not ns or m.get("namespace") == ns)
+
+        try:
+            while True:
+                event, obj = await queue.get()
+                if not matches(obj):
+                    continue
+                line = json.dumps({"type": event, "object": obj}) + "\n"
+                await resp.write(line.encode())
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            with self._log_lock:
+                self._queues.remove((queue, loop))
+        return resp
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> str:
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def setup() -> None:
+                app = web.Application()
+                app.router.add_route("*", "/{tail:.*}", self._handle)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                self.port = site._server.sockets[0].getsockname()[1]
+                self._runner = runner
+                self._started.set()
+
+            loop.run_until_complete(setup())
+            loop.run_forever()
+            loop.run_until_complete(self._runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("fake apiserver did not start")
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -67,10 +67,12 @@ def test_chart_args_match_controller_cli():
             assert f"--{flag}" in src, f"{tmpl.name} passes unknown flag --{flag}"
 
 
-def test_controller_cli_gates_kube_store():
+def test_controller_cli_kube_store_needs_cluster():
+    """Default --store=kube without in-cluster env or --kube-api-url must
+    fail with a clean usage error, not a stack trace."""
     import pytest
 
     from llm_d_fast_model_actuation_tpu.controller.__main__ import main
 
     with pytest.raises(SystemExit):
-        main(["dual-pods-controller", "--namespace", "ns"])  # kube store gated
+        main(["dual-pods-controller", "--namespace", "ns"])
