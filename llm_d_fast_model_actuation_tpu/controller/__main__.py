@@ -54,14 +54,12 @@ def main(argv=None) -> None:
         from .kubestore import KubeStore
 
         if args.kube_api_url:
-            token = None
-            if args.kube_token_file:
-                with open(args.kube_token_file) as f:
-                    token = f.read().strip()
             store = KubeStore(
                 args.kube_api_url,
                 args.namespace,
-                token=token,
+                # pass the FILE: bound SA tokens rotate, KubeStore re-reads
+                # per request
+                token_file=args.kube_token_file or None,
                 ca_file=args.kube_ca_file or None,
             )
         else:

@@ -242,12 +242,16 @@ class KubeStore:
                 self._emit(MODIFIED, item)
         # purge entries deleted while we weren't watching — but never ones
         # written AFTER the list was generated (their RV exceeds the list
-        # RV; a concurrent create() on the loop thread must stay visible)
+        # RV; a concurrent create() on the loop thread must stay visible),
+        # and only within the namespace the list actually covered
+        # (cross-namespace writes are cached too but not listed here)
+        _, _, namespaced = KIND_PATHS[kind]
         with self._lock:
             gone = [
                 k
                 for k, obj in self._cache.items()
                 if k[0] == kind
+                and (not namespaced or k[1] == self.namespace)
                 and k not in seen
                 and (not list_rv_int or _rv_int(obj) <= list_rv_int)
             ]

@@ -237,6 +237,9 @@ class InMemoryStore:
                     self._emit(MODIFIED, cur)
                 return
             del self._objs[key]
+            # kube assigns deletion a fresh RV — watch consumers resuming
+            # from a list RV must see deletions committed after the list
+            m["resourceVersion"] = self._next_rv()
             self._emit(DELETED, cur)
 
     # -- conveniences --------------------------------------------------------

@@ -1,0 +1,316 @@
+"""Full-stack e2e: the TPU-less equivalent of the reference's kind suite
+(test/e2e/run-launcher-based.sh, SURVEY.md §4.3).
+
+Every boundary is real:
+  controller --(watch/REST)--> fake kube-apiserver        [KubeStore]
+  controller --(HTTP SPI)----> requester stub subprocess  [chip discovery,
+                                readiness relay]
+  controller --(HTTP REST)---> launcher subprocess        [instance CRUDL]
+  launcher   --(fork)--------> engine child (tiny model, CPU)
+  controller --(HTTP admin)--> engine (/is_sleeping, /sleep, /wake_up)
+
+Covered cycle: cold actuation to Ready -> serve completions -> requester
+deletion puts the instance to sleep -> re-actuation wakes the SAME instance
+(warm path) without a new launcher or engine process.
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.clients import HttpTransports
+from llm_d_fast_model_actuation_tpu.controller.dualpods import (
+    DualPodsConfig,
+    DualPodsController,
+)
+from llm_d_fast_model_actuation_tpu.controller.kubestore import KubeStore
+
+from fake_apiserver import FakeApiServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NS = "e2e"
+NODE = "n1"
+CHIP = "tpu-mock-0-0"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def port_free(port: int) -> bool:
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+            return True
+        except OSError:
+            return False
+
+
+def wait_http(url: str, timeout: float = 90.0) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            r = requests.get(url, timeout=2)
+            if r.status_code == 200:
+                return
+            last = r.status_code
+        except requests.RequestException as e:
+            last = e
+        time.sleep(0.2)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+def _spawn(args, log_file, **env_extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(env_extra)
+    # log to a file, never a PIPE nobody drains: chatty children would block
+    # on a full pipe buffer and wedge the whole stack
+    out = open(log_file, "wb")
+    return subprocess.Popen(
+        [sys.executable, "-m", *args],
+        env=env,
+        stdout=out,
+        stderr=subprocess.STDOUT,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    if not port_free(C.LAUNCHER_SERVICE_PORT):
+        pytest.skip(f"port {C.LAUNCHER_SERVICE_PORT} busy (launcher port is fixed)")
+    procs = []
+    srv = FakeApiServer()
+    srv.start()
+    spi_port, probes_port = free_port(), free_port()
+    try:
+        procs.append(
+            _spawn(
+                [
+                    "llm_d_fast_model_actuation_tpu.launcher.main",
+                    "--mock-chips",
+                    "--mock-chip-count",
+                    "4",
+                    "--mock-topology",
+                    "2x2",
+                    "--host",
+                    "127.0.0.1",
+                    "--port",
+                    str(C.LAUNCHER_SERVICE_PORT),
+                    "--log-dir",
+                    str(tmp_path_factory.mktemp("launcher-logs")),
+                ]
+            )
+        )
+        procs.append(
+            _spawn(
+                [
+                    "llm_d_fast_model_actuation_tpu.requester.main",
+                    "--host",
+                    "127.0.0.1",
+                    "--backend",
+                    "static",
+                    "--chips",
+                    CHIP,
+                    "--spi-port",
+                    str(spi_port),
+                    "--probes-port",
+                    str(probes_port),
+                ]
+            )
+        )
+        wait_http(f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/health")
+        wait_http(f"http://127.0.0.1:{spi_port}/v1/dual-pods/accelerators")
+        yield srv, spi_port, probes_port
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        srv.stop()
+
+
+def _launcher_pod_object(ks):
+    """Build the launcher Pod object the way the controller would, so its
+    config-hash matches selection (shared template builder)."""
+    from llm_d_fast_model_actuation_tpu.api.types import LauncherConfig
+    from llm_d_fast_model_actuation_tpu.controller.populator import (
+        build_launcher_template,
+        specialize_to_node,
+    )
+
+    lc = LauncherConfig.from_dict(ks.get("LauncherConfig", NS, "lc1"))
+    _, ti_hash = build_launcher_template(lc)
+    pod = specialize_to_node(lc, NODE, ti_hash)
+    pod["metadata"]["namespace"] = NS
+    pod["metadata"]["name"] = "launcher-live"
+    pod["status"] = {
+        "podIP": "127.0.0.1",
+        "conditions": [{"type": "Ready", "status": "True"}],
+    }
+    return pod
+
+
+@pytest.mark.e2e
+def test_cold_then_warm_actuation_over_real_http(stack):
+    srv, spi_port, probes_port = stack
+    engine_port = free_port()
+
+    async def scenario():
+        ks = KubeStore(f"http://127.0.0.1:{srv.port}", NS, kinds=None)
+        await ks.start()
+        transports = HttpTransports()
+        ctl = DualPodsController(ks, transports, DualPodsConfig(namespace=NS))
+        await ctl.start()
+        try:
+            ks.create(
+                {
+                    "kind": "LauncherConfig",
+                    "metadata": {"name": "lc1", "namespace": NS},
+                    "spec": {
+                        "podTemplate": {"metadata": {}, "spec": {"containers": [{"name": "launcher"}]}},
+                        "maxInstances": 2,
+                    },
+                }
+            )
+            ks.create(
+                {
+                    "kind": "InferenceServerConfig",
+                    "metadata": {"name": "isc1", "namespace": NS},
+                    "spec": {
+                        "modelServerConfig": {
+                            "port": engine_port,
+                            "options": (
+                                f"--model tiny --port {engine_port} --num-pages 32 "
+                                "--max-batch 2 --page-size 8 --max-model-len 64"
+                            ),
+                            "env_vars": {"JAX_PLATFORMS": "cpu"},
+                        },
+                        "launcherConfigName": "lc1",
+                    },
+                }
+            )
+            # the running launcher process, represented as its Pod object
+            ks.create(_launcher_pod_object(ks))
+
+            def add_requester(name):
+                ks.create(
+                    {
+                        "kind": "Pod",
+                        "metadata": {
+                            "name": name,
+                            "namespace": NS,
+                            "annotations": {
+                                C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc1",
+                                C.ADMIN_PORT_ANNOTATION: str(spi_port),
+                            },
+                        },
+                        "spec": {
+                            "nodeName": NODE,
+                            "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
+                        },
+                        "status": {"podIP": "127.0.0.1"},
+                    }
+                )
+
+            add_requester("req1")
+
+            # ---- cold actuation: engine forked, served, readiness relayed
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                try:
+                    if requests.get(
+                        f"http://127.0.0.1:{probes_port}/ready", timeout=1
+                    ).status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                await asyncio.sleep(0.3)
+            r = requests.get(f"http://127.0.0.1:{probes_port}/ready", timeout=2)
+            assert r.status_code == 200, "readiness must be relayed to the stub"
+
+            engine = f"http://127.0.0.1:{engine_port}"
+            out1 = requests.post(
+                engine + "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 3},
+                timeout=60,
+            ).json()["choices"][0]["token_ids"]
+            assert len(out1) == 3
+
+            launcher_pod = ks.get("Pod", NS, "launcher-live")
+            assert launcher_pod["metadata"]["annotations"][
+                C.REQUESTER_ANNOTATION
+            ].startswith("req1/")
+
+            # ---- requester deleted: instance must go to SLEEP, not die
+            ks.delete("Pod", NS, "req1")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                pod = ks.get("Pod", NS, "launcher-live")
+                if (pod["metadata"].get("labels") or {}).get(C.SLEEPING_LABEL) == "true":
+                    break
+                await asyncio.sleep(0.3)
+            assert requests.get(engine + "/is_sleeping", timeout=5).json() == {
+                "is_sleeping": True
+            }
+            inv = requests.get(
+                f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
+                timeout=5,
+            ).json()
+            assert inv["total_instances"] == 1, "instance survives unbind asleep"
+
+            # ---- warm re-actuation: SAME instance wakes, same greedy output
+            # (a real re-actuation gets a FRESH requester pod; reset the
+            # long-lived stub's ready flag to model that)
+            requests.post(
+                f"http://127.0.0.1:{spi_port}/v1/become-unready", timeout=5
+            )
+            add_requester("req2")
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    if requests.get(
+                        f"http://127.0.0.1:{probes_port}/ready", timeout=1
+                    ).status_code == 200:
+                        break
+                except requests.RequestException:
+                    pass
+                await asyncio.sleep(0.3)
+            assert (
+                requests.get(f"http://127.0.0.1:{probes_port}/ready", timeout=2).status_code
+                == 200
+            )
+            assert requests.get(engine + "/is_sleeping", timeout=5).json() == {
+                "is_sleeping": False
+            }
+            inv = requests.get(
+                f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
+                timeout=5,
+            ).json()
+            assert inv["total_instances"] == 1, "warm hit must reuse, not recreate"
+            out2 = requests.post(
+                engine + "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 3},
+                timeout=60,
+            ).json()["choices"][0]["token_ids"]
+            assert out2 == out1, "wake must restore identical greedy serving"
+        finally:
+            await ctl.stop()
+            await transports.close()
+            await ks.stop()
+
+    asyncio.run(scenario())
