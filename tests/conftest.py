@@ -23,6 +23,25 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_subprocess_env(**extra) -> dict:
+    """Environment for a CPU-only child process (launcher/requester/engine).
+
+    The image carries a TPU plugin site-package on PYTHONPATH whose
+    registration hook forces `jax_platforms="axon,cpu"` — overriding the
+    JAX_PLATFORMS env var — so every subprocess that inits a jax backend
+    grabs the (single, exclusive) TPU tunnel and hangs or contends. Child
+    processes can't run a post-import config.update the way conftest does,
+    so strip the plugin from PYTHONPATH entirely: no registration, pure CPU.
+    """
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT  # deliberately NOT inheriting .axon_site
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
 
 @pytest.fixture(scope="session")
 def devices8():

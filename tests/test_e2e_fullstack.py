@@ -34,7 +34,6 @@ from llm_d_fast_model_actuation_tpu.controller.kubestore import KubeStore
 
 from fake_apiserver import FakeApiServer
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NS = "e2e"
 NODE = "n1"
 CHIP = "tpu-mock-0-0"
@@ -71,19 +70,18 @@ def wait_http(url: str, timeout: float = 90.0) -> None:
 
 
 def _spawn(args, log_file, **env_extra):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.update(env_extra)
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env(**env_extra)
     # log to a file, never a PIPE nobody drains: chatty children would block
     # on a full pipe buffer and wedge the whole stack
-    out = open(log_file, "wb")
-    return subprocess.Popen(
-        [sys.executable, "-m", *args],
-        env=env,
-        stdout=out,
-        stderr=subprocess.STDOUT,
-    )
+    with open(log_file, "wb") as out:
+        return subprocess.Popen(
+            [sys.executable, "-m", *args],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+        )
 
 
 @pytest.fixture(scope="module")
@@ -94,6 +92,7 @@ def stack(tmp_path_factory):
     srv = FakeApiServer()
     srv.start()
     spi_port, probes_port = free_port(), free_port()
+    logs = tmp_path_factory.mktemp("proc-logs")
     try:
         procs.append(
             _spawn(
@@ -110,7 +109,8 @@ def stack(tmp_path_factory):
                     str(C.LAUNCHER_SERVICE_PORT),
                     "--log-dir",
                     str(tmp_path_factory.mktemp("launcher-logs")),
-                ]
+                ],
+                logs / "launcher.log",
             )
         )
         procs.append(
@@ -127,7 +127,8 @@ def stack(tmp_path_factory):
                     str(spi_port),
                     "--probes-port",
                     str(probes_port),
-                ]
+                ],
+                logs / "requester.log",
             )
         )
         wait_http(f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/health")

@@ -17,8 +17,6 @@ import time
 import pytest
 import requests
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -45,10 +43,13 @@ def wait_http(url: str, timeout: float = 60.0) -> None:
 def launcher(tmp_path_factory):
     port = free_port()
     log_dir = str(tmp_path_factory.mktemp("launcher-logs"))
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.Popen(
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
+    # log to a file, never a PIPE nobody drains (a full pipe buffer would
+    # wedge the launcher and everything behind it)
+    with open(os.path.join(log_dir, "launcher-stdout.log"), "wb") as out:
+        proc = subprocess.Popen(
         [
             sys.executable,
             "-m",
@@ -66,7 +67,7 @@ def launcher(tmp_path_factory):
             log_dir,
         ],
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=out,
         stderr=subprocess.STDOUT,
     )
     base = f"http://127.0.0.1:{port}"
