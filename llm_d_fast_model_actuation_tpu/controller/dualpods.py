@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import secrets
 import logging
 import time
 from dataclasses import dataclass, field
@@ -48,7 +49,7 @@ from .directpath import (
     nominal_provider_pod,
     render_server_patch,
 )
-from .store import Conflict, InMemoryStore, NotFound
+from .store import AlreadyExists, Conflict, InMemoryStore, NotFound
 
 logger = logging.getLogger(__name__)
 
@@ -353,7 +354,7 @@ class DualPodsController:
         if _deleting(req):
             if provider is not None:
                 await self._ensure_unbound(ns, provider)
-            self._remove_finalizer("Pod", ns, name)
+            await self._remove_finalizer("Pod", ns, name)
             self.server_data.pop(uid, None)
             return
 
@@ -361,17 +362,21 @@ class DualPodsController:
             # exogenous provider deletion: relay to the requester (with UID
             # precondition), then let the provider finish dying.
             try:
-                self.store.delete("Pod", ns, name, expect_uid=uid)
+                await asyncio.to_thread(
+                    self.store.delete, "Pod", ns, name, expect_uid=uid
+                )
             except (NotFound, Conflict):
                 pass
-            self._remove_finalizer("Pod", ns, provider["metadata"]["name"])
+            await self._remove_finalizer("Pod", ns, provider["metadata"]["name"])
             for key in self._duality_up.pop(provider["metadata"]["name"], []):
                 M.DUALITY.labels(isc_name=key[0], chip=key[1], node=key[2]).set(0)
             return
 
         if provider is not None and pod_in_trouble(provider):
             logger.warning("provider %s in trouble; deleting", provider["metadata"]["name"])
-            self.store.delete("Pod", ns, provider["metadata"]["name"])
+            await asyncio.to_thread(
+                self.store.delete, "Pod", ns, provider["metadata"]["name"]
+            )
             return
 
         # node must be schedulable/known
@@ -396,7 +401,7 @@ class DualPodsController:
         isc_name = ann.get(C.INFERENCE_SERVER_CONFIG_ANNOTATION, "")
         patch_tmpl = ann.get(C.SERVER_PATCH_ANNOTATION, "")
         if isc_name and patch_tmpl:
-            self._set_status(
+            await self._set_status(
                 ns,
                 name,
                 ["server-patch and inference-server-config are mutually exclusive"],
@@ -412,11 +417,11 @@ class DualPodsController:
             await self._reconcile_direct(ns, req, provider, patch_tmpl, node, sd)
             return
         if not isc_name:
-            self._set_status(ns, name, ["no inference-server-config annotation"])
+            await self._set_status(ns, name, ["no inference-server-config annotation"])
             return
         isc_obj = self.store.try_get(InferenceServerConfig.KIND, ns, isc_name)
         if isc_obj is None:
-            self._set_status(ns, name, [f"InferenceServerConfig {isc_name} not found"])
+            await self._set_status(ns, name, [f"InferenceServerConfig {isc_name} not found"])
             raise Retry(f"ISC {isc_name} missing", after=0.5)
         isc = InferenceServerConfig.from_dict(isc_obj)
 
@@ -474,11 +479,11 @@ class DualPodsController:
     ) -> Optional[Dict[str, Any]]:
         lc_name = isc.spec.launcher_config_name
         if not lc_name:
-            self._set_status(ns, req["metadata"]["name"], ["ISC has no launcherConfigName"])
+            await self._set_status(ns, req["metadata"]["name"], ["ISC has no launcherConfigName"])
             return None
         lc_obj = self.store.try_get(LauncherConfig.KIND, ns, lc_name)
         if lc_obj is None:
-            self._set_status(ns, req["metadata"]["name"], [f"LauncherConfig {lc_name} not found"])
+            await self._set_status(ns, req["metadata"]["name"], [f"LauncherConfig {lc_name} not found"])
             raise Retry(f"LauncherConfig {lc_name} missing", after=0.5)
         lc = LauncherConfig.from_dict(lc_obj)
         node = req["spec"]["nodeName"]
@@ -621,10 +626,9 @@ class DualPodsController:
     ) -> Optional[Dict[str, Any]]:
         pod, _ = self._launcher_template(lc, node)
         pod["metadata"]["namespace"] = ns
-        pod["metadata"]["name"] = f"{lc.metadata.name}-{node}-{int(time.time()*1000)%100000}"
         self._stamp_binding(pod, req, isc_name, sd)
         t0 = time.monotonic()
-        created = self.store.create(pod)
+        created = await self._create_unique(pod, f"{lc.metadata.name}-{node}")
         if self.cfg.launcher_runtime is not None:
             await self.cfg.launcher_runtime(created)
         M.LAUNCHER_CREATE_SECONDS.labels(lcfg_name=lc.metadata.name).observe(
@@ -672,7 +676,9 @@ class DualPodsController:
                 self._stamp_binding(pod, req, isc_name, sd)
                 return pod
 
-            bound = self.store.mutate("Pod", ns, name, apply)
+            bound = await asyncio.to_thread(
+                self.store.mutate, "Pod", ns, name, apply
+            )
         except (Conflict, NotFound) as e:
             raise Retry(f"bind {name}: {e}", after=0.1)
         ld = self.launcher_data.setdefault(name, LauncherData())
@@ -717,8 +723,12 @@ class DualPodsController:
                 await handle.delete_instance(sd.instance_id)
             except InstanceNotFound:
                 pass
-            self.store.delete(
-                "Pod", ns, req["metadata"]["name"], expect_uid=req["metadata"]["uid"]
+            await asyncio.to_thread(
+                self.store.delete,
+                "Pod",
+                ns,
+                req["metadata"]["name"],
+                expect_uid=req["metadata"]["uid"],
             )
             return
         if inst is None:
@@ -749,9 +759,9 @@ class DualPodsController:
         # readiness relay + deferred routing labels
         healthy = await engine.healthy()
         if healthy:
-            self._apply_routing_metadata(ns, pname, isc)
-            self._apply_sleeping_label(ns, pname, "false")
-            self._ensure_req_state(ns, req, sd, pname)
+            await self._apply_routing_metadata(ns, pname, isc)
+            await self._apply_sleeping_label(ns, pname, "false")
+            await self._ensure_req_state(ns, req, sd, pname)
             if sd.readiness_relayed is not True:
                 spi = self.transports.requester_spi(req)
                 try:
@@ -775,8 +785,8 @@ class DualPodsController:
                         ).set(1)
                     self._duality_up[pname] = keys
         else:
-            self._apply_sleeping_label(ns, pname, "false")
-            self._ensure_req_state(ns, req, sd, pname)
+            await self._apply_sleeping_label(ns, pname, "false")
+            await self._ensure_req_state(ns, req, sd, pname)
             if sd.readiness_relayed is True:
                 spi = self.transports.requester_spi(req)
                 try:
@@ -823,7 +833,7 @@ class DualPodsController:
             patch = render_server_patch(patch_tmpl, ProviderData(node_name=node))
             nominal = nominal_provider_pod(req, patch, node, sd.chip_ids or [], chip_map)
         except ValueError as e:
-            self._set_status(ns, name, [f"server-patch: {e}"])
+            await self._set_status(ns, name, [f"server-patch: {e}"])
             return
         want_hash = nominal["metadata"]["annotations"][NOMINAL_HASH_ANNOTATION]
         if provider is not None:
@@ -848,7 +858,9 @@ class DualPodsController:
                 sd.path = sd.path or "warm"
                 provider = await self._bind_direct(ns, req, twin)
             else:
-                self._enforce_sleeper_budget(ns, node, sd.chip_ids or [])
+                await asyncio.to_thread(
+                    self._enforce_sleeper_budget, ns, node, sd.chip_ids or []
+                )
                 provider = await self._create_direct_provider(ns, req, nominal, sd)
             if provider is None:
                 raise Retry("direct provider not available yet", after=0.2)
@@ -894,7 +906,9 @@ class DualPodsController:
                     fins.append(FINALIZER)
                 return pod
 
-            bound = self.store.mutate("Pod", ns, name, apply)
+            bound = await asyncio.to_thread(
+                self.store.mutate, "Pod", ns, name, apply
+            )
         except (Conflict, NotFound) as e:
             raise Retry(f"bind twin {name}: {e}", after=0.1)
         logger.info("bound %s -> sleeping twin %s", rm["name"], name)
@@ -910,14 +924,13 @@ class DualPodsController:
         rm = req["metadata"]
         pod = nominal
         pod["metadata"]["namespace"] = ns
-        pod["metadata"]["name"] = f"{rm['name']}-provider-{int(time.time()*1000)%100000}"
         ann = _ann(pod)
         ann[C.REQUESTER_ANNOTATION] = f"{rm['name']}/{rm['uid']}"
         _labels(pod)[C.DUAL_LABEL] = rm["name"]
         fins = _meta(pod).setdefault("finalizers", [])
         if FINALIZER not in fins:
             fins.append(FINALIZER)
-        created = self.store.create(pod)
+        created = await self._create_unique(pod, f"{rm['name']}-provider")
         if self.cfg.provider_runtime is not None:
             await self.cfg.provider_runtime(created)
         sd.path = "cold"
@@ -969,7 +982,7 @@ class DualPodsController:
         for chip in chip_ids:
             on_chip = [p for p in sleepers if chip in chips_of(p)]
             on_chip.sort(key=last_used)
-            while len(on_chip) >= limit:
+            while len(on_chip) > limit:
                 victim = on_chip.pop(0)
                 vname = victim["metadata"]["name"]
                 try:
@@ -1002,8 +1015,8 @@ class DualPodsController:
         sd.sleeping = False
 
         healthy = await engine.healthy()
-        self._apply_sleeping_label(ns, pname, "false")
-        self._ensure_req_state(ns, req, sd, pname)
+        await self._apply_sleeping_label(ns, pname, "false")
+        await self._ensure_req_state(ns, req, sd, pname)
         if not healthy:
             if sd.readiness_relayed is True:
                 try:
@@ -1059,7 +1072,7 @@ class DualPodsController:
             return pod
 
         try:
-            self.store.mutate("Pod", ns, pname, apply)
+            await asyncio.to_thread(self.store.mutate, "Pod", ns, pname, apply)
         except NotFound:
             pass
         for key in self._duality_up.pop(pname, []):
@@ -1083,7 +1096,7 @@ class DualPodsController:
         isc_name = ann.get(ISC_NAME_ANNOTATION, "")
 
         # de-route before sleeping (EPP must stop routing first)
-        self._remove_routing_metadata(ns, pname)
+        await self._remove_routing_metadata(ns, pname)
 
         if instance_id:
             obsolete = self._instance_obsolete(ns, isc_name, instance_id, ann)
@@ -1131,7 +1144,7 @@ class DualPodsController:
             return pod
 
         try:
-            self.store.mutate("Pod", ns, pname, apply)
+            await asyncio.to_thread(self.store.mutate, "Pod", ns, pname, apply)
         except NotFound:
             pass
         for key in self._duality_up.pop(pname, []):
@@ -1252,7 +1265,29 @@ class DualPodsController:
             except json.JSONDecodeError:
                 pass
 
-    def _apply_routing_metadata(
+    async def _amutate(self, kind: str, ns: str, name: str, fn) -> None:
+        """`store.mutate` off the event loop (writes are blocking HTTP),
+        swallowing NotFound (the object died; nothing to stamp)."""
+        try:
+            await asyncio.to_thread(self.store.mutate, kind, ns, name, fn)
+        except NotFound:
+            pass
+
+    async def _create_unique(
+        self, pod: Dict[str, Any], prefix: str
+    ) -> Dict[str, Any]:
+        """`metadata.generateName` semantics without server support in every
+        test store: random suffix + retry on AlreadyExists (replaces the old
+        time-derived suffix that wrapped every 100 s)."""
+        for _ in range(8):
+            pod["metadata"]["name"] = f"{prefix}-{secrets.token_hex(3)}"
+            try:
+                return await asyncio.to_thread(self.store.create, pod)
+            except AlreadyExists:
+                continue
+        raise Retry(f"no free pod name under prefix {prefix}", after=0.2)
+
+    async def _apply_routing_metadata(
         self, ns: str, provider_name: str, isc: InferenceServerConfig
     ) -> None:
         esc = isc.spec.engine_server_config
@@ -1283,12 +1318,9 @@ class DualPodsController:
             a[C.ISC_ROUTING_METADATA_ANNOTATION] = canonical_json(routing)
             return pod
 
-        try:
-            self.store.mutate("Pod", ns, provider_name, apply)
-        except NotFound:
-            pass
+        await self._amutate("Pod", ns, provider_name, apply)
 
-    def _remove_routing_metadata(self, ns: str, provider_name: str) -> None:
+    async def _remove_routing_metadata(self, ns: str, provider_name: str) -> None:
         def apply(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             a = _ann(pod)
             raw = a.get(C.ISC_ROUTING_METADATA_ANNOTATION)
@@ -1305,24 +1337,18 @@ class DualPodsController:
             a.pop(C.ISC_ROUTING_METADATA_ANNOTATION, None)
             return pod
 
-        try:
-            self.store.mutate("Pod", ns, provider_name, apply)
-        except NotFound:
-            pass
+        await self._amutate("Pod", ns, provider_name, apply)
 
-    def _apply_sleeping_label(self, ns: str, pod_name: str, value: str) -> None:
+    async def _apply_sleeping_label(self, ns: str, pod_name: str, value: str) -> None:
         def apply(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             if _labels(pod).get(C.SLEEPING_LABEL) == value:
                 return None
             _labels(pod)[C.SLEEPING_LABEL] = value
             return pod
 
-        try:
-            self.store.mutate("Pod", ns, pod_name, apply)
-        except NotFound:
-            pass
+        await self._amutate("Pod", ns, pod_name, apply)
 
-    def _ensure_req_state(
+    async def _ensure_req_state(
         self, ns: str, req: Dict[str, Any], sd: ServerData, provider_name: str
     ) -> None:
         """Status ann, accelerators ann, dual/instance labels, finalizer — one
@@ -1352,12 +1378,9 @@ class DualPodsController:
                 changed = True
             return pod if changed else None
 
-        try:
-            self.store.mutate("Pod", ns, name, apply)
-        except NotFound:
-            pass
+        await self._amutate("Pod", ns, name, apply)
 
-    def _set_status(self, ns: str, req_name: str, errors: List[str]) -> None:
+    async def _set_status(self, ns: str, req_name: str, errors: List[str]) -> None:
         def apply(pod: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             a = _ann(pod)
             want = canonical_json({"Errors": errors})
@@ -1366,12 +1389,9 @@ class DualPodsController:
             a[C.STATUS_ANNOTATION] = want
             return pod
 
-        try:
-            self.store.mutate("Pod", ns, req_name, apply)
-        except NotFound:
-            pass
+        await self._amutate("Pod", ns, req_name, apply)
 
-    def _remove_finalizer(self, kind: str, ns: str, name: str) -> None:
+    async def _remove_finalizer(self, kind: str, ns: str, name: str) -> None:
         def apply(obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
             fins = obj["metadata"].get("finalizers") or []
             if FINALIZER not in fins:
@@ -1380,7 +1400,4 @@ class DualPodsController:
             obj["metadata"]["finalizers"] = fins
             return obj
 
-        try:
-            self.store.mutate(kind, ns, name, apply)
-        except NotFound:
-            pass
+        await self._amutate(kind, ns, name, apply)

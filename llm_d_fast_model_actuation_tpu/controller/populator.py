@@ -22,6 +22,7 @@ re-reconcile scheduled at the next phase-flip instant.
 from __future__ import annotations
 
 import asyncio
+import secrets
 import json
 import logging
 import time
@@ -241,12 +242,14 @@ class Populator:
             kind, name = await self._digest_queue.get()
             self._inflight += 1
             try:
+                # digests do blocking HTTP status writes + O(nodes x LPPs)
+                # recompute: keep the event loop free
                 if kind == LauncherConfig.KIND:
-                    self._digest_lc(name)
+                    await asyncio.to_thread(self._digest_lc, name)
                 elif kind == LauncherPopulationPolicy.KIND:
-                    self._digest_lpp(name)
+                    await asyncio.to_thread(self._digest_lpp, name)
                 else:  # Node
-                    self._digest_node(name)
+                    await asyncio.to_thread(self._digest_node, name)
             except Exception:
                 logger.exception("digest of %s %s failed", kind, name)
             finally:
@@ -330,9 +333,18 @@ class Populator:
                         entry.desired = max(entry.desired, cfl.launcher_count)
         old_keys = set(self.policy.keys())
         self.policy.digest = new_digest
-        # enqueue changed + vanished keys
-        for key in set(self.policy.keys()) | old_keys:
-            self._key_queue.put_nowait(key)
+        # enqueue changed + vanished keys; digests run off-loop (to_thread),
+        # so hop through call_soon_threadsafe when not on the loop
+        keys = set(self.policy.keys()) | old_keys
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        for key in keys:
+            if on_loop or self._loop is None:
+                self._key_queue.put_nowait(key)
+            else:
+                self._loop.call_soon_threadsafe(self._key_queue.put_nowait, key)
 
     def _digest_lc_obj(self, name: str, obj: Dict[str, Any]) -> None:
         lc = LauncherConfig.from_dict(obj)
@@ -447,7 +459,8 @@ class Populator:
         for p in to_delete:
             m = p["metadata"]
             try:
-                self.store.delete(
+                await asyncio.to_thread(
+                    self.store.delete,
                     "Pod",
                     self.cfg.namespace,
                     m["name"],
@@ -467,9 +480,9 @@ class Populator:
                 pod = specialize_to_node(lcd.obj, node, lcd.template_hash)
                 pod["metadata"]["namespace"] = self.cfg.namespace
                 pod["metadata"]["name"] = (
-                    f"{lc_name}-{node}-p{int(time.monotonic()*1e6) % 10**9}-{i}"
+                    f"{lc_name}-{node}-p{secrets.token_hex(4)}"
                 )
-                created = self.store.create(pod)
+                created = await asyncio.to_thread(self.store.create, pod)
                 exp.expect_creation(created["metadata"]["uid"])
                 if self.cfg.launcher_runtime is not None:
                     await self.cfg.launcher_runtime(created)

@@ -5,13 +5,26 @@ owning sharded params, the page pool, and two compiled programs:
 
   * ``_prefill_fn``  — batch-1 prompt ingestion, bucketed to power-of-two
     lengths so at most log2(max_seq) prefill programs are ever compiled;
-  * ``_step_fn``     — one fused decode+sample step for the whole slot batch,
-    cache donated so page updates are in-place in HBM.
+    samples the first token *inside* the program;
+  * ``_chunk_fn(T)`` — T fused decode+sample steps (``lax.scan`` over steps)
+    for the whole slot batch, cache donated so page updates are in-place in
+    HBM. Exactly two chunk programs ever compile: T = ``decode_chunk``
+    (steady state) and T = 1 (drain tail) — compiles are expensive on TPU.
 
 Decode runs every slot every step (static shapes; empty slots write to the
 reserved null page and their outputs are ignored) — the XLA-friendly version
 of continuous batching: requests join/leave by host-side slot bookkeeping,
 the compiled step never changes shape.
+
+The serving path contains NO eager jax ops: scheduler state (last tokens,
+positions, per-slot budgets, page table, temperatures, RNG key data) lives in
+device arrays threaded through the compiled programs, and the host only
+uploads fresh state after an admission/retire edge and downloads the [T, b]
+token block once per chunk. This matters twice on TPU: per-op dispatch is
+expensive (each eager op is a host round-trip), and eager ops re-specialize
+(recompile) when array commitment changes across a sleep/wake cycle — the
+reference-framework "wake must not recompile" contract (README.md:16-26)
+only holds if the hot path is entirely pre-compiled programs.
 """
 
 from __future__ import annotations
@@ -39,9 +52,13 @@ class EngineConfig:
     num_pages: int = 2048
     max_seq_len: int = 0  # 0 -> model.max_seq_len
     eos_token_id: int = -1  # -1 = never stop on EOS
-    #: Attention implementation: "reference" (pure XLA) or "pallas"
-    #: (hand-written TPU kernels; interpreter mode off-TPU).
-    attention_impl: str = "reference"
+    #: Attention implementation: "auto" (pallas on TPU, grouped elsewhere),
+    #: "grouped" (GQA-grouped XLA, deferred cache scatter), "pallas"
+    #: (hand-written TPU kernels; interpreter mode off-TPU), or "reference"
+    #: (scatter-first + repeat-KV XLA — the parity baseline).
+    attention_impl: str = "auto"
+    #: Max decode steps fused into one compiled program dispatch.
+    decode_chunk: int = 8
 
     @property
     def seq_len(self) -> int:
@@ -50,6 +67,12 @@ class EngineConfig:
     @property
     def pages_per_seq(self) -> int:
         return -(-self.seq_len // self.page_size)
+
+
+def resolve_attention_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "grouped"
+    return impl
 
 
 @dataclass
@@ -80,19 +103,25 @@ class InferenceEngine:
         mesh: Optional[Mesh] = None,
         seed: int = 0,
     ) -> None:
+        impl = resolve_attention_impl(cfg.attention_impl)
         self.cfg = cfg
         self.mesh = mesh
         # thread the attention impl through the model config (per-engine, not
         # a process global — two engines must not clobber each other)
         m = cfg.model
-        if m.attention_impl != cfg.attention_impl:
+        if m.attention_impl != impl:
             import dataclasses
 
-            m = dataclasses.replace(m, attention_impl=cfg.attention_impl)
+            m = dataclasses.replace(m, attention_impl=impl)
         if params is None:
             params = llama.init_params(jax.random.key(seed), m)
         if mesh is not None:
             params = shard_pytree(params, mesh, llama.param_logical_axes(m))
+        else:
+            # Commit to the default device: committed-ness is part of the jit
+            # cache key, and the post-wake device_put restore produces
+            # committed arrays — starting committed keeps one compiled set.
+            params = jax.device_put(params, jax.devices()[0])
         self.params = params
         self.pool = PagePool.create(
             m.num_layers,
@@ -103,39 +132,120 @@ class InferenceEngine:
             dtype=m.dtype,
             mesh=mesh,
         )
+        if mesh is None:
+            self.pool.replace(
+                jax.device_put(self.pool.as_tuple(), jax.devices()[0])
+            )
         self.allocator = PageAllocator(cfg.num_pages)
         b, p = cfg.max_batch, cfg.pages_per_seq
+        # Host mirrors of the device scheduler state (source of truth between
+        # chunks; re-uploaded only after an admission/retire/prefill edge).
         self._page_table = np.zeros((b, p), dtype=np.int32)
         self._positions = np.zeros((b,), dtype=np.int32)
         self._last_tokens = np.zeros((b,), dtype=np.int32)
         self._temps = np.zeros((b,), dtype=np.float32)
+        self._budgets = np.zeros((b,), dtype=np.int32)
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
         self._next_seq_id = 1
-        self._rng = jax.random.key(seed + 1)
+        self._raw_key: Any = np.asarray(
+            jax.random.key_data(jax.random.key(seed + 1))
+        )  # uint32 key data; device-resident after first upload
+        self._dev: Optional[Dict[str, Any]] = None  # device scheduler arrays
+        self._dirty = True
 
         model_cfg = m
+        self._model_cfg = m
 
-        def _prefill(params, tokens, seq_lens, cache, page_table):
+        def _prefill(params, tokens, seq_lens, cache, page_table, temp, raw_key):
             logits, cache = llama.prefill(
                 params, model_cfg, tokens, seq_lens, cache, page_table
             )
             last = jnp.take_along_axis(
                 logits, (seq_lens - 1)[:, None, None], axis=1
             )[:, 0]
-            return last, cache
+            key = jax.random.wrap_key_data(raw_key)
+            key, sub = jax.random.split(key)
+            tok = sample(last, sub, temp)
+            return tok, cache, jax.random.key_data(key)
 
         # cache (arg 3) donated: prefill updates pages in place.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
+        self._chunk_fns: Dict[int, Any] = {}
 
-        def _step(params, tokens, positions, cache, page_table, temps, key):
-            logits, cache = llama.decode_step(
-                params, model_cfg, tokens, positions, cache, page_table
+    # -- compiled decode chunk ----------------------------------------------
+
+    def _make_chunk_fn(self, T: int):
+        model_cfg = self._model_cfg
+        eos = self.cfg.eos_token_id
+
+        def chunk(params, lt, pos, budget, cache, page_table, temps, raw_key):
+            key = jax.random.wrap_key_data(raw_key)
+
+            def body(carry, _):
+                lt, pos, budget, cache, key = carry
+                active = budget > 0
+                logits, cache = llama.decode_step(
+                    params, model_cfg, lt, pos, cache, page_table, active
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample(logits, sub, temps)
+                nxt = jnp.where(active, nxt, lt)
+                a32 = active.astype(jnp.int32)
+                pos = pos + a32
+                budget = budget - a32
+                if eos >= 0:
+                    budget = jnp.where(active & (nxt == eos), 0, budget)
+                return (nxt, pos, budget, cache, key), nxt
+
+            (lt, pos, budget, cache, key), toks = jax.lax.scan(
+                body, (lt, pos, budget, cache, key), None, length=T
             )
-            next_tokens = sample(logits, key, temps)
-            return next_tokens, cache
+            return toks, lt, pos, budget, cache, jax.random.key_data(key)
 
-        self._step_fn = jax.jit(_step, donate_argnums=(3,))
+        # donate scheduler state + cache + key data (all replaced each call)
+        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 7))
+
+    def _chunk_fn(self, T: int):
+        fn = self._chunk_fns.get(T)
+        if fn is None:
+            fn = self._chunk_fns[T] = self._make_chunk_fn(T)
+        return fn
+
+    # -- device scheduler state ---------------------------------------------
+
+    def _upload_sched(self) -> None:
+        """Push host scheduler mirrors to device (one transfer per array)."""
+        self._dev = {
+            "lt": jax.device_put(self._last_tokens),
+            "pos": jax.device_put(self._positions),
+            "budget": jax.device_put(self._budgets),
+            "pt": jax.device_put(self._page_table),
+            "temps": jax.device_put(self._temps),
+        }
+        if isinstance(self._raw_key, np.ndarray):
+            self._raw_key = jax.device_put(self._raw_key)
+        self._dirty = False
+
+    def drop_device_sched_state(self) -> None:
+        """Forget device scheduler arrays (sleep path). Host mirrors remain
+        the source of truth; the next chunk re-uploads them."""
+        if self._raw_key is not None and not isinstance(self._raw_key, np.ndarray):
+            self._raw_key = np.asarray(self._raw_key)
+        self._dev = None
+        self._dirty = True
+
+    def on_device_reacquire(self) -> None:
+        """After a device-releasing sleep, the PJRT client was re-created:
+        rebuild the engine's device-bound objects (its mesh) on the new
+        device handles. Compiled programs re-lower lazily through the
+        persistent compile cache."""
+        if self.mesh is not None:
+            from .device import rebuild_mesh
+
+            self.mesh = rebuild_mesh(
+                tuple(self.mesh.axis_names), tuple(self.mesh.devices.shape)
+            )
 
     # -- request lifecycle --------------------------------------------------
 
@@ -189,6 +299,7 @@ class InferenceEngine:
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
         row[: len(req.pages)] = req.pages
         self._page_table[slot] = row
+        self._dirty = True
         return True
 
     def _prefill_bucket(self, n: int) -> int:
@@ -204,26 +315,25 @@ class InferenceEngine:
         tokens[0, :n] = req.prompt
         seq_lens = np.array([n], dtype=np.int32)
         table = self._page_table[req.slot : req.slot + 1]
-        last_logits, cache = self._prefill_fn(
+        temp = np.asarray([req.temperature], dtype=np.float32)
+        tok, cache, self._raw_key = self._prefill_fn(
             self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(seq_lens),
+            tokens,
+            seq_lens,
             self.pool.as_tuple(),
-            jnp.asarray(table),
+            table,
+            temp,
+            self._raw_key,
         )
         self.pool.replace(cache)
-        self._rng, key = jax.random.split(self._rng)
-        tok = sample(
-            last_logits,
-            key,
-            jnp.asarray([req.temperature], dtype=jnp.float32),
-        )
-        first = int(tok[0])
+        first = int(np.asarray(tok)[0])
         req.pos = n
         self._emit(req, first)
         self._positions[req.slot] = req.pos  # position of the token to place
         self._last_tokens[req.slot] = first
         self._temps[req.slot] = req.temperature
+        self._budgets[req.slot] = req.max_new_tokens - len(req.out_tokens)
+        self._dirty = True
 
     def _emit(self, req: Request, token: int) -> None:
         if req.first_token_time is None:
@@ -241,13 +351,16 @@ class InferenceEngine:
         self._page_table[req.slot] = 0
         self._positions[req.slot] = 0
         self._last_tokens[req.slot] = 0
+        self._budgets[req.slot] = 0
         req.slot = -1
+        self._dirty = True
 
     # -- the engine loop body ----------------------------------------------
 
     def step(self) -> List[Request]:
-        """Admit + prefill waiting requests, then one decode step for the
-        running batch. Returns requests that finished this step."""
+        """Admit + prefill waiting requests, then one decode *chunk* (up to
+        ``decode_chunk`` fused steps) for the running batch. Returns requests
+        that finished."""
         if self.params is None:
             raise EngineAsleep("engine state is offloaded (sleeping)")
         finished: List[Request] = []
@@ -262,29 +375,51 @@ class InferenceEngine:
                 self._retire(req)
                 finished.append(req)
 
-        running = [r for r in self._slots if r is not None]
+        running = {
+            r.slot: r for r in self._slots if r is not None and not r.done
+        }
         if running:
-            self._rng, key = jax.random.split(self._rng)
-            next_tokens, cache = self._step_fn(
+            max_remaining = max(
+                r.max_new_tokens - len(r.out_tokens) for r in running.values()
+            )
+            # Exactly two compiled chunk programs (T=decode_chunk and T=1):
+            # compiles are expensive on TPU, and a serving engine at steady
+            # state always has >= decode_chunk tokens of demand. The drain
+            # tail of a batch run falls back to single steps.
+            T = self.cfg.decode_chunk if max_remaining >= self.cfg.decode_chunk else 1
+            if self._dirty or self._dev is None:
+                self._upload_sched()
+            d = self._dev
+            toks_dev, lt, pos, budget, cache, self._raw_key = self._chunk_fn(T)(
                 self.params,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._positions),
+                d["lt"],
+                d["pos"],
+                d["budget"],
                 self.pool.as_tuple(),
-                jnp.asarray(self._page_table),
-                jnp.asarray(self._temps),
-                key,
+                d["pt"],
+                d["temps"],
+                self._raw_key,
             )
             self.pool.replace(cache)
-            toks = np.asarray(next_tokens)
-            for req in running:
-                tok = int(toks[req.slot])
-                req.pos += 1
-                self._positions[req.slot] = req.pos
-                self._last_tokens[req.slot] = tok
-                self._emit(req, tok)
-                if req.done:
-                    self._retire(req)
-                    finished.append(req)
+            self._dev = {
+                "lt": lt, "pos": pos, "budget": budget,
+                "pt": d["pt"], "temps": d["temps"],
+            }
+            toks = np.asarray(toks_dev)  # ONE host sync per chunk
+            for t in range(T):
+                for slot, req in list(running.items()):
+                    tok = int(toks[t, slot])
+                    req.pos += 1
+                    self._positions[slot] = req.pos
+                    self._last_tokens[slot] = tok
+                    self._emit(req, tok)
+                    # keep the budget mirror exact: a dirty re-upload with a
+                    # stale budget would un-freeze finished slots on device
+                    self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)
+                    if req.done:
+                        self._retire(req)
+                        finished.append(req)
+                        del running[slot]
         return finished
 
     def has_work(self) -> bool:

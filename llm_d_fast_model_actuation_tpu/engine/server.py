@@ -72,6 +72,26 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=512)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--eos-token-id", type=int, default=-1)
+    p.add_argument(
+        "--attention-impl",
+        default="auto",
+        choices=["auto", "reference", "grouped", "pallas"],
+        help="decode attention implementation (auto = pallas on TPU, "
+        "grouped XLA elsewhere)",
+    )
+    p.add_argument(
+        "--decode-chunk",
+        type=int,
+        default=8,
+        help="max decode steps fused into one compiled dispatch",
+    )
+    p.add_argument(
+        "--sleep-release-devices",
+        default="auto",
+        choices=["auto", "always", "never"],
+        help="tear down the TPU client on sleep so other instances can use "
+        "the chip (auto = on for TPU, off elsewhere)",
+    )
     return p
 
 
@@ -82,6 +102,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         )
     if args.tensor_parallel_size < 1:
         raise ValueError("--tensor-parallel-size must be >= 1")
+    if args.decode_chunk < 1:
+        raise ValueError("--decode-chunk must be >= 1")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -121,11 +143,20 @@ class EngineService:
                 num_pages=args.num_pages,
                 max_seq_len=args.max_model_len or 0,
                 eos_token_id=args.eos_token_id,
+                attention_impl=args.attention_impl,
+                decode_chunk=args.decode_chunk,
             ),
             mesh=mesh,
             seed=args.seed,
         )
         self.sleeper = attach_sleep(self.engine)
+        mode = getattr(args, "sleep_release_devices", "auto")
+        import jax
+
+        self.release_on_sleep = (
+            mode == "always"
+            or (mode == "auto" and jax.default_backend() == "tpu")
+        )
         self._publisher = self._make_publisher()
         self._publish_usage()
         self._thread = threading.Thread(target=self._run, daemon=True, name="engine-loop")
@@ -209,7 +240,7 @@ class EngineService:
 
     def sleep(self, level: int) -> Dict[str, Any]:
         with self._lock:
-            out = self.sleeper.sleep(level)
+            out = self.sleeper.sleep(level, release=self.release_on_sleep)
         self._publish_usage()
         return out
 
@@ -285,7 +316,15 @@ def build_app(service: EngineService) -> web.Application:
         return web.json_response({"status": "OK"})
 
     async def is_sleeping(request: web.Request) -> web.Response:
-        return web.json_response({"is_sleeping": service.sleeper.is_sleeping})
+        # `is_sleeping` is the reference wire contract; `devices_released`
+        # is the TPU-specific extra the launcher's chip-exclusivity probe
+        # needs (sleeping-but-client-open still holds the chip).
+        return web.json_response(
+            {
+                "is_sleeping": service.sleeper.is_sleeping,
+                "devices_released": service.sleeper.devices_released,
+            }
+        )
 
     async def sleep(request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))

@@ -1,5 +1,5 @@
-"""Level-1 sleep/wake: move live model state HBM <-> host without killing
-the process.
+"""Sleep/wake: move live model state HBM <-> host without killing the
+process — optionally releasing the TPU itself.
 
 The reference's headline capability (vLLM sleep mode: ~3 s wake for 64 GiB,
 README.md:16-26), rebuilt on XLA memory kinds: every array keeps its sharding
@@ -9,12 +9,23 @@ multi-chip meshes each chip's shard moves independently (no resharding, no
 gather). Wake does NOT recompile: compiled executables are host-resident and
 keyed by sharding+shape, which are unchanged.
 
+**Device release** (`release=True`) goes further than the reference can on
+GPU: the state is snapshotted to plain host numpy and the process's PJRT
+client is destroyed (`engine/device.py`), so the chip is actually free for
+another process — the TPU-correct form of the dual-pods time-sharing
+contract (docs/dual-pods.md:20-56; on TPU a chip has exactly one holder, so
+an HBM-empty-but-client-open sleeper still blocks every other server). Wake
+then re-creates the client, restores state, and re-lowers programs through
+the persistent XLA compile cache instead of recompiling from scratch.
+
 Sleep levels (vLLM vocabulary):
   level 1 — weights and KV pages offloaded to host; wake restores both.
   level 2 — weights discarded entirely (re-init/reload on wake), KV dropped.
 
 Backends without host memory-space support (CPU tests) fall back to
-numpy staging buffers — same state machine, same API.
+numpy staging buffers — same state machine, same API. Release mode works on
+every backend (CPU client re-init is supported), so the full release state
+machine is exercised by the CPU suite.
 """
 
 from __future__ import annotations
@@ -22,10 +33,17 @@ from __future__ import annotations
 import enum
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
+
+from .device import (
+    rebuild_spec,
+    reacquire_devices,
+    release_devices,
+    sharding_spec,
+)
 
 
 class SleepLevel(enum.IntEnum):
@@ -46,9 +64,11 @@ def _platform_supports_host_memory() -> bool:
 class _Stats:
     last_sleep_seconds: float = 0.0
     last_wake_seconds: float = 0.0
+    last_reacquire_seconds: float = 0.0
     bytes_offloaded: int = 0
     sleeps_total: int = 0
     wakes_total: int = 0
+    releases_total: int = 0
 
 
 class SleepManager:
@@ -57,14 +77,26 @@ class SleepManager:
     Usage: ``mgr = SleepManager(get_state, set_state)`` where get/set move a
     pytree of device arrays out of / into the engine. The manager guarantees
     the engine never holds both copies (donation/delete on each edge).
+
+    ``on_reacquire`` (optional) runs after a released client is re-created,
+    before state restore — the engine uses it to rebuild device-bound
+    objects (its mesh).
     """
 
-    def __init__(self, get_state, set_state) -> None:
+    def __init__(
+        self,
+        get_state,
+        set_state,
+        on_reacquire: Optional[Callable[[], None]] = None,
+    ) -> None:
         self._get_state = get_state
         self._set_state = set_state
+        self._on_reacquire = on_reacquire
         self._level = SleepLevel.AWAKE
         self._host_state: Optional[Any] = None
-        self._shardings: Optional[Any] = None
+        self._shardings: Optional[Any] = None  # sharding objects (no release)
+        self._sharding_specs: Optional[Any] = None  # device-free (release)
+        self._released = False
         self._use_memory_kind = _platform_supports_host_memory()
         self.stats = _Stats()
 
@@ -76,16 +108,20 @@ class SleepManager:
     def level(self) -> SleepLevel:
         return self._level
 
+    @property
+    def devices_released(self) -> bool:
+        return self._released
+
     # -- edges ---------------------------------------------------------------
 
-    def sleep(self, level: int = 1) -> Dict[str, Any]:
+    def sleep(self, level: int = 1, release: bool = False) -> Dict[str, Any]:
         level = SleepLevel(level)
         if level == SleepLevel.AWAKE:
             raise ValueError("sleep level must be 1 or 2")
         if self._level != SleepLevel.AWAKE:
             if level == SleepLevel.L2_DISCARD and self._level == SleepLevel.L1_HOST_OFFLOAD:
                 # Escalate 1 -> 2: give the host RAM back too.
-                if self._use_memory_kind and self._host_state is not None:
+                if self._use_memory_kind and not self._released and self._host_state is not None:
                     for leaf in jax.tree.leaves(self._host_state):
                         leaf.delete()
                 self._host_state = None
@@ -94,26 +130,45 @@ class SleepManager:
             return self.describe()
         t0 = time.monotonic()
         state = self._get_state()
-        self._shardings = jax.tree.map(lambda x: x.sharding, state)
         nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
-        if level == SleepLevel.L1_HOST_OFFLOAD:
-            if self._use_memory_kind:
-                host = jax.tree.map(
-                    lambda x: jax.device_put(
-                        x, x.sharding.with_memory_kind("pinned_host")
-                    ),
-                    state,
-                )
-                host = jax.block_until_ready(host)
+        if release:
+            # Plain numpy staging: pinned_host buffers belong to the client
+            # we are about to destroy. Save device-free sharding specs as a
+            # flat list (the specs are tuples, which pytrees would flatten).
+            self._sharding_specs = [
+                sharding_spec(x) for x in jax.tree.leaves(state)
+            ]
+            self._shardings = None
+            if level == SleepLevel.L1_HOST_OFFLOAD:
+                self._host_state = jax.tree.map(np.asarray, state)
             else:
-                host = jax.tree.map(lambda x: np.asarray(x), state)
-            self._host_state = host
+                self._host_state = None
         else:
-            self._host_state = None
+            self._shardings = jax.tree.map(lambda x: x.sharding, state)
+            self._sharding_specs = None
+            if level == SleepLevel.L1_HOST_OFFLOAD:
+                if self._use_memory_kind:
+                    host = jax.tree.map(
+                        lambda x: jax.device_put(
+                            x, x.sharding.with_memory_kind("pinned_host")
+                        ),
+                        state,
+                    )
+                    host = jax.block_until_ready(host)
+                else:
+                    host = jax.tree.map(lambda x: np.asarray(x), state)
+                self._host_state = host
+            else:
+                self._host_state = None
         # Release HBM now, not at GC time.
         for leaf in jax.tree.leaves(state):
             leaf.delete()
+        del state
         self._set_state(None)
+        if release:
+            release_devices()
+            self._released = True
+            self.stats.releases_total += 1
         self._level = level
         self.stats.last_sleep_seconds = time.monotonic() - t0
         self.stats.bytes_offloaded = nbytes if level == SleepLevel.L1_HOST_OFFLOAD else 0
@@ -126,22 +181,40 @@ class SleepManager:
         if self._level == SleepLevel.AWAKE:
             return self.describe()
         t0 = time.monotonic()
+        if self._released:
+            reacquire_devices()
+            self.stats.last_reacquire_seconds = time.monotonic() - t0
+            if self._on_reacquire is not None:
+                self._on_reacquire()
         if self._level == SleepLevel.L1_HOST_OFFLOAD:
-            assert self._host_state is not None and self._shardings is not None
-            state = jax.tree.map(
-                lambda h, sh: jax.device_put(h, sh),
-                self._host_state,
-                self._shardings,
-            )
-            state = jax.block_until_ready(state)
-            if self._use_memory_kind:
-                for leaf in jax.tree.leaves(self._host_state):
-                    leaf.delete()
+            assert self._host_state is not None
+            if self._released:
+                assert self._sharding_specs is not None
+                leaves, treedef = jax.tree.flatten(self._host_state)
+                restored = [
+                    jax.device_put(h, rebuild_spec(spec))
+                    for h, spec in zip(leaves, self._sharding_specs)
+                ]
+                state = jax.tree.unflatten(treedef, restored)
+                state = jax.block_until_ready(state)
+            else:
+                state = jax.tree.map(
+                    lambda h, sh: jax.device_put(h, sh),
+                    self._host_state,
+                    self._shardings,
+                )
+                state = jax.block_until_ready(state)
+                if self._use_memory_kind:
+                    for leaf in jax.tree.leaves(self._host_state):
+                        leaf.delete()
         else:
             if reinit is None:
                 raise ValueError("level-2 wake requires a reinit callback")
             state = reinit()
         self._host_state = None
+        self._sharding_specs = None
+        self._shardings = None
+        self._released = False
         self._set_state(state)
         self._level = SleepLevel.AWAKE
         self.stats.last_wake_seconds = time.monotonic() - t0
@@ -152,9 +225,11 @@ class SleepManager:
         return {
             "is_sleeping": self.is_sleeping,
             "level": int(self._level),
+            "devices_released": self._released,
             "bytes_offloaded": self.stats.bytes_offloaded,
             "last_sleep_seconds": self.stats.last_sleep_seconds,
             "last_wake_seconds": self.stats.last_wake_seconds,
+            "last_reacquire_seconds": self.stats.last_reacquire_seconds,
         }
 
 
@@ -171,8 +246,14 @@ def attach_sleep(engine) -> SleepManager:
             engine.params = None
             engine.pool.k_pages = None
             engine.pool.v_pages = None
+            # Scheduler arrays (tokens/positions/budgets/key) are device
+            # state too — a sleeping engine must hold zero HBM. Host mirrors
+            # stay authoritative; the first post-wake chunk re-uploads them.
+            engine.drop_device_sched_state()
         else:
             engine.params = state["params"]
             engine.pool.replace(state["kv"])
 
-    return SleepManager(get_state, set_state)
+    return SleepManager(
+        get_state, set_state, on_reacquire=engine.on_device_reacquire
+    )

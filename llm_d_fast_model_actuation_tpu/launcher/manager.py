@@ -7,17 +7,24 @@ to 409), stop is graceful-then-kill, and a crashed child produces a STOPPED
 event with its exit code via the sentinel watcher.
 
 TPU delta: a `ChipLedger` records which chip sets are held by live instance
-processes; overlapping placements are reported (the dual-pods controller is
-the one that guarantees at most one *awake* instance per chip set — the
-ledger gives it the node-local truth to verify against).
+processes, and the manager *enforces* it: on TPU a chip has exactly one
+process-holder at a time (a second PJRT client blocks in init), so creating
+an instance whose chips overlap an AWAKE holder can only wedge — the
+launcher refuses with 409. Overlap with holders that are all ASLEEP (devices
+released; see engine/sleep.py) is the product's time-sharing path and is
+allowed. The dual-pods controller remains the party that orchestrates who
+sleeps when; the ledger is the node-local safety net against a controller
+bug silently double-booking a chip.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import urllib.request
 import uuid as uuidlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from ..utils.events import EventBroadcaster
 from .chiptranslator import ChipTranslator
@@ -29,22 +36,63 @@ STATUS_STOPPED = "stopped"
 STATUS_RUNNING = "running"
 
 
+class ChipConflict(Exception):
+    """Requested chips overlap an instance that is (or may be) awake."""
+
+    def __init__(self, instance_id: str, blockers: List[str]) -> None:
+        super().__init__(
+            f"instance {instance_id}: chips held by awake (or not-yet-probeable) "
+            f"instance(s) {blockers}; a TPU chip has one holder — sleep them first"
+        )
+        self.instance_id = instance_id
+        self.blockers = blockers
+
+
+def probe_instance_awake(instance: "EngineInstance") -> Optional[bool]:
+    """Ask the instance's engine admin API whether it still holds its chips.
+
+    Returns True ("awake": serving, or sleeping with the TPU client still
+    open — either way the chip is held), False (asleep AND devices released
+    — the chip is genuinely free), or None (engine not reachable — still
+    booting, crashed, or a test fake)."""
+    try:
+        from ..engine.server import parse_engine_options
+
+        port = parse_engine_options(instance.config.options).port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/is_sleeping", timeout=2
+        ) as resp:
+            body = json.loads(resp.read() or b"{}")
+        return not (
+            body.get("is_sleeping", False)
+            and body.get("devices_released", False)
+        )
+    except Exception:
+        return None
+
+
 class ChipLedger:
     """Node-local truth of which live instance holds which chips."""
 
     def __init__(self) -> None:
         self._held: Dict[str, List[str]] = {}  # instance_id -> chip_ids
 
+    def overlapping(
+        self, chip_ids: Optional[List[str]], exclude: Optional[str] = None
+    ) -> List[str]:
+        """Instance IDs whose recorded chip sets overlap `chip_ids`."""
+        chips = set(chip_ids or [])
+        return [
+            iid
+            for iid, held in self._held.items()
+            if iid != exclude and chips & set(held)
+        ]
+
     def acquire(self, instance_id: str, chip_ids: Optional[List[str]]) -> List[str]:
         """Record ownership; returns the list of instance IDs whose chip sets
         overlap (empty = clean placement)."""
-        chips = set(chip_ids or [])
-        overlaps = [
-            iid
-            for iid, held in self._held.items()
-            if iid != instance_id and chips & set(held)
-        ]
-        self._held[instance_id] = sorted(chips)
+        overlaps = self.overlapping(chip_ids, exclude=instance_id)
+        self._held[instance_id] = sorted(set(chip_ids or []))
         return overlaps
 
     def release(self, instance_id: str) -> None:
@@ -60,6 +108,10 @@ class EngineProcessManager:
         translator: ChipTranslator,
         log_dir: str = "",
         kickoff=None,
+        enforce_chip_exclusivity: bool = True,
+        awake_probe: Optional[
+            Callable[["EngineInstance"], Optional[bool]]
+        ] = None,
     ) -> None:
         self.instances: Dict[str, EngineInstance] = {}
         self.translator = translator
@@ -76,6 +128,11 @@ class EngineProcessManager:
         # buffer append must be one atomic step or a watcher can skip events
         self._rev_lock = threading.Lock()
         self._kickoff = kickoff
+        # With a fake kickoff there is no engine admin API to probe, so the
+        # sleep state of an overlapping holder is unknowable — enforcement
+        # stays opt-in for such managers (tests pass a probe or disable).
+        self.enforce_chip_exclusivity = enforce_chip_exclusivity
+        self._awake_probe = awake_probe or probe_instance_awake
 
     # -- revisions -----------------------------------------------------------
 
@@ -114,19 +171,37 @@ class EngineProcessManager:
                 parse_engine_options(config.options)
             except Exception as e:
                 raise InvalidInstanceConfig(f"invalid engine options: {e}")
+        overlaps = self.ledger.overlapping(config.chip_ids, exclude=iid)
+        if overlaps and self.enforce_chip_exclusivity:
+            # Allowed only if EVERY overlapping holder is verifiably asleep
+            # with devices released. Unreachable == possibly booting ==
+            # treated awake: refusing a race beats wedging the chip.
+            blockers = []
+            for other in overlaps:
+                inst = self.instances.get(other)
+                if inst is None:
+                    # stale ledger entry (a failed create); drop, not block
+                    self.ledger.release(other)
+                    continue
+                if self._awake_probe(inst) is not False:
+                    blockers.append(other)
+            if blockers:
+                raise ChipConflict(iid, blockers)
+        elif overlaps:
+            logger.warning(
+                "instance %s chips overlap live instances %s "
+                "(enforcement off: controller must ensure they are asleep)",
+                iid,
+                overlaps,
+            )
         kwargs = {} if self._kickoff is None else {"kickoff": self._kickoff}
         instance = EngineInstance(
             iid, config, self.translator, log_dir=self.log_dir, **kwargs
         )
-        overlaps = self.ledger.acquire(iid, config.chip_ids)
-        if overlaps:
-            logger.warning(
-                "instance %s chips overlap live instances %s "
-                "(controller must ensure the overlapping ones are asleep)",
-                iid,
-                overlaps,
-            )
         result = instance.start()
+        # record ownership only once the process actually exists — a failed
+        # start must not leak a chips hold
+        self.ledger.acquire(iid, config.chip_ids)
         self.instances[iid] = instance
         published = dict(result)
         instance.last_revision = self._publish("CREATED", published)

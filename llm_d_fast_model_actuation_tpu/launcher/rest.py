@@ -21,6 +21,7 @@ from aiohttp import web
 
 from ..utils.events import RevisionTooOld
 from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
+from .manager import ChipConflict
 from .manager import EngineProcessManager
 
 logger = logging.getLogger(__name__)
@@ -77,9 +78,15 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     async def create_instance(request: web.Request) -> web.Response:
         config = await _parse_config(request)
         try:
-            result = manager.create_instance(config)
+            # create forks + may probe overlapping engines over HTTP (2 s
+            # timeout each) — keep the event loop free
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, manager.create_instance, config
+            )
         except InvalidInstanceConfig as e:
             raise web.HTTPUnprocessableEntity(text=str(e))
+        except ChipConflict as e:
+            raise web.HTTPConflict(text=str(e))
         except Exception as e:
             logger.exception("create failed")
             raise web.HTTPInternalServerError(text=str(e))
@@ -90,10 +97,13 @@ def build_app(manager: EngineProcessManager) -> web.Application:
         instance_id = request.match_info["instance_id"]
         config = await _parse_config(request)
         try:
-            result = manager.create_instance(config, instance_id=instance_id)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: manager.create_instance(config, instance_id=instance_id),
+            )
         except InvalidInstanceConfig as e:
             raise web.HTTPUnprocessableEntity(text=str(e))
-        except ValueError as e:
+        except (ValueError, ChipConflict) as e:
             raise web.HTTPConflict(text=str(e))
         except Exception as e:
             logger.exception("create failed")

@@ -30,7 +30,7 @@ _IMPL = "reference"
 
 def set_attention_impl(impl: str) -> None:
     global _IMPL
-    if impl not in ("reference", "pallas"):
+    if impl not in ("reference", "grouped", "pallas"):
         raise ValueError(f"unknown attention impl {impl!r}")
     _IMPL = impl
 
@@ -85,6 +85,67 @@ def causal_prefill_attention(
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_decode_attention_inline(
+    q: jnp.ndarray,  # [batch, heads, head_dim] — the new token's queries
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    k_new: jnp.ndarray,  # [batch, kv_heads, head_dim] — the new token's K
+    v_new: jnp.ndarray,  # [batch, kv_heads, head_dim] — the new token's V
+    page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
+    positions: jnp.ndarray,  # [batch] int32 — position of the new token;
+    #                          cache entries < position are attended
+    impl: "str | None" = None,
+) -> jnp.ndarray:
+    """Decode attention where the new token's K/V are passed *inline* instead
+    of having been scattered into the cache first.
+
+    This is the serving fast path: per-layer cache scatters are the dominant
+    non-matmul cost of a decode step on TPU (each XLA scatter on the pool
+    re-materializes it), so the engine defers all layers' KV writes to ONE
+    scatter after the layer scan and attention reads cache[< position] plus
+    the inline (k_new, v_new) as a virtual final cache entry. Numerically
+    identical to scatter-then-attend (same softmax over the same set).
+
+    GQA is handled by *grouping* query heads [b, kvh, group, d] — no
+    materialized `repeat` of K/V, matmuls run bf16 on the MXU with fp32
+    accumulation.
+    """
+    if (impl or _IMPL) == "pallas":
+        from .pallas import paged_decode_attention_inline_pallas
+
+        return paged_decode_attention_inline_pallas(
+            q, k_pages, v_pages, k_new, v_new, page_table, positions,
+            interpret=_pallas_interpret(),
+        )
+    b, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+    pages_per_seq = page_table.shape[1]
+    page_size = k_pages.shape[1]
+    ctx = pages_per_seq * page_size
+
+    k = k_pages[page_table].reshape(b, ctx, kvh, d)
+    v = v_pages[page_table].reshape(b, ctx, kvh, d)
+    qg = (q.astype(jnp.float32) * (d**-0.5)).astype(q.dtype).reshape(b, kvh, g, d)
+    logits = jnp.einsum(
+        "bngd,bknd->bngk", qg, k, preferred_element_type=jnp.float32
+    )
+    valid = jnp.arange(ctx)[None, :] < positions[:, None]  # strictly past
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    self_logit = jnp.einsum(
+        "bngd,bnd->bng", qg, k_new.astype(qg.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    all_logits = jnp.concatenate([logits, self_logit[..., None]], axis=-1)
+    probs = jax.nn.softmax(all_logits, axis=-1)
+    out = jnp.einsum(
+        "bngk,bknd->bngd", probs[..., :ctx].astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    out = out + probs[..., ctx:] * v_new.reshape(b, kvh, 1, d).astype(jnp.float32)
+    return out.reshape(b, h, d).astype(q.dtype)
 
 
 def paged_decode_attention(

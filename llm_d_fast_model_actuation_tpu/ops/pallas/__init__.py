@@ -5,10 +5,14 @@ dispatcher there selects an implementation (`set_attention_impl`). Kernels
 run in interpreter mode off-TPU so the whole suite is testable on CPU.
 """
 
-from .decode import paged_decode_attention_pallas
+from .decode import (
+    paged_decode_attention_inline_pallas,
+    paged_decode_attention_pallas,
+)
 from .prefill import causal_prefill_attention_pallas
 
 __all__ = [
+    "paged_decode_attention_inline_pallas",
     "paged_decode_attention_pallas",
     "causal_prefill_attention_pallas",
 ]

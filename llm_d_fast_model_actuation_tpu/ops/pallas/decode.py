@@ -132,6 +132,172 @@ def _decode_kernel(
     o_ref[0] = out.astype(o_ref.dtype)
 
 
+def _decode_kernel_inline(
+    # scalar prefetch
+    page_table_ref,  # [batch, pages_per_seq] SMEM
+    pos_ref,  # [batch] SMEM — position of the new token (cache holds < pos)
+    # inputs
+    q_ref,  # [1, heads, head_dim] VMEM
+    knew_ref,  # [1, kv_heads, head_dim] VMEM — the new token's K (not yet in cache)
+    vnew_ref,  # [1, kv_heads, head_dim] VMEM
+    k_hbm,  # [num_pages, page_size, kv_heads, head_dim] HBM/ANY
+    v_hbm,  # same
+    # output
+    o_ref,  # [1, heads, head_dim] VMEM
+    # scratch
+    k_buf,  # [2, page_size, kv_heads, head_dim] VMEM
+    v_buf,  # same
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    """Decode attention with the new token's K/V passed inline (the engine
+    defers cache scatters; see ops/attention.py:paged_decode_attention_inline).
+    Identical online-softmax structure to `_decode_kernel`, plus one final
+    fold of the inline token into the running (m, l, acc) state."""
+    b = pl.program_id(0)
+    group = num_heads // num_kv_heads
+    pos = pos_ref[b]
+    num_pages = jax.lax.div(pos + page_size - 1, page_size)
+
+    def page_dma(buf, hbm, slot, p, sem_row):
+        return pltpu.make_async_copy(
+            hbm.at[page_table_ref[b, p]],
+            buf.at[slot],
+            sems.at[sem_row, slot],
+        )
+
+    @pl.when(num_pages > 0)
+    def _():
+        page_dma(k_buf, k_hbm, 0, 0, 0).start()
+        page_dma(v_buf, v_hbm, 0, 0, 1).start()
+
+    q = q_ref[0].astype(jnp.float32) * (head_dim**-0.5)  # [heads, head_dim]
+
+    def body(p, carry):
+        ms, ls, accs = carry
+        slot = jax.lax.rem(p, 2)
+
+        @pl.when(p + 1 < num_pages)
+        def _():
+            nxt = jax.lax.rem(p + 1, 2)
+            page_dma(k_buf, k_hbm, nxt, p + 1, 0).start()
+            page_dma(v_buf, v_hbm, nxt, p + 1, 1).start()
+
+        page_dma(k_buf, k_hbm, slot, p, 0).wait()
+        page_dma(v_buf, v_hbm, slot, p, 1).wait()
+
+        tok0 = p * page_size
+        tok_idx = tok0 + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1)
+        valid = tok_idx < pos  # strictly past tokens
+
+        new_ms, new_ls, new_accs = [], [], []
+        for g in range(num_kv_heads):
+            qg = q[g * group : (g + 1) * group]
+            kg = k_buf[slot, :, g, :].astype(jnp.float32)
+            vg = v_buf[slot, :, g, :].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                qg, kg, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            logits = jnp.where(valid, logits, NEG_INF)
+            m_cur = jnp.maximum(ms[g], logits.max(axis=-1, keepdims=True))
+            alpha = jnp.exp(ms[g] - m_cur)
+            probs = jnp.exp(logits - m_cur)
+            l_cur = ls[g] * alpha + probs.sum(axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                probs, vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            new_ms.append(m_cur)
+            new_ls.append(l_cur)
+            new_accs.append(accs[g] * alpha + pv)
+        return tuple(new_ms), tuple(new_ls), tuple(new_accs)
+
+    m0 = tuple(jnp.full((group, 1), NEG_INF, jnp.float32) for _ in range(num_kv_heads))
+    l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(num_kv_heads))
+    acc0 = tuple(
+        jnp.zeros((group, head_dim), jnp.float32) for _ in range(num_kv_heads)
+    )
+    ms, ls, accs = jax.lax.fori_loop(0, num_pages, body, (m0, l0, acc0))
+
+    # Fold the inline token (always valid; guarantees l > 0 even at pos == 0).
+    out_rows = []
+    for g in range(num_kv_heads):
+        qg = q[g * group : (g + 1) * group]
+        kn = knew_ref[0, g, :].astype(jnp.float32)  # [head_dim]
+        vn = vnew_ref[0, g, :].astype(jnp.float32)
+        logit = (qg * kn[None, :]).sum(axis=-1, keepdims=True)  # [group, 1]
+        m_cur = jnp.maximum(ms[g], logit)
+        alpha = jnp.exp(ms[g] - m_cur)
+        p_self = jnp.exp(logit - m_cur)
+        l_cur = ls[g] * alpha + p_self
+        acc = accs[g] * alpha + p_self * vn[None, :]
+        out_rows.append(acc / l_cur)
+    out = jnp.concatenate(out_rows, axis=0)  # [heads, head_dim]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_inline_pallas(
+    q: jnp.ndarray,  # [batch, heads, head_dim]
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [batch, kv_heads, head_dim]
+    v_new: jnp.ndarray,
+    page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
+    positions: jnp.ndarray,  # [batch] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    batch, num_heads, head_dim = q.shape
+    _, page_size, num_kv_heads, _ = k_pages.shape
+
+    kernel = functools.partial(
+        _decode_kernel_inline,
+        page_size=page_size,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        head_dim=head_dim,
+    )
+    row_spec = lambda shape: pl.BlockSpec(  # noqa: E731
+        shape, lambda b, *_: (b,) + (0,) * (len(shape) - 1), memory_space=pltpu.VMEM
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch,),
+        in_specs=[
+            row_spec((1, num_heads, head_dim)),
+            row_spec((1, num_kv_heads, head_dim)),
+            row_spec((1, num_kv_heads, head_dim)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=row_spec((1, num_heads, head_dim)),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, num_kv_heads, head_dim), k_pages.dtype),
+            pltpu.VMEM((2, page_size, num_kv_heads, head_dim), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        page_table.astype(jnp.int32),
+        positions.astype(jnp.int32),
+        q,
+        k_new,
+        v_new,
+        k_pages,
+        v_pages,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [batch, heads, head_dim]

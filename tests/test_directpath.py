@@ -268,8 +268,11 @@ def test_different_patch_gets_new_provider():
 
 
 def test_sleeper_budget_lru_eviction():
+    """Exact-limit semantics (enforceSleeperBudget, inference-server.go:1404):
+    sleepers are evicted only while count > limit, oldest first."""
     h = Harness(sleeper_limit=1)
     other = PATCH.replace("llama-3-8b", "qwen-0.5b")
+    third = PATCH.replace("llama-3-8b", "phi-3-mini")
 
     async def body():
         # sleeper #1 on chip-0
@@ -279,13 +282,19 @@ def test_sleeper_budget_lru_eviction():
         h.store.delete("Pod", h.ns, "req1")
         await h.settle()
 
-        # a different config on the same chip: budget (1) forces eviction
+        # sleeper #2 (different config, same chip -> no twin reuse)
         h.add_direct_requester("req2", other, chips=["chip-0"])
+        await h.settle()
+        h.store.delete("Pod", h.ns, "req2")
+        await h.settle()
+
+        # a third config: 2 sleepers > limit 1 -> evict exactly one (the LRU)
+        h.add_direct_requester("req3", third, chips=["chip-0"])
         await h.settle()
         provs = h.direct_provider_pods()
         names = [p["metadata"]["name"] for p in provs]
         assert first not in names, "LRU sleeper must be evicted"
-        assert len(provs) == 1
+        assert len(provs) == 2, "limit 1 keeps one sleeper + the new provider"
 
     run_scenario(h, body)
 

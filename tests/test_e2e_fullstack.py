@@ -265,9 +265,12 @@ def test_cold_then_warm_actuation_over_real_http(stack):
                 if (pod["metadata"].get("labels") or {}).get(C.SLEEPING_LABEL) == "true":
                     break
                 await asyncio.sleep(0.3)
-            assert requests.get(engine + "/is_sleeping", timeout=5).json() == {
-                "is_sleeping": True
-            }
+            assert (
+                requests.get(engine + "/is_sleeping", timeout=5).json()[
+                    "is_sleeping"
+                ]
+                is True
+            )
             inv = requests.get(
                 f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
                 timeout=5,
@@ -295,9 +298,12 @@ def test_cold_then_warm_actuation_over_real_http(stack):
                 requests.get(f"http://127.0.0.1:{probes_port}/ready", timeout=2).status_code
                 == 200
             )
-            assert requests.get(engine + "/is_sleeping", timeout=5).json() == {
-                "is_sleeping": False
-            }
+            assert (
+                requests.get(engine + "/is_sleeping", timeout=5).json()[
+                    "is_sleeping"
+                ]
+                is False
+            )
             inv = requests.get(
                 f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
                 timeout=5,

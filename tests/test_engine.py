@@ -112,12 +112,13 @@ def test_sleep_wake_midstream_resumes():
         max_seq_len=64,
     )
     eng = InferenceEngine(cfg, seed=0)
-    gold = eng.generate([[9, 8, 7]], max_new_tokens=8)[0]
+    gold = eng.generate([[9, 8, 7]], max_new_tokens=24)[0]
 
     eng2 = InferenceEngine(cfg, seed=0)
-    eng2.add_request([9, 8, 7], max_new_tokens=8)
-    for _ in range(3):
+    eng2.add_request([9, 8, 7], max_new_tokens=24)
+    for _ in range(2):  # prefill + a few decode chunks; still mid-generation
         eng2.step()
+    assert eng2.has_work(), "request must still be in flight before sleep"
     mgr = attach_sleep(eng2)
     mgr.sleep(1)
     mgr.wake_up()

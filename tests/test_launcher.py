@@ -215,6 +215,58 @@ def test_manager_chip_ledger(manager, translator):
     assert manager.ledger.holders().get("x") is None
 
 
+def test_chip_exclusivity_refuses_awake_overlap(translator, tmp_path):
+    """A TPU chip has one holder: creating an instance whose chips overlap
+    an AWAKE (or unprobeable) holder must 409, not silently double-book."""
+    from llm_d_fast_model_actuation_tpu.launcher.manager import ChipConflict
+
+    awake = {"x": True}
+    m = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=fake_kickoff,
+        awake_probe=lambda inst: awake.get(inst.instance_id),
+    )
+    try:
+        ids = translator.chip_ids()
+        m.create_instance(InstanceConfig(options="a", chip_ids=ids[:4]), "x")
+        with pytest.raises(ChipConflict):
+            m.create_instance(InstanceConfig(options="b", chip_ids=ids[3:5]), "y")
+        assert "y" not in m.ledger.holders(), "refused create must not hold chips"
+
+        # unknown sleep state (probe None) is treated as awake: still refused
+        awake["x"] = None
+        with pytest.raises(ChipConflict):
+            m.create_instance(InstanceConfig(options="b", chip_ids=ids[3:5]), "y")
+
+        # all overlapping holders verifiably asleep -> time-sharing allowed
+        awake["x"] = False
+        st = m.create_instance(InstanceConfig(options="b", chip_ids=ids[3:5]), "y")
+        assert st["instance_id"] == "y"
+        # disjoint chips never consult the probe
+        st2 = m.create_instance(InstanceConfig(options="c", chip_ids=ids[5:7]), "z")
+        assert st2["instance_id"] == "z"
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
+def test_chip_exclusivity_enforcement_can_be_disabled(translator, tmp_path):
+    m = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=fake_kickoff,
+        enforce_chip_exclusivity=False,
+    )
+    try:
+        ids = translator.chip_ids()
+        m.create_instance(InstanceConfig(options="a", chip_ids=ids[:4]), "x")
+        # overlap only warns (round-2 behavior), preserved behind the flag
+        m.create_instance(InstanceConfig(options="b", chip_ids=ids[3:5]), "y")
+        assert set(m.ledger.holders()) == {"x", "y"}
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
 # -- REST API -----------------------------------------------------------------
 
 

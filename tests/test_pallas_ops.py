@@ -134,7 +134,7 @@ def test_engine_generates_identically_with_pallas_attention():
     model = llama.LlamaConfig.tiny()
     prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
     outs = {}
-    for impl in ("reference", "pallas"):
+    for impl in ("reference", "grouped", "pallas"):
         cfg = EngineConfig(
             model=model,
             max_batch=2,
@@ -147,3 +147,66 @@ def test_engine_generates_identically_with_pallas_attention():
         outs[impl] = eng.generate(prompts, max_new_tokens=6)
     attn.set_attention_impl("reference")
     assert outs["pallas"] == outs["reference"]
+    assert outs["grouped"] == outs["reference"]
+
+
+@pytest.mark.parametrize(
+    "batch,heads,kv_heads,head_dim,page_size,pages_per_seq",
+    [
+        (2, 4, 2, 16, 8, 4),
+        (3, 8, 8, 32, 16, 2),  # MHA (group=1)
+        (1, 8, 2, 64, 8, 3),  # GQA 4x
+    ],
+)
+def test_inline_decode_matches_scatter_then_attend(
+    batch, heads, kv_heads, head_dim, page_size, pages_per_seq
+):
+    """The deferred-scatter serving path: attend(cache[<pos], inline new K/V)
+    must equal scatter-into-cache-then-attend — for both the grouped-XLA
+    math and the inline Pallas kernel (interpret mode on CPU)."""
+    from llm_d_fast_model_actuation_tpu.ops.pallas import (
+        paged_decode_attention_inline_pallas,
+    )
+
+    key = jax.random.key(11)
+    ks = jax.random.split(key, 6)
+    num_pages = batch * pages_per_seq + 1
+    q = _rand(ks[0], (batch, heads, head_dim))
+    k_pages = _rand(ks[1], (num_pages, page_size, kv_heads, head_dim))
+    v_pages = _rand(ks[2], (num_pages, page_size, kv_heads, head_dim))
+    k_new = _rand(ks[3], (batch, kv_heads, head_dim))
+    v_new = _rand(ks[4], (batch, kv_heads, head_dim))
+    pt = jnp.asarray(
+        np.arange(1, 1 + batch * pages_per_seq, dtype=np.int32).reshape(
+            batch, pages_per_seq
+        )
+    )
+    # ragged positions incl. a page boundary and a partial last page
+    pos_np = np.minimum(
+        np.array([page_size * pages_per_seq - 1, page_size, 3][:batch]),
+        page_size * pages_per_seq - 1,
+    ).astype(np.int32)
+    positions = jnp.asarray(pos_np)
+
+    # golden: scatter k_new/v_new at `positions` first, then plain attention
+    page_of = pos_np // page_size
+    slot_of = pos_np % page_size
+    phys = np.asarray(pt)[np.arange(batch), page_of]
+    kp2 = k_pages.at[phys, slot_of].set(k_new)
+    vp2 = v_pages.at[phys, slot_of].set(v_new)
+    want = attn.paged_decode_attention(
+        q, kp2, vp2, pt, jnp.asarray(pos_np + 1), impl="reference"
+    )
+
+    got_grouped = attn.paged_decode_attention_inline(
+        q, k_pages, v_pages, k_new, v_new, pt, positions, impl="grouped"
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_grouped), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    got_pallas = paged_decode_attention_inline_pallas(
+        q, k_pages, v_pages, k_new, v_new, pt, positions, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got_pallas), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
