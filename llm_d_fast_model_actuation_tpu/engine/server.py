@@ -401,6 +401,18 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_post("/wake_up", wake_up)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/completions", completions)
+
+    if os.environ.get("FMA_DEBUG_ENDPOINTS") == "1":
+        # test-server role (SURVEY §4): crash induction for the
+        # stopped-instance-recovery e2e (the reference kills its test server
+        # the same way; the sentinel must see a real process death)
+        async def debug_crash(request: web.Request) -> web.Response:
+            import threading
+
+            threading.Timer(0.1, lambda: os._exit(17)).start()
+            return web.json_response({"crashing": True})
+
+        app.router.add_post("/debug/crash", debug_crash)
     return app
 
 

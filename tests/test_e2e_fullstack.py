@@ -1,5 +1,5 @@
 """Full-stack e2e: the TPU-less equivalent of the reference's kind suite
-(test/e2e/run-launcher-based.sh, SURVEY.md §4.3).
+(test/e2e/run-launcher-based.sh + test-cases.sh:136-897, SURVEY.md §4.3).
 
 Every boundary is real:
   controller --(watch/REST)--> fake kube-apiserver        [KubeStore]
@@ -9,13 +9,18 @@ Every boundary is real:
   launcher   --(fork)--------> engine child (tiny model, CPU)
   controller --(HTTP admin)--> engine (/is_sleeping, /sleep, /wake_up)
 
-Covered cycle: cold actuation to Ready -> serve completions -> requester
-deletion puts the instance to sleep -> re-actuation wakes the SAME instance
-(warm path) without a new launcher or engine process.
+Cases (reference analogue in parens):
+  * cold -> warm actuation               (test-cases.sh "hot/warm start")
+  * two ISCs time-share ONE chip via release-mode sleep — the dual-pods
+    product premise (docs/dual-pods.md:20-56) with real device release
+  * two instances share one launcher     ("multiple instances")
+  * per-launcher cap + unbound reclaim   ("cap reclaim")
+  * controller restart recovery          ("restart recovery")
+  * crashed-instance recovery through the real notifier
+                                         ("stopped-instance recovery")
 """
 
 import asyncio
-import os
 import socket
 import subprocess
 import sys
@@ -34,9 +39,9 @@ from llm_d_fast_model_actuation_tpu.controller.kubestore import KubeStore
 
 from fake_apiserver import FakeApiServer
 
-NS = "e2e"
 NODE = "n1"
 CHIP = "tpu-mock-0-0"
+CHIP2 = "tpu-mock-0-1"
 
 
 def free_port() -> int:
@@ -84,6 +89,29 @@ def _spawn(args, log_file, **env_extra):
         )
 
 
+def spawn_requester_stub(chips, log_file):
+    """One requester SPI stub subprocess; returns (proc, spi_port, probes_port)."""
+    spi_port, probes_port = free_port(), free_port()
+    proc = _spawn(
+        [
+            "llm_d_fast_model_actuation_tpu.requester.main",
+            "--host",
+            "127.0.0.1",
+            "--backend",
+            "static",
+            "--chips",
+            ",".join(chips),
+            "--spi-port",
+            str(spi_port),
+            "--probes-port",
+            str(probes_port),
+        ],
+        log_file,
+    )
+    wait_http(f"http://127.0.0.1:{spi_port}/v1/dual-pods/accelerators")
+    return proc, spi_port, probes_port
+
+
 @pytest.fixture(scope="module")
 def stack(tmp_path_factory):
     if not port_free(C.LAUNCHER_SERVICE_PORT):
@@ -91,7 +119,6 @@ def stack(tmp_path_factory):
     procs = []
     srv = FakeApiServer()
     srv.start()
-    spi_port, probes_port = free_port(), free_port()
     logs = tmp_path_factory.mktemp("proc-logs")
     try:
         procs.append(
@@ -113,27 +140,10 @@ def stack(tmp_path_factory):
                 logs / "launcher.log",
             )
         )
-        procs.append(
-            _spawn(
-                [
-                    "llm_d_fast_model_actuation_tpu.requester.main",
-                    "--host",
-                    "127.0.0.1",
-                    "--backend",
-                    "static",
-                    "--chips",
-                    CHIP,
-                    "--spi-port",
-                    str(spi_port),
-                    "--probes-port",
-                    str(probes_port),
-                ],
-                logs / "requester.log",
-            )
-        )
+        p, spi_port, probes_port = spawn_requester_stub([CHIP], logs / "requester.log")
+        procs.append(p)
         wait_http(f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/health")
-        wait_http(f"http://127.0.0.1:{spi_port}/v1/dual-pods/accelerators")
-        yield srv, spi_port, probes_port
+        yield srv, spi_port, probes_port, logs
     finally:
         for p in procs:
             p.terminate()
@@ -145,179 +155,529 @@ def stack(tmp_path_factory):
         srv.stop()
 
 
-def _launcher_pod_object(ks):
-    """Build the launcher Pod object the way the controller would, so its
-    config-hash matches selection (shared template builder)."""
-    from llm_d_fast_model_actuation_tpu.api.types import LauncherConfig
-    from llm_d_fast_model_actuation_tpu.controller.populator import (
-        build_launcher_template,
-        specialize_to_node,
-    )
+LAUNCHER = f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}"
 
-    lc = LauncherConfig.from_dict(ks.get("LauncherConfig", NS, "lc1"))
-    _, ti_hash = build_launcher_template(lc)
-    pod = specialize_to_node(lc, NODE, ti_hash)
-    pod["metadata"]["namespace"] = NS
-    pod["metadata"]["name"] = "launcher-live"
-    pod["status"] = {
-        "podIP": "127.0.0.1",
-        "conditions": [{"type": "Ready", "status": "True"}],
-    }
-    return pod
+
+def launcher_instances():
+    return requests.get(LAUNCHER + "/v2/vllm/instances", timeout=5).json()
+
+
+def _purge_launcher_instances():
+    for st in launcher_instances().get("instances", []):
+        requests.delete(
+            LAUNCHER + f"/v2/vllm/instances/{st['instance_id']}", timeout=30
+        )
+
+
+class Scenario:
+    """Per-test world: own namespace on the shared apiserver, own controller."""
+
+    def __init__(self, srv, ns: str):
+        self.srv = srv
+        self.ns = ns
+        self.ks = None
+        self.ctl = None
+        self.transports = None
+
+    async def start(self, **cfg_kw):
+        self.ks = KubeStore(f"http://127.0.0.1:{self.srv.port}", self.ns, kinds=None)
+        await self.ks.start()
+        self.transports = HttpTransports()
+        self.ctl = DualPodsController(
+            self.ks, self.transports, DualPodsConfig(namespace=self.ns, **cfg_kw)
+        )
+        await self.ctl.start()
+
+    async def stop(self):
+        if self.ctl:
+            await self.ctl.stop()
+        if self.transports:
+            await self.transports.close()
+        if self.ks:
+            await self.ks.stop()
+        self.ctl = self.transports = self.ks = None
+
+    # -- objects -------------------------------------------------------------
+
+    def add_lc(self, name="lc1", max_instances=2):
+        self.ks.create(
+            {
+                "kind": "LauncherConfig",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "podTemplate": {
+                        "metadata": {},
+                        "spec": {"containers": [{"name": "launcher"}]},
+                    },
+                    "maxInstances": max_instances,
+                },
+            }
+        )
+
+    def add_isc(self, name, engine_port, lc_name="lc1", extra_options="", env=None):
+        options = (
+            f"--model tiny --port {engine_port} --num-pages 32 "
+            f"--max-batch 2 --page-size 8 --max-model-len 64" + extra_options
+        )
+        env_vars = {"JAX_PLATFORMS": "cpu"}
+        env_vars.update(env or {})
+        self.ks.create(
+            {
+                "kind": "InferenceServerConfig",
+                "metadata": {"name": name, "namespace": self.ns},
+                "spec": {
+                    "modelServerConfig": {
+                        "port": engine_port,
+                        "options": options,
+                        "env_vars": env_vars,
+                    },
+                    "launcherConfigName": lc_name,
+                },
+            }
+        )
+
+    def add_launcher_pod(self, lc_name="lc1", name="launcher-live"):
+        from llm_d_fast_model_actuation_tpu.api.types import LauncherConfig
+        from llm_d_fast_model_actuation_tpu.controller.populator import (
+            build_launcher_template,
+            specialize_to_node,
+        )
+
+        lc = LauncherConfig.from_dict(self.ks.get("LauncherConfig", self.ns, lc_name))
+        _, ti_hash = build_launcher_template(lc)
+        pod = specialize_to_node(lc, NODE, ti_hash)
+        pod["metadata"]["namespace"] = self.ns
+        pod["metadata"]["name"] = name
+        pod["status"] = {
+            "podIP": "127.0.0.1",
+            "conditions": [{"type": "Ready", "status": "True"}],
+        }
+        self.ks.create(pod)
+
+    def add_requester(self, name, isc_name, spi_port):
+        self.ks.create(
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": self.ns,
+                    "annotations": {
+                        C.INFERENCE_SERVER_CONFIG_ANNOTATION: isc_name,
+                        C.ADMIN_PORT_ANNOTATION: str(spi_port),
+                    },
+                },
+                "spec": {
+                    "nodeName": NODE,
+                    "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
+                },
+                "status": {"podIP": "127.0.0.1"},
+            }
+        )
+
+    # -- waiting -------------------------------------------------------------
+
+    async def wait_ready(self, probes_port, timeout=180):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if (
+                    requests.get(
+                        f"http://127.0.0.1:{probes_port}/ready", timeout=1
+                    ).status_code
+                    == 200
+                ):
+                    return
+            except requests.RequestException:
+                pass
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"stub on {probes_port} never became ready")
+
+    async def wait_sleeping_label(self, pod_name, value="true", timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            pod = self.ks.try_get("Pod", self.ns, pod_name)
+            if (
+                pod is not None
+                and (pod["metadata"].get("labels") or {}).get(C.SLEEPING_LABEL)
+                == value
+            ):
+                return pod
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"{pod_name} never got sleeping={value}")
+
+    async def wait_gone(self, kind, name, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.ks.try_get(kind, self.ns, name) is None:
+                return
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"{kind} {name} never deleted")
+
+    async def wait_engine_sleeping(self, engine_port, value, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                body = requests.get(
+                    f"http://127.0.0.1:{engine_port}/is_sleeping", timeout=2
+                ).json()
+                if body["is_sleeping"] is value:
+                    return body
+            except requests.RequestException:
+                pass
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"engine {engine_port} never is_sleeping={value}")
+
+
+def complete(engine_port, prompt=(1, 2, 3), n=3, timeout=60):
+    return requests.post(
+        f"http://127.0.0.1:{engine_port}/v1/completions",
+        json={"prompt": list(prompt), "max_tokens": n},
+        timeout=timeout,
+    ).json()["choices"][0]["token_ids"]
+
+
+def reset_stub(spi_port):
+    requests.post(f"http://127.0.0.1:{spi_port}/v1/become-unready", timeout=5)
+
+
+@pytest.fixture
+def scenario(stack, request):
+    srv, spi_port, probes_port, logs = stack
+    ns = f"e2e-{request.node.name.replace('_', '-')[:40]}"
+    sc = Scenario(srv, ns)
+    sc.default_spi = spi_port
+    sc.default_probes = probes_port
+    sc.logs = logs
+    yield sc
+    _purge_launcher_instances()
+    reset_stub(spi_port)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# ---------------------------------------------------------------- the cases
 
 
 @pytest.mark.e2e
-def test_cold_then_warm_actuation_over_real_http(stack):
-    srv, spi_port, probes_port = stack
+def test_cold_then_warm_actuation_over_real_http(scenario):
+    sc = scenario
     engine_port = free_port()
 
-    async def scenario():
-        ks = KubeStore(f"http://127.0.0.1:{srv.port}", NS, kinds=None)
-        await ks.start()
-        transports = HttpTransports()
-        ctl = DualPodsController(ks, transports, DualPodsConfig(namespace=NS))
-        await ctl.start()
+    async def body():
+        await sc.start()
         try:
-            ks.create(
-                {
-                    "kind": "LauncherConfig",
-                    "metadata": {"name": "lc1", "namespace": NS},
-                    "spec": {
-                        "podTemplate": {"metadata": {}, "spec": {"containers": [{"name": "launcher"}]}},
-                        "maxInstances": 2,
-                    },
-                }
-            )
-            ks.create(
-                {
-                    "kind": "InferenceServerConfig",
-                    "metadata": {"name": "isc1", "namespace": NS},
-                    "spec": {
-                        "modelServerConfig": {
-                            "port": engine_port,
-                            "options": (
-                                f"--model tiny --port {engine_port} --num-pages 32 "
-                                "--max-batch 2 --page-size 8 --max-model-len 64"
-                            ),
-                            "env_vars": {"JAX_PLATFORMS": "cpu"},
-                        },
-                        "launcherConfigName": "lc1",
-                    },
-                }
-            )
-            # the running launcher process, represented as its Pod object
-            ks.create(_launcher_pod_object(ks))
-
-            def add_requester(name):
-                ks.create(
-                    {
-                        "kind": "Pod",
-                        "metadata": {
-                            "name": name,
-                            "namespace": NS,
-                            "annotations": {
-                                C.INFERENCE_SERVER_CONFIG_ANNOTATION: "isc1",
-                                C.ADMIN_PORT_ANNOTATION: str(spi_port),
-                            },
-                        },
-                        "spec": {
-                            "nodeName": NODE,
-                            "containers": [{"name": C.INFERENCE_SERVER_CONTAINER_NAME}],
-                        },
-                        "status": {"podIP": "127.0.0.1"},
-                    }
-                )
-
-            add_requester("req1")
+            sc.add_lc()
+            sc.add_isc("isc1", engine_port)
+            sc.add_launcher_pod()
+            sc.add_requester("req1", "isc1", sc.default_spi)
 
             # ---- cold actuation: engine forked, served, readiness relayed
-            deadline = time.time() + 180
-            while time.time() < deadline:
-                try:
-                    if requests.get(
-                        f"http://127.0.0.1:{probes_port}/ready", timeout=1
-                    ).status_code == 200:
-                        break
-                except requests.RequestException:
-                    pass
-                await asyncio.sleep(0.3)
-            r = requests.get(f"http://127.0.0.1:{probes_port}/ready", timeout=2)
-            assert r.status_code == 200, "readiness must be relayed to the stub"
-
-            engine = f"http://127.0.0.1:{engine_port}"
-            out1 = requests.post(
-                engine + "/v1/completions",
-                json={"prompt": [1, 2, 3], "max_tokens": 3},
-                timeout=60,
-            ).json()["choices"][0]["token_ids"]
+            await sc.wait_ready(sc.default_probes)
+            out1 = complete(engine_port)
             assert len(out1) == 3
 
-            launcher_pod = ks.get("Pod", NS, "launcher-live")
+            launcher_pod = sc.ks.get("Pod", sc.ns, "launcher-live")
             assert launcher_pod["metadata"]["annotations"][
                 C.REQUESTER_ANNOTATION
             ].startswith("req1/")
 
             # ---- requester deleted: instance must go to SLEEP, not die
-            ks.delete("Pod", NS, "req1")
-            deadline = time.time() + 60
-            while time.time() < deadline:
-                pod = ks.get("Pod", NS, "launcher-live")
-                if (pod["metadata"].get("labels") or {}).get(C.SLEEPING_LABEL) == "true":
-                    break
-                await asyncio.sleep(0.3)
-            assert (
-                requests.get(engine + "/is_sleeping", timeout=5).json()[
-                    "is_sleeping"
-                ]
-                is True
+            sc.ks.delete("Pod", sc.ns, "req1")
+            await sc.wait_sleeping_label("launcher-live")
+            await sc.wait_engine_sleeping(engine_port, True)
+            assert launcher_instances()["total_instances"] == 1, (
+                "instance survives unbind asleep"
             )
-            inv = requests.get(
-                f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
-                timeout=5,
-            ).json()
-            assert inv["total_instances"] == 1, "instance survives unbind asleep"
 
             # ---- warm re-actuation: SAME instance wakes, same greedy output
-            # (a real re-actuation gets a FRESH requester pod; reset the
-            # long-lived stub's ready flag to model that)
-            requests.post(
-                f"http://127.0.0.1:{spi_port}/v1/become-unready", timeout=5
+            reset_stub(sc.default_spi)
+            sc.add_requester("req2", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            await sc.wait_engine_sleeping(engine_port, False)
+            assert launcher_instances()["total_instances"] == 1, (
+                "warm hit must reuse, not recreate"
             )
-            add_requester("req2")
-            deadline = time.time() + 120
-            while time.time() < deadline:
-                try:
-                    if requests.get(
-                        f"http://127.0.0.1:{probes_port}/ready", timeout=1
-                    ).status_code == 200:
-                        break
-                except requests.RequestException:
-                    pass
-                await asyncio.sleep(0.3)
-            assert (
-                requests.get(f"http://127.0.0.1:{probes_port}/ready", timeout=2).status_code
-                == 200
+            assert complete(engine_port) == out1, (
+                "wake must restore identical greedy serving"
             )
-            assert (
-                requests.get(engine + "/is_sleeping", timeout=5).json()[
-                    "is_sleeping"
-                ]
-                is False
-            )
-            inv = requests.get(
-                f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/v2/vllm/instances",
-                timeout=5,
-            ).json()
-            assert inv["total_instances"] == 1, "warm hit must reuse, not recreate"
-            out2 = requests.post(
-                engine + "/v1/completions",
-                json={"prompt": [1, 2, 3], "max_tokens": 3},
-                timeout=60,
-            ).json()["choices"][0]["token_ids"]
-            assert out2 == out1, "wake must restore identical greedy serving"
         finally:
-            await ctl.stop()
-            await transports.close()
-            await ks.stop()
+            await sc.stop()
 
-    asyncio.run(scenario())
+    run(body())
+
+
+@pytest.mark.e2e
+def test_two_iscs_time_share_one_chip_with_device_release(scenario):
+    """The dual-pods product premise, with REAL device release: two different
+    server configs alternate on the SAME chip, each sleep releasing the
+    engine's backend client so the launcher's enforced ChipLedger admits the
+    other (docs/dual-pods.md:20-56; on real TPU the chip has one holder and
+    this alternation is the only way two servers can share it)."""
+    sc = scenario
+    port_a, port_b = free_port(), free_port()
+    release = " --sleep-release-devices always"
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc-a", port_a, extra_options=release)
+            sc.add_isc("isc-b", port_b, extra_options=release)
+            sc.add_launcher_pod()
+
+            # A cold-starts and serves on CHIP
+            sc.add_requester("req-a", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            out_a = complete(port_a)
+
+            # A unbinds -> sleeps WITH device release
+            sc.ks.delete("Pod", sc.ns, "req-a")
+            body_a = await sc.wait_engine_sleeping(port_a, True)
+            assert body_a["devices_released"] is True, (
+                "release-mode sleep must drop the backend client"
+            )
+
+            # B cold-starts on the SAME chip — the launcher's enforced ledger
+            # admits it because A verifiably released
+            reset_stub(sc.default_spi)
+            sc.add_requester("req-b", "isc-b", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert len(complete(port_b)) == 3
+            assert launcher_instances()["total_instances"] == 2, (
+                "A asleep + B awake coexist on one chip"
+            )
+
+            # B unbinds; A warm-wakes (reacquires devices) and serves again
+            sc.ks.delete("Pod", sc.ns, "req-b")
+            await sc.wait_engine_sleeping(port_b, True)
+            reset_stub(sc.default_spi)
+            sc.add_requester("req-a2", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            await sc.wait_engine_sleeping(port_a, False)
+            assert complete(port_a) == out_a, (
+                "generation identical across release/reacquire cycles"
+            )
+            assert launcher_instances()["total_instances"] == 2
+        finally:
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_two_instances_share_one_launcher(scenario, tmp_path):
+    """A sleeping instance and a new awake instance (different config,
+    different chip) coexist on ONE launcher — the reference's 'Multiple
+    Instances Share One Launcher' (test-cases.sh:465-506): scale A down,
+    repoint at a second ISC, and the SAME launcher pod gets a 2nd instance."""
+    sc = scenario
+    port_a, port_b = free_port(), free_port()
+    stub2, spi2, probes2 = spawn_requester_stub([CHIP2], tmp_path / "stub2.log")
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc(max_instances=2)
+            sc.add_isc("isc-a", port_a)
+            sc.add_isc("isc-b", port_b)
+            sc.add_launcher_pod()
+
+            sc.add_requester("req-a", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+
+            # scale A down: launcher stays, unbound, with a sleeping instance
+            sc.ks.delete("Pod", sc.ns, "req-a")
+            await sc.wait_engine_sleeping(port_a, True)
+            pod = sc.ks.get("Pod", sc.ns, "launcher-live")
+            assert C.REQUESTER_ANNOTATION not in (
+                pod["metadata"].get("annotations") or {}
+            ), "launcher must be unbound after scale-down"
+
+            # a different config (different chip) reuses the SAME launcher
+            sc.add_requester("req-b", "isc-b", spi2)
+            await sc.wait_ready(probes2)
+            pod = sc.ks.get("Pod", sc.ns, "launcher-live")
+            assert pod["metadata"]["annotations"][
+                C.REQUESTER_ANNOTATION
+            ].startswith("req-b/"), "same launcher pod must be reused"
+            launcher_pods = [
+                p
+                for p in sc.ks.list(
+                    "Pod", sc.ns, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
+                )
+            ]
+            assert len(launcher_pods) == 1, "no second launcher pod created"
+
+            inv = launcher_instances()
+            assert inv["total_instances"] == 2, "sleeper + new instance coexist"
+            assert inv["running_instances"] == 2, "both processes alive"
+            assert len(complete(port_b)) == 3
+            assert (
+                requests.get(
+                    f"http://127.0.0.1:{port_a}/is_sleeping", timeout=2
+                ).json()["is_sleeping"]
+                is True
+            ), "first instance still asleep on the shared launcher"
+        finally:
+            await sc.stop()
+            stub2.terminate()
+            stub2.wait(timeout=10)
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_launcher_cap_reclaims_unbound_sleeper(scenario):
+    """maxInstances=1: an unbound sleeper is reclaimed (deleted) to make room
+    for a different config (reference 'cap reclaim', test-cases.sh)."""
+    sc = scenario
+    port_a, port_b = free_port(), free_port()
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc(max_instances=1)
+            sc.add_isc("isc-a", port_a)
+            sc.add_isc("isc-b", port_b)
+            sc.add_launcher_pod()
+
+            sc.add_requester("req-a", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            sc.ks.delete("Pod", sc.ns, "req-a")
+            await sc.wait_engine_sleeping(port_a, True)
+            assert launcher_instances()["total_instances"] == 1
+
+            # B arrives: cap is 1, the sleeping A-instance is unbound -> it
+            # is deleted (reclaimed), then B's instance is created
+            reset_stub(sc.default_spi)
+            sc.add_requester("req-b", "isc-b", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert launcher_instances()["total_instances"] == 1, (
+                "cap respected via reclaim"
+            )
+            assert len(complete(port_b)) == 3
+            # A's engine process is gone
+            with pytest.raises(requests.RequestException):
+                requests.get(
+                    f"http://127.0.0.1:{port_a}/health", timeout=2
+                ).raise_for_status()
+        finally:
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_controller_restart_recovers_bindings(scenario):
+    """Kill the controller, start a fresh one on the same cluster state: the
+    binding annotations are authoritative and the warm path still works
+    (reference 'restart recovery'; recover_instance_state)."""
+    sc = scenario
+    engine_port = free_port()
+
+    async def body():
+        await sc.start()
+        sc.add_lc()
+        sc.add_isc("isc1", engine_port)
+        sc.add_launcher_pod()
+        sc.add_requester("req1", "isc1", sc.default_spi)
+        await sc.wait_ready(sc.default_probes)
+        out1 = complete(engine_port)
+
+        # controller dies mid-flight
+        await sc.stop()
+
+        # fresh controller; then unbind -> the NEW controller must sleep an
+        # instance it never saw created
+        await sc.start()
+        try:
+            sc.ks.delete("Pod", sc.ns, "req1")
+            await sc.wait_sleeping_label("launcher-live")
+            await sc.wait_engine_sleeping(engine_port, True)
+            assert launcher_instances()["total_instances"] == 1
+
+            reset_stub(sc.default_spi)
+            sc.add_requester("req2", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert complete(engine_port) == out1
+            assert launcher_instances()["total_instances"] == 1
+        finally:
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_crashed_instance_recovery_via_notifier(scenario):
+    """Engine child crashes; the REAL notifier (watch-driven, over the
+    launcher's HTTP watch) reflects the signature onto the launcher Pod; the
+    controller relays by deleting the requester; re-actuation cold-starts a
+    fresh process (reference 'stopped-instance recovery')."""
+    sc = scenario
+    engine_port = free_port()
+
+    async def body():
+        await sc.start()
+        from llm_d_fast_model_actuation_tpu.launcher.notifier import (
+            HttpSource,
+            InstanceStateNotifier,
+        )
+
+        source = HttpSource(LAUNCHER)
+
+        async def patch(signature: str) -> None:
+            def apply(pod):
+                ann = pod["metadata"].setdefault("annotations", {})
+                if ann.get(C.INSTANCE_SIGNATURE_ANNOTATION) == signature:
+                    return None
+                ann[C.INSTANCE_SIGNATURE_ANNOTATION] = signature
+                return pod
+
+            await asyncio.to_thread(
+                sc.ks.mutate, "Pod", sc.ns, "launcher-live", apply
+            )
+
+        notifier = InstanceStateNotifier(
+            source.lister, patch, watcher=source.watcher, poll_interval_s=0.5
+        )
+        task = asyncio.get_running_loop().create_task(notifier.run())
+        try:
+            sc.add_lc()
+            sc.add_isc("isc1", engine_port, env={"FMA_DEBUG_ENDPOINTS": "1"})
+            sc.add_launcher_pod()
+            sc.add_requester("req1", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert len(complete(engine_port)) == 3
+
+            # crash the engine child for real (the sentinel must fire)
+            requests.post(
+                f"http://127.0.0.1:{engine_port}/debug/crash", timeout=5
+            )
+
+            # controller must delete the requester (failure relay)
+            await sc.wait_gone("Pod", "req1", timeout=120)
+
+            # re-actuation: fresh cold start on a fresh process
+            reset_stub(sc.default_spi)
+            sc.add_requester("req2", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert len(complete(engine_port)) == 3
+            running = [
+                s
+                for s in launcher_instances()["instances"]
+                if s["status"] == "running"
+            ]
+            assert len(running) == 1, "exactly one live instance after recovery"
+        finally:
+            notifier.stop()
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await source.close()
+            await sc.stop()
+
+    run(body())
