@@ -190,6 +190,10 @@ class AcceleratorSpec:
     #: Required sub-slice topology, e.g. "2x2"; empty = any `chips` chips on
     #: one host.
     topology: str = ""
+    #: Whether the ISC explicitly declared an accelerator spec. Only then is
+    #: placement validated against it (an absent spec accepts whatever the
+    #: scheduler assigned, matching the reference's behavior).
+    specified: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"chips": self.chips}
@@ -199,7 +203,11 @@ class AcceleratorSpec:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "AcceleratorSpec":
-        return cls(chips=int(d.get("chips", 1) or 1), topology=d.get("topology", ""))
+        return cls(
+            chips=int(d.get("chips", 1) or 1),
+            topology=d.get("topology", ""),
+            specified=bool(d),
+        )
 
 
 # -- InferenceServerConfig ---------------------------------------------------

@@ -14,6 +14,7 @@ the reference's single doHTTP path (inference-server.go:2208-2253).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional, Protocol
 
@@ -60,6 +61,20 @@ def pod_ip(pod: Dict[str, Any]) -> str:
     if not ip:
         raise RuntimeError(f"pod {pod['metadata']['name']} has no IP yet")
     return ip
+
+
+@contextlib.contextmanager
+def observe_http_latency(purpose: str, method: str):
+    """Public wrapper around the fma_http_latency_seconds discipline, for
+    callers doing controller-originated HTTP outside `_Http` (and for the
+    metrics-catalog test to exercise the real instrumentation path)."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        HTTP_LATENCY.labels(purpose=purpose, method=method).observe(
+            time.monotonic() - t0
+        )
 
 
 class _Http:

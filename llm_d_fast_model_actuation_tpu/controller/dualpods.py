@@ -155,6 +155,8 @@ class DualPodsController:
         self._duality_up: Dict[str, List[Tuple[str, str, str]]] = {}
         self._queues: Dict[str, asyncio.Queue] = {}
         self._workers: Dict[str, asyncio.Task] = {}
+        self._enqueued_at: Dict[Tuple[str, Tuple[str, str, str]], float] = {}
+        self._count_keys: Tuple[Set[str], Set[str]] = (set(), set())
         self._unsub: Optional[Callable[[], None]] = None
         self._stopping = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -204,6 +206,36 @@ class DualPodsController:
             return
         self._loop.call_soon_threadsafe(self._classify_and_enqueue, obj)
 
+    def _refresh_counts(self, ns: str) -> None:
+        """fma_requester_count / fma_isc_count: recomputed from the informer
+        cache on relevant events (reference keeps these via handler-driven
+        gauges; the cache scan is cheap at controller scale). Keys that
+        vanish are zeroed so dashboards don't show ghost series."""
+        req_counts: Dict[str, int] = {}
+        for pod in self.store.list("Pod", ns):
+            if _deleting(pod):
+                continue
+            isc = (pod["metadata"].get("annotations") or {}).get(
+                C.INFERENCE_SERVER_CONFIG_ANNOTATION
+            )
+            if isc:
+                req_counts[isc] = req_counts.get(isc, 0) + 1
+        isc_counts: Dict[str, int] = {}
+        for obj in self.store.list(InferenceServerConfig.KIND, ns):
+            lc = (obj.get("spec") or {}).get("launcherConfigName") or ""
+            if lc:
+                isc_counts[lc] = isc_counts.get(lc, 0) + 1
+        prev_req, prev_isc = self._count_keys
+        for k in prev_req - set(req_counts):
+            M.REQUESTER_COUNT.labels(isc_name=k).set(0)
+        for k, v in req_counts.items():
+            M.REQUESTER_COUNT.labels(isc_name=k).set(v)
+        for k in prev_isc - set(isc_counts):
+            M.ISC_COUNT.labels(launcher_config_name=k).set(0)
+        for k, v in isc_counts.items():
+            M.ISC_COUNT.labels(launcher_config_name=k).set(v)
+        self._count_keys = (set(req_counts), set(isc_counts))
+
     def _classify_and_enqueue(self, obj: Dict[str, Any]) -> None:
         kind = obj.get("kind")
         m = obj.get("metadata") or {}
@@ -216,6 +248,7 @@ class DualPodsController:
                 or C.SERVER_PATCH_ANNOTATION in ann
             ):
                 node = (obj.get("spec") or {}).get("nodeName", "")
+                self._refresh_counts(ns)
                 self._enqueue(node, ("requester", ns, name))
             elif lab.get(C.COMPONENT_LABEL) == C.LAUNCHER_COMPONENT:
                 node = (obj.get("spec") or {}).get("nodeName", "")
@@ -232,6 +265,7 @@ class DualPodsController:
                     )
                     self._enqueue(node, ("requester", ns, req.split("/")[0]))
         elif kind == InferenceServerConfig.KIND:
+            self._refresh_counts(ns)
             self._enqueue("", ("isc-changed", ns, name))
 
     def _enqueue(self, node: str, item: Tuple[str, str, str]) -> None:
@@ -242,6 +276,9 @@ class DualPodsController:
             assert self._loop is not None
             self._workers[node] = self._loop.create_task(self._worker(node, q))
         M.INNER_QUEUE_ADDS.labels(node=node or "-").inc()
+        # queue-wait measurement (queue_duration_seconds, controller.go:206-242);
+        # first-enqueue wins so a retry's wait measures from its re-add
+        self._enqueued_at.setdefault((node, item), time.monotonic())
         q.put_nowait(item)
         M.INNER_QUEUE_DEPTH.labels(node=node or "-").set(q.qsize())
 
@@ -251,6 +288,11 @@ class DualPodsController:
             item = await q.get()
             self._inflight += 1
             M.INNER_QUEUE_DEPTH.labels(node=node or "-").set(q.qsize())
+            t_enq = self._enqueued_at.pop((node, item), None)
+            if t_enq is not None:
+                M.QUEUE_DURATION.labels(node=node or "-").observe(
+                    time.monotonic() - t_enq
+                )
             t0 = time.monotonic()
             try:
                 await self._process(item)
@@ -425,6 +467,15 @@ class DualPodsController:
             raise Retry(f"ISC {isc_name} missing", after=0.5)
         isc = InferenceServerConfig.from_dict(isc_obj)
 
+        acc_errors = self._validate_accelerators(ns, node, isc, sd.chip_ids or [])
+        if acc_errors:
+            # Misplacement is a terminal condition for this requester (the
+            # scheduler gave it the wrong chips); surface it and stop —
+            # actuating a non-contiguous TP engine would put collectives on
+            # a non-ICI path.
+            await self._set_status(ns, name, acc_errors)
+            return
+
         engine_cfg, instance_id = self._desired_instance(isc, isc_name, sd.chip_ids)
         sd.instance_id = instance_id
         sd.server_port = isc.spec.engine_server_config.port
@@ -438,6 +489,94 @@ class DualPodsController:
                 raise Retry("no launcher available yet", after=0.3)
 
         await self._reconcile_bound(ns, req, provider, isc, isc_name, sd)
+
+    def _validate_accelerators(
+        self, ns: str, node: str, isc: InferenceServerConfig, chip_ids: List[str]
+    ) -> List[str]:
+        """ISC ``accelerator.{chips,topology}`` vs the requester-reported
+        chip set — topology-aware placement validation (SURVEY §7; the
+        reference's flat equivalent is the GPU count/index check,
+        inference-server.go:384-399, which cannot express contiguity).
+
+        Chip coordinates come from the chip-map ConfigMap when present,
+        else from the ``...-<x>-<y>[-<z>]`` chip-ID convention the chip
+        translators emit. Without coordinates only the count is checked.
+        """
+        from ..api.types import SliceTopology
+        from ..parallel.topology import contiguous
+
+        spec = isc.spec.engine_server_config.accelerator
+        if not spec.specified:
+            return []  # no declared requirements: scheduler placement stands
+        errors: List[str] = []
+        if spec.chips and len(chip_ids) != spec.chips:
+            errors.append(
+                f"accelerator.chips={spec.chips} but requester reports "
+                f"{len(chip_ids)} chip(s)"
+            )
+        coords = self._chip_coords(ns, node, chip_ids)
+        if coords is None:
+            if spec.topology:
+                errors.append(
+                    f"accelerator.topology={spec.topology} required but chip "
+                    "coordinates are unknown (no chip-map entry and "
+                    "unparseable chip IDs)"
+                )
+            return errors
+        if len(chip_ids) > 1 and not contiguous(coords):
+            errors.append(
+                f"chips {sorted(chip_ids)} are not ICI-contiguous "
+                "(TP collectives would leave the mesh)"
+            )
+        if spec.topology and not errors:
+            want = SliceTopology.parse(spec.topology)
+            spans = []
+            ndim = len(coords[0]) if coords else 0
+            for ax in range(ndim):
+                vals = [c[ax] for c in coords]
+                spans.append(max(vals) - min(vals) + 1)
+
+            def norm(dims):
+                d = sorted(int(x) for x in dims if int(x) > 1)
+                return d or [1]
+
+            if len(chip_ids) != want.num_chips or norm(spans) != norm(want.dims):
+                got = "x".join(str(s) for s in spans) or "1"
+                errors.append(
+                    f"accelerator.topology={spec.topology} but placement is "
+                    f"{got} ({len(chip_ids)} chip(s))"
+                )
+        return errors
+
+    def _chip_coords(
+        self, ns: str, node: str, chip_ids: List[str]
+    ) -> Optional[List[Tuple[int, ...]]]:
+        """ICI coordinates for `chip_ids`, or None when unknowable."""
+        if not chip_ids:
+            return []
+        chip_map = load_chip_map(self.store, ns)
+        if chip_map is not None:
+            host = chip_map.host(node)
+            if host is not None:
+                by_id = host.by_id()
+                if all(c in by_id for c in chip_ids):
+                    return [by_id[c].coords for c in chip_ids]
+        # fall back to the translator ID convention: ...-<x>-<y>[-<z>]
+        coords: List[Tuple[int, ...]] = []
+        for cid in chip_ids:
+            parts = cid.split("-")
+            tail: List[int] = []
+            for p in reversed(parts):
+                if p.isdigit() and len(tail) < 3:
+                    tail.append(int(p))
+                else:
+                    break
+            if len(tail) < 2:
+                return None
+            coords.append(tuple(reversed(tail)))
+        if len({len(c) for c in coords}) != 1:
+            return None
+        return coords
 
     def _desired_instance(
         self, isc: InferenceServerConfig, isc_name: str, chip_ids: List[str]

@@ -80,8 +80,18 @@ WORK_DURATION = Histogram(
     ["node"],
 )
 
+QUEUE_DURATION = Histogram(
+    "fma_dpc_innerqueue_queue_duration_seconds",
+    "Time an item waits in the per-node queue before processing",
+    ["node"],
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+)
+
 
 def serve_metrics(port: int = 8002) -> None:
-    from prometheus_client import start_http_server
+    """Prometheus + debug on one port (the reference serves both from one
+    mux, pkg/observability/prom-and-debug.go:34-79); see utils/observability
+    for the /debug endpoints."""
+    from ..utils.observability import serve_observability
 
-    start_http_server(port)
+    serve_observability(port)

@@ -288,6 +288,7 @@ class Harness:
         port: int = 8000,
         options: str = "--model tiny",
         labels: Optional[Dict[str, str]] = None,
+        accelerator: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         return self.store.create(
             {
@@ -298,6 +299,7 @@ class Harness:
                         "port": port,
                         "options": options,
                         **({"labels": labels} if labels else {}),
+                        **({"accelerator": accelerator} if accelerator else {}),
                     },
                     "launcherConfigName": lc_name,
                 },

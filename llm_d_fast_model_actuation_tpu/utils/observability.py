@@ -1,0 +1,92 @@
+"""One observability endpoint: prometheus metrics + python debug handlers.
+
+The reference serves prometheus and Go pprof from one mux
+(pkg/observability/prom-and-debug.go:34-79). The python-native analogue:
+
+  GET /metrics       — prometheus exposition (default registry)
+  GET /debug/stacks  — current traceback of every thread (the goroutine-dump
+                       equivalent; what you want from a wedged controller)
+  GET /debug/vars    — process vitals: rss, fds, gc counts, thread count
+
+Runs on a daemon thread with the stdlib ThreadingHTTPServer — zero extra
+dependencies, safe to import before an event loop exists.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def _dump_stacks() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def _vars() -> dict:
+    info = {
+        "pid": os.getpid(),
+        "threads": threading.active_count(),
+        "gc_counts": gc.get_count(),
+        "gc_objects": len(gc.get_objects()),
+    }
+    try:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith(("VmRSS", "VmHWM", "Threads", "FDSize")):
+                    k, v = line.split(":", 1)
+                    info["proc_" + k.lower()] = v.strip()
+    except OSError:
+        pass
+    return info
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            from prometheus_client import generate_latest
+
+            self._send(200, generate_latest(), "text/plain; version=0.0.4")
+        elif path == "/debug/stacks":
+            self._send(200, _dump_stacks().encode(), "text/plain")
+        elif path == "/debug/vars":
+            self._send(
+                200, json.dumps(_vars(), default=str).encode(), "application/json"
+            )
+        else:
+            self._send(404, b"not found\n", "text/plain")
+
+
+def serve_observability(
+    port: int, host: str = "0.0.0.0"
+) -> ThreadingHTTPServer:
+    """Start the metrics+debug server on a daemon thread; returns the server
+    (tests call .shutdown())."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(
+        target=server.serve_forever, daemon=True, name="observability"
+    )
+    t.start()
+    return server

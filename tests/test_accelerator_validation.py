@@ -1,0 +1,142 @@
+"""Topology-aware placement validation: the controller consumes the ISC's
+``accelerator.{chips,topology}`` and rejects misplacements with a status
+error instead of actuating (SURVEY §7 "topology-aware placement"; the
+reference's flat GPU-count analogue is inference-server.go:384-399 — it
+cannot express ICI contiguity, which is the TPU-specific constraint).
+
+Chip IDs follow the translator convention ``tpu-<node>-<x>-<y>`` so the
+controller can derive ICI coordinates without a chip-map ConfigMap; one test
+also goes through a real chip-map.
+"""
+
+import json
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+
+from dualpods_harness import Harness, run_scenario
+
+
+def _status_errors(h, name):
+    req = h.store.get("Pod", h.ns, name)
+    raw = (req["metadata"].get("annotations") or {}).get(C.STATUS_ANNOTATION)
+    return json.loads(raw)["Errors"] if raw else []
+
+
+def _actuated(h, name):
+    return h.spis[name].ready
+
+
+def test_wrong_chip_count_rejected():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("isc4", "lc1", accelerator={"chips": 4})
+
+    async def body():
+        h.add_requester("reqA", "isc4", chips=["tpu-n1-0-0", "tpu-n1-0-1"])
+        await h.settle()
+        errs = _status_errors(h, "reqA")
+        assert any("accelerator.chips=4" in e for e in errs), errs
+        assert not _actuated(h, "reqA"), "misplaced requester must not actuate"
+
+    run_scenario(h, body)
+
+
+def test_non_contiguous_placement_rejected():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("isc2", "lc1", accelerator={"chips": 2})
+
+    async def body():
+        # (0,0) and (1,3) on a 2x4 host: not a dense sub-box
+        h.add_requester("reqA", "isc2", chips=["tpu-n1-0-0", "tpu-n1-1-3"])
+        await h.settle()
+        errs = _status_errors(h, "reqA")
+        assert any("ICI-contiguous" in e for e in errs), errs
+        assert not _actuated(h, "reqA")
+
+    run_scenario(h, body)
+
+
+def test_topology_shape_mismatch_rejected():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("isc22", "lc1", accelerator={"chips": 4, "topology": "2x2"})
+
+    async def body():
+        # contiguous 1x4 strip — right count, wrong shape for 2x2
+        h.add_requester(
+            "reqA",
+            "isc22",
+            chips=["tpu-n1-0-0", "tpu-n1-0-1", "tpu-n1-0-2", "tpu-n1-0-3"],
+        )
+        await h.settle()
+        errs = _status_errors(h, "reqA")
+        assert any("topology=2x2" in e for e in errs), errs
+        assert not _actuated(h, "reqA")
+
+    run_scenario(h, body)
+
+
+def test_valid_sub_slice_actuates():
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("isc22", "lc1", accelerator={"chips": 4, "topology": "2x2"})
+
+    async def body():
+        h.add_requester(
+            "reqA",
+            "isc22",
+            chips=["tpu-n1-0-0", "tpu-n1-0-1", "tpu-n1-1-0", "tpu-n1-1-1"],
+        )
+        await h.settle()
+        assert _actuated(h, "reqA"), _status_errors(h, "reqA")
+        assert _status_errors(h, "reqA") == []
+
+    run_scenario(h, body)
+
+
+def test_unspecified_accelerator_accepts_any_placement():
+    """No declared accelerator spec: the scheduler's assignment stands
+    (reference behavior), even for odd chip sets."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+
+    async def body():
+        h.add_requester("reqA", "iscA", chips=["chip-0", "chip-1", "chip-2"])
+        await h.settle()
+        assert _actuated(h, "reqA")
+
+    run_scenario(h, body)
+
+
+def test_chip_map_coordinates_take_precedence():
+    """With a chip-map ConfigMap, coordinates come from it (authoritative),
+    not from parsing the chip ID."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("isc2", "lc1", accelerator={"chips": 2, "topology": "1x2"})
+    # opaque IDs; only the map knows they are adjacent
+    h.store.create(
+        {
+            "kind": "ConfigMap",
+            "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": h.ns},
+            "data": {
+                "n1": "topology: 2x4\n0 serialA 0,0\n1 serialB 0,1\n"
+                "2 serialC 1,3\n3 serialD 1,2"
+            },
+        }
+    )
+
+    async def body():
+        h.add_requester("ok", "isc2", chips=["serialA", "serialB"])
+        await h.settle()
+        assert _actuated(h, "ok"), _status_errors(h, "ok")
+
+        h.add_requester("bad", "isc2", chips=["serialA", "serialC"])
+        await h.settle()
+        errs = _status_errors(h, "bad")
+        assert any("ICI-contiguous" in e for e in errs), errs
+        assert not _actuated(h, "bad")
+
+    run_scenario(h, body)
