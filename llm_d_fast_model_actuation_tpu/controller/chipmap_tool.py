@@ -1,0 +1,197 @@
+"""chip-map population tool — the TPU edition of the reference's
+`scripts/ensure-nodes-mapped.sh` (gpu-map ConfigMap, controller.go:888-924).
+
+Ensures the ``chip-map`` ConfigMap has a data entry for every schedulable
+TPU node: nodes already mapped are left untouched; unmapped nodes are probed
+(in production by launching a one-shot pod on the node that runs the
+`tpuinfo` shim — native/tpuinfo — and prints the chip table; in tests by an
+injected prober) and the result is written in the ChipMap line grammar::
+
+    topology: 2x4
+    0 tpu-n1-0-0 0,0
+    1 tpu-n1-0-1 0,1
+    ...
+
+The hardware-less e2e and real deployments agree on chip identity only
+through this map — same role as the reference's gpu-map.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import subprocess
+from typing import Any, Callable, Dict, List, Optional
+
+from ..api import constants as C
+from ..parallel.topology import ChipMap, HostTopology
+from .store import AlreadyExists
+
+logger = logging.getLogger(__name__)
+
+#: node -> HostTopology (None = probe failed; node is skipped this run)
+Prober = Callable[[str], Optional[HostTopology]]
+
+
+def tpu_nodes(store: Any, selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
+    """Schedulable nodes that look like TPU hosts: either matching the given
+    label selector, or reporting ``google.com/tpu`` capacity."""
+    out = []
+    for node in store.list("Node", selector=selector or None):
+        if (node.get("spec") or {}).get("unschedulable"):
+            logger.info(
+                "skipping unschedulable node %s", node["metadata"]["name"]
+            )
+            continue
+        if selector:
+            out.append(node)
+            continue
+        capacity = ((node.get("status") or {}).get("capacity")) or {}
+        if any("tpu" in k for k in capacity):
+            out.append(node)
+    return out
+
+
+def ensure_nodes_mapped(
+    store: Any,
+    namespace: str,
+    prober: Prober,
+    selector: Optional[Dict[str, str]] = None,
+) -> List[str]:
+    """Idempotently fill the chip-map; returns the nodes newly mapped."""
+    cm_name = C.CHIP_MAP_CONFIGMAP
+    cm = store.try_get("ConfigMap", namespace, cm_name)
+    if cm is None:
+        try:
+            cm = store.create(
+                {
+                    "kind": "ConfigMap",
+                    "metadata": {"name": cm_name, "namespace": namespace},
+                    "data": {},
+                }
+            )
+        except AlreadyExists:
+            cm = store.get("ConfigMap", namespace, cm_name)
+
+    added: List[str] = []
+    for node in tpu_nodes(store, selector):
+        name = node["metadata"]["name"]
+        if (cm.get("data") or {}).get(name):
+            continue  # already mapped: the map is append-only, like gpu-map
+        host = prober(name)
+        if host is None:
+            logger.warning("could not index node %s", name)
+            continue
+        single = ChipMap()
+        single.set_host(name, host)
+        value = single.dump()[name]
+
+        def apply(obj):
+            obj.setdefault("data", {})[name] = value
+            return obj
+
+        cm = store.mutate("ConfigMap", namespace, cm_name, apply)
+        added.append(name)
+        logger.info("mapped node %s (%d chips)", name, len(host.chips))
+    return added
+
+
+def kubectl_tpuinfo_prober(
+    image: str, namespace: str, kubectl: str = "kubectl"
+) -> Prober:
+    """Production prober: run a one-shot pod pinned to the node that executes
+    the tpuinfo shim (`fma-tpuinfo --table`) and parse its log — the same
+    choreography as ensure-nodes-mapped.sh's nvidia-smi pod."""
+
+    def probe(node: str) -> Optional[HostTopology]:
+        pod = f"{node}-chip-map"
+        manifest = f"""
+apiVersion: v1
+kind: Pod
+metadata:
+  name: {pod}
+  labels: {{app: gather-chip-map}}
+spec:
+  restartPolicy: OnFailure
+  nodeSelector: {{kubernetes.io/hostname: "{node}"}}
+  containers:
+  - name: c1
+    image: {image}
+    command: ["python", "-m", "llm_d_fast_model_actuation_tpu.native.tpuinfo", "--table"]
+"""
+        try:
+            subprocess.run(
+                [kubectl, "-n", namespace, "create", "-f", "-"],
+                input=manifest.encode(),
+                check=True,
+            )
+            subprocess.run(
+                [
+                    kubectl, "-n", namespace, "wait", f"pod/{pod}",
+                    "--for", "jsonpath={.status.phase}=Succeeded",
+                    "--timeout", "120s",
+                ],
+                check=True,
+            )
+            logs = subprocess.run(
+                [kubectl, "-n", namespace, "logs", pod],
+                check=True,
+                capture_output=True,
+            ).stdout.decode()
+            cm = ChipMap.parse({node: logs})
+            return cm.host(node)
+        except (subprocess.CalledProcessError, ValueError, KeyError) as e:
+            logger.warning("probe of %s failed: %s", node, e)
+            return None
+        finally:
+            subprocess.run(
+                [kubectl, "-n", namespace, "delete", "pod", pod,
+                 "--ignore-not-found"],
+                check=False,
+            )
+
+    return probe
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fma-ensure-nodes-mapped",
+        description="populate the chip-map ConfigMap for unmapped TPU nodes",
+    )
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--api-base", default="", help="apiserver base URL (default: in-cluster)")
+    p.add_argument(
+        "--node-selector",
+        default="",
+        help="label selector key=value[,k=v] for TPU nodes "
+        "(default: nodes with tpu capacity)",
+    )
+    p.add_argument(
+        "--tpuinfo-image",
+        default="ghcr.io/llm-d/fma-tpu-launcher:latest",
+        help="image containing the fma-tpuinfo shim for probe pods",
+    )
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from .kubestore import KubeStore
+
+    if args.api_base:
+        store = KubeStore(args.api_base, args.namespace, kinds=None)
+    else:
+        store = KubeStore.in_cluster(args.namespace)
+    # one-shot tool: a plain relist is enough, no watch loops
+    store._relist("Node")
+    store._relist("ConfigMap")
+
+    selector = None
+    if args.node_selector:
+        selector = dict(kv.split("=", 1) for kv in args.node_selector.split(","))
+    prober = kubectl_tpuinfo_prober(args.tpuinfo_image, args.namespace)
+    added = ensure_nodes_mapped(store, args.namespace, prober, selector)
+    print(f"mapped {len(added)} node(s): {', '.join(added) or '(none)'}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

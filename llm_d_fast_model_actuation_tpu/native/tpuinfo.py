@@ -72,3 +72,33 @@ def hbm_usage() -> Dict[str, int]:
         c["chip_id"]: int(c.get("hbm_used_bytes", 0))
         for c in _query().get("chips", [])
     }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI for chip-map probe pods (`python -m ...native.tpuinfo --table`):
+    prints the ChipMap line grammar the controller parses — the tpuinfo
+    analogue of the reference probe pods' `nvidia-smi --query-gpu=index,uuid`
+    (scripts/ensure-nodes-mapped.sh)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="fma-tpuinfo")
+    p.add_argument(
+        "--table",
+        action="store_true",
+        help="chip-map grammar: 'topology: TxU' then '<index> <chip_id> <x,y>'",
+    )
+    args = p.parse_args(argv)
+    if args.table:
+        topo = host_topology()
+        if topo:
+            print(f"topology: {topo}")
+        for c in sorted(enumerate_chips(), key=lambda c: int(c["index"])):
+            coords = ",".join(str(x) for x in (c.get("coords") or []))
+            print(f"{c['index']} {c['chip_id']} {coords}".rstrip())
+    else:
+        print(json.dumps(_query(), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,7 +7,12 @@ cmd/test-requester (emulated allocation for hardware-less e2e). Backends:
     fallback), HBM usage from the shim;
   * ``--backend env``    — chips from $TPU_VISIBLE_DEVICES + a chip-map file
     (what the kube scheduler/device plugin would have granted);
-  * ``--backend static`` — explicit ``--chips a,b,c`` (tests).
+  * ``--backend static`` — explicit ``--chips a,b,c`` (tests);
+  * ``--backend alloc``  — claim ``--alloc-count`` chips of ``--chips`` on
+    ``--node`` from the shared ``chip-allocations`` ConfigMap with the
+    optimistic-concurrency loop (reference test-requester contention
+    emulation, cmd/test-requester/gpu-allocation.go:41-257); claims are
+    released on shutdown.
 """
 
 from __future__ import annotations
@@ -26,9 +31,27 @@ from .spi import LogSink, ReadyFlag, SpiServer
 logger = logging.getLogger(__name__)
 
 
-def resolve_chips(args: argparse.Namespace) -> List[str]:
+def resolve_chips(args: argparse.Namespace):
+    """Returns (chip_ids, cleanup_fn_or_None)."""
     if args.backend == "static":
-        return [c for c in args.chips.split(",") if c]
+        return [c for c in args.chips.split(",") if c], None
+    if args.backend == "alloc":
+        from ..controller.kubestore import KubeStore
+        from .allocation import ChipAllocator
+
+        pool = [c for c in args.chips.split(",") if c]
+        if not (args.api_base and args.node and pool and args.alloc_count > 0):
+            raise RuntimeError(
+                "alloc backend needs --api-base, --node, --chips (the node "
+                "pool) and --alloc-count"
+            )
+        store = KubeStore(args.api_base, args.namespace, kinds=None)
+        holder = args.pod_name or os.environ.get("POD_NAME") or f"req-{os.getpid()}"
+        alloc = ChipAllocator(store, args.namespace, args.node, holder)
+        chips = alloc.allocate(
+            args.alloc_count, pool, timeout_s=args.alloc_timeout
+        )
+        return chips, alloc.release
     if args.backend == "env":
         from ..parallel.topology import ChipMap
         import json
@@ -46,11 +69,11 @@ def resolve_chips(args: argparse.Namespace) -> List[str]:
         if host is None:
             raise RuntimeError(f"node {node} not in chip map")
         want = {int(i) for i in visible.split(",")}
-        return [c.chip_id for c in host.chips if c.index in want]
+        return [c.chip_id for c in host.chips if c.index in want], None
     # real
     from ..launcher.chiptranslator import _enumerate_real
 
-    return [c.chip_id for c in _enumerate_real().chips]
+    return [c.chip_id for c in _enumerate_real().chips], None
 
 
 def memory_backend(args: argparse.Namespace, chip_ids: List[str]):
@@ -68,7 +91,7 @@ def memory_backend(args: argparse.Namespace, chip_ids: List[str]):
 async def serve(args: argparse.Namespace) -> None:
     ready = ReadyFlag(False)
     sink = LogSink()
-    chips = resolve_chips(args)
+    chips, cleanup = resolve_chips(args)
     logger.info("requester stub: chips=%s", chips)
     spi = SpiServer(chips, ready, memory_backend(args, chips), sink)
     probes = ProbesServer(ready)
@@ -81,12 +104,24 @@ async def serve(args: argparse.Namespace) -> None:
         await site.start()
         runners.append(runner)
     logger.info("SPI on :%s, probes on :%s", args.spi_port, args.probes_port)
+    # SIGTERM must run the cleanup path: the alloc backend's ConfigMap claims
+    # are released on exit (gpu-allocation.go's defer-release equivalent)
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
     try:
-        while True:
-            await asyncio.sleep(3600)
+        await stop.wait()
     finally:
         for runner in runners:
             await runner.cleanup()
+        if cleanup is not None:
+            cleanup()  # release ConfigMap chip claims (alloc backend)
 
 
 def main(argv=None) -> None:
@@ -100,9 +135,23 @@ def main(argv=None) -> None:
         type=int,
         default=int(os.environ.get("PROBES_PORT", "8080")),
     )
-    p.add_argument("--backend", choices=("real", "env", "static"), default="real")
-    p.add_argument("--chips", default="", help="comma-separated chip IDs (static)")
+    p.add_argument(
+        "--backend", choices=("real", "env", "static", "alloc"), default="real"
+    )
+    p.add_argument(
+        "--chips",
+        default="",
+        help="comma-separated chip IDs (static: owned outright; "
+        "alloc: the node's contended pool)",
+    )
     p.add_argument("--chip-map-path", default="")
+    # alloc backend (ConfigMap-based contention emulation)
+    p.add_argument("--api-base", default="", help="apiserver base URL")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--node", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--pod-name", default=os.environ.get("POD_NAME", ""))
+    p.add_argument("--alloc-count", type=int, default=1)
+    p.add_argument("--alloc-timeout", type=float, default=60.0)
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     asyncio.run(serve(args))

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Populate the chip-map ConfigMap for every schedulable TPU node.
+# TPU edition of the reference's gpu-map population script; the logic lives
+# in python (llm_d_fast_model_actuation_tpu/controller/chipmap_tool.py) so it
+# is unit-testable — this wrapper keeps the familiar entry point.
+#
+# Usage: ensure-nodes-mapped.sh [--namespace NS] [--node-selector k=v] ...
+set -euo pipefail
+exec python -m llm_d_fast_model_actuation_tpu.controller.chipmap_tool "$@"

@@ -1,0 +1,181 @@
+"""test-requester chip-allocation contention (the reference's
+optimistic-concurrency gpu-allocation loop, cmd/test-requester/
+gpu-allocation.go:41-257): multiple requesters race for one node's chips
+through a shared ConfigMap; losers see winners' claims on retry.
+
+Unit tests drive ChipAllocator directly; the e2e case races two real
+requester subprocesses against the fake apiserver over HTTP.
+"""
+
+import json
+import subprocess
+import time
+
+import pytest
+import requests
+
+from llm_d_fast_model_actuation_tpu.requester.allocation import (
+    ALLOCATIONS_CONFIGMAP,
+    ChipAllocator,
+    OutOfChips,
+)
+from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+
+NS = "fma"
+POOL = ["tpu-n1-0-0", "tpu-n1-0-1"]
+
+
+def _claims(store, node="n1"):
+    cm = store.get("ConfigMap", NS, ALLOCATIONS_CONFIGMAP)
+    return json.loads((cm.get("data") or {}).get(node) or "{}")
+
+
+def test_disjoint_claims_and_release():
+    s = InMemoryStore()
+    a = ChipAllocator(s, NS, "n1", "pod-a")
+    b = ChipAllocator(s, NS, "n1", "pod-b")
+
+    got_a = a.allocate(1, POOL)
+    got_b = b.allocate(1, POOL)
+    assert len(got_a) == len(got_b) == 1
+    assert set(got_a).isdisjoint(got_b), "claims must never overlap"
+    assert _claims(s) == {got_a[0]: "pod-a", got_b[0]: "pod-b"}
+
+    # pool exhausted: a third requester times out (deterministically)
+    c = ChipAllocator(s, NS, "n1", "pod-c")
+    with pytest.raises(OutOfChips):
+        c.allocate(1, POOL, timeout_s=0.5, poll_s=0.05)
+
+    # release frees capacity; the waiter succeeds now
+    a.release()
+    got_c = c.allocate(1, POOL, timeout_s=5)
+    assert got_c == got_a, "freed chip is reclaimed (lexically-first pick)"
+
+
+def test_allocate_is_idempotent_per_holder():
+    """Crash-restart safety: re-allocating counts existing claims."""
+    s = InMemoryStore()
+    a = ChipAllocator(s, NS, "n1", "pod-a")
+    first = a.allocate(2, POOL)
+    again = ChipAllocator(s, NS, "n1", "pod-a").allocate(2, POOL)
+    assert sorted(first) == sorted(again)
+    assert len(_claims(s)) == 2
+
+
+def test_concurrent_threads_never_double_book():
+    """Eight holders race for 8 chips from 4 threads — every chip ends with
+    exactly one holder (the CAS loop resolves every conflict)."""
+    import threading
+
+    pool = [f"tpu-n1-0-{i}" for i in range(8)]
+    s = InMemoryStore()
+    results = {}
+
+    def claim(holder):
+        got = ChipAllocator(s, NS, "n1", holder).allocate(2, pool, timeout_s=10)
+        results[holder] = got
+
+    threads = [
+        threading.Thread(target=claim, args=(f"pod-{i}",)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    claimed = [c for got in results.values() for c in got]
+    assert sorted(claimed) == sorted(pool), "all chips allocated exactly once"
+    assert _claims(s) == {
+        c: h for h, got in results.items() for c in got
+    }
+
+
+@pytest.mark.e2e
+def test_two_requesters_race_over_http(tmp_path):
+    """Two real requester subprocesses, one 2-chip node, fake apiserver:
+    deterministic outcome — disjoint single-chip claims, both SPIs serve
+    their allocation, and killing one releases its claim."""
+    import socket
+    import sys
+
+    from conftest import cpu_subprocess_env
+    from fake_apiserver import FakeApiServer
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    srv = FakeApiServer()
+    srv.start()
+    procs = []
+    try:
+        spis = []
+        for i in range(2):
+            spi, probes = free_port(), free_port()
+            spis.append(spi)
+            with open(tmp_path / f"req{i}.log", "wb") as out:
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m",
+                            "llm_d_fast_model_actuation_tpu.requester.main",
+                            "--host", "127.0.0.1",
+                            "--backend", "alloc",
+                            "--api-base", f"http://127.0.0.1:{srv.port}",
+                            "--namespace", NS,
+                            "--node", "n1",
+                            "--pod-name", f"pod-{i}",
+                            "--chips", ",".join(POOL),
+                            "--alloc-count", "1",
+                            "--spi-port", str(spi),
+                            "--probes-port", str(probes),
+                        ],
+                        env=cpu_subprocess_env(),
+                        stdout=out,
+                        stderr=subprocess.STDOUT,
+                    )
+                )
+
+        def spi_chips(port, timeout=60):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    r = requests.get(
+                        f"http://127.0.0.1:{port}/v1/dual-pods/accelerators",
+                        timeout=2,
+                    )
+                    if r.status_code == 200:
+                        return r.json()
+                except requests.RequestException:
+                    pass
+                time.sleep(0.2)
+            raise TimeoutError(f"SPI {port} never served")
+
+        got0, got1 = spi_chips(spis[0]), spi_chips(spis[1])
+        assert len(got0) == len(got1) == 1
+        assert set(got0).isdisjoint(got1), f"double-booked: {got0} vs {got1}"
+
+        # SIGTERM pod-0: its claim must be released in the ConfigMap
+        procs[0].terminate()
+        procs[0].wait(timeout=15)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            cm = requests.get(
+                f"http://127.0.0.1:{srv.port}/api/v1/namespaces/{NS}/"
+                f"configmaps/{ALLOCATIONS_CONFIGMAP}",
+                timeout=5,
+            ).json()
+            claims = json.loads((cm.get("data") or {}).get("n1") or "{}")
+            if "pod-0" not in claims.values():
+                break
+            time.sleep(0.3)
+        assert list(claims.values()) == ["pod-1"], claims
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        srv.stop()

@@ -129,7 +129,7 @@ def test_static_backend_resolution():
     import argparse
 
     args = argparse.Namespace(backend="static", chips="a,b,c", chip_map_path="")
-    assert resolve_chips(args) == ["a", "b", "c"]
+    assert resolve_chips(args) == (["a", "b", "c"], None)
 
 
 def test_env_backend_resolution(tmp_path, monkeypatch):
@@ -147,5 +147,6 @@ def test_env_backend_resolution(tmp_path, monkeypatch):
     monkeypatch.setenv("NODE_NAME", "n9")
     monkeypatch.setenv("TPU_VISIBLE_DEVICES", "1,3")
     args = argparse.Namespace(backend="env", chips="", chip_map_path=str(path))
-    got = resolve_chips(args)
+    got, cleanup = resolve_chips(args)
+    assert cleanup is None
     assert got == [host.chips[1].chip_id, host.chips[3].chip_id]
