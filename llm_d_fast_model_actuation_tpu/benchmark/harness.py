@@ -121,9 +121,10 @@ class ActuationBenchmark:
     def __init__(self, cfg: Optional[BenchmarkConfig] = None, **harness_kwargs) -> None:
         self.cfg = cfg or BenchmarkConfig()
         if self.cfg.mode != "simulated":
-            raise NotImplementedError(
-                f"mode {self.cfg.mode!r}: only 'simulated' runs without a cluster; "
-                "point the controller Transports at a live stack for the rest"
+            raise ValueError(
+                f"mode {self.cfg.mode!r}: ActuationBenchmark is the simulated "
+                "driver; real-stack measurement is benchmark.live "
+                "(LiveBenchmark / run_baseline_live)"
             )
         self.harness = Harness(latencies=self.cfg.latencies(), **harness_kwargs)
         self._counter = 0

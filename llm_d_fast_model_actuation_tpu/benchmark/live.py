@@ -1,0 +1,267 @@
+"""Live-mode benchmark: T_actuation against a REAL stack over real HTTP.
+
+The reference's benchmark runs in three modes (benchmark_base.py:34-99):
+simulated, kind (it creates the cluster), and remote (points at one). Here
+"live" covers the last two: the benchmark speaks to an apiserver (the fake
+one it can start itself, or any real one via --api-base), runs the real
+dual-pods controller against it, and measures requester-create -> readiness
+over the real launcher/engine subprocess stack.
+
+Path classification is observed from the outside, the way an SRE would:
+the launcher inventory before/after the actuation (instance created ->
+cold), or the engine's /is_sleeping flip (asleep -> awake: warm), else hot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from ..api import constants as C
+from .harness import PairResult, ScenarioReport
+
+
+@dataclass
+class LiveConfig:
+    api_base: str  #: apiserver base URL (e.g. the fake apiserver, or kind)
+    namespace: str = "bench"
+    node: str = "n1"
+    launcher_url: str = f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}"
+    spi_port: int = 0  #: requester stub SPI (readiness relay target)
+    probes_port: int = 0  #: requester stub probes (/ready polled)
+    engine_port_base: int = 18100
+    readiness_poll_s: float = 0.2
+    timeout_s: float = 180.0
+    #: engine options template; {port} is substituted per ISC
+    engine_options: str = (
+        "--model tiny --port {port} --num-pages 32 --max-batch 2 "
+        "--page-size 8 --max-model-len 64"
+    )
+    engine_env: Dict[str, str] = field(
+        default_factory=lambda: {"JAX_PLATFORMS": "cpu"}
+    )
+
+
+class LiveBenchmark:
+    """Drives actuations against a running stack; the controller itself runs
+    in-process against the same apiserver (what the deployment's controller
+    pod would do)."""
+
+    def __init__(self, cfg: LiveConfig) -> None:
+        self.cfg = cfg
+        self._isc_counter = 0  # engine-port assignment
+        self._req_counter = 0  # requester pod naming
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.ks = None
+        self.ctl = None
+        self.transports = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        from ..controller.clients import HttpTransports
+        from ..controller.dualpods import DualPodsConfig, DualPodsController
+        from ..controller.kubestore import KubeStore
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30)
+        )
+        self.ks = KubeStore(self.cfg.api_base, self.cfg.namespace, kinds=None)
+        await self.ks.start()
+        self.transports = HttpTransports()
+        self.ctl = DualPodsController(
+            self.ks, self.transports, DualPodsConfig(namespace=self.cfg.namespace)
+        )
+        await self.ctl.start()
+
+    async def stop(self) -> None:
+        if self.ctl:
+            await self.ctl.stop()
+        if self.transports:
+            await self.transports.close()
+        if self.ks:
+            await self.ks.stop()
+        if self._session:
+            await self._session.close()
+
+    # -- cluster objects -----------------------------------------------------
+
+    def deploy_config(self, isc_name: str, lc_name: str = "bench-lc") -> int:
+        """Create LC/ISC (+ the launcher Pod object mirroring the running
+        launcher process); returns the ISC's engine port."""
+        port = self.cfg.engine_port_base + self._isc_counter
+        self._isc_counter += 1
+        if self.ks.try_get("LauncherConfig", self.cfg.namespace, lc_name) is None:
+            self.ks.create(
+                {
+                    "kind": "LauncherConfig",
+                    "metadata": {"name": lc_name, "namespace": self.cfg.namespace},
+                    "spec": {
+                        "podTemplate": {
+                            "metadata": {},
+                            "spec": {"containers": [{"name": "launcher"}]},
+                        },
+                        "maxInstances": 4,
+                    },
+                }
+            )
+            self._create_launcher_pod_object(lc_name)
+        self.ks.create(
+            {
+                "kind": "InferenceServerConfig",
+                "metadata": {"name": isc_name, "namespace": self.cfg.namespace},
+                "spec": {
+                    "modelServerConfig": {
+                        "port": port,
+                        "options": self.cfg.engine_options.format(port=port),
+                        "env_vars": dict(self.cfg.engine_env),
+                    },
+                    "launcherConfigName": lc_name,
+                },
+            }
+        )
+        return port
+
+    def _create_launcher_pod_object(self, lc_name: str) -> None:
+        from ..api.types import LauncherConfig
+        from ..controller.populator import (
+            build_launcher_template,
+            specialize_to_node,
+        )
+
+        lc = LauncherConfig.from_dict(
+            self.ks.get("LauncherConfig", self.cfg.namespace, lc_name)
+        )
+        _, ti_hash = build_launcher_template(lc)
+        pod = specialize_to_node(lc, self.cfg.node, ti_hash)
+        pod["metadata"]["namespace"] = self.cfg.namespace
+        pod["metadata"]["name"] = "bench-launcher-live"
+        pod["status"] = {
+            "podIP": "127.0.0.1",
+            "conditions": [{"type": "Ready", "status": "True"}],
+        }
+        self.ks.create(pod)
+
+    # -- measurement ---------------------------------------------------------
+
+    async def _http_json(self, method: str, url: str) -> Any:
+        async with self._session.request(method, url) as resp:
+            resp.raise_for_status()
+            return await resp.json()
+
+    async def _instances(self) -> Dict[str, Any]:
+        return await self._http_json(
+            "GET", self.cfg.launcher_url + "/v2/vllm/instances"
+        )
+
+    async def _stub_ready(self) -> bool:
+        try:
+            async with self._session.get(
+                f"http://127.0.0.1:{self.cfg.probes_port}/ready"
+            ) as resp:
+                return resp.status == 200
+        except aiohttp.ClientError:
+            return False
+
+    async def _reset_stub(self) -> None:
+        async with self._session.post(
+            f"http://127.0.0.1:{self.cfg.spi_port}/v1/become-unready"
+        ) as resp:
+            resp.raise_for_status()
+
+    async def actuate(self, isc_name: str, engine_port: int) -> PairResult:
+        """Create a requester Pod; T_actuation = create -> readiness relay
+        observed at the stub's probes endpoint (the reference's definition:
+        requester create -> Ready)."""
+        await self._reset_stub()
+        before = await self._instances()
+        before_ids = {s["instance_id"] for s in before.get("instances", [])}
+        was_sleeping = False
+        try:
+            body = await self._http_json(
+                "GET", f"http://127.0.0.1:{engine_port}/is_sleeping"
+            )
+            was_sleeping = bool(body.get("is_sleeping"))
+        except aiohttp.ClientError:
+            pass
+
+        name = f"bench-req-{self._req_counter:06d}"
+        self._req_counter += 1
+        t0 = time.monotonic()
+        self.ks.create(
+            {
+                "kind": "Pod",
+                "metadata": {
+                    "name": name,
+                    "namespace": self.cfg.namespace,
+                    "annotations": {
+                        C.INFERENCE_SERVER_CONFIG_ANNOTATION: isc_name,
+                        C.ADMIN_PORT_ANNOTATION: str(self.cfg.spi_port),
+                    },
+                },
+                "spec": {
+                    "nodeName": self.cfg.node,
+                    "containers": [
+                        {"name": C.INFERENCE_SERVER_CONTAINER_NAME}
+                    ],
+                },
+                "status": {"podIP": "127.0.0.1"},
+            }
+        )
+        deadline = t0 + self.cfg.timeout_s
+        while not await self._stub_ready():
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{name} not ready in {self.cfg.timeout_s}s")
+            await asyncio.sleep(self.cfg.readiness_poll_s)
+        elapsed = time.monotonic() - t0
+
+        after = await self._instances()
+        after_ids = {s["instance_id"] for s in after.get("instances", [])}
+        if after_ids - before_ids:
+            path = "cold"
+        elif was_sleeping:
+            path = "warm"
+        else:
+            path = "hot"
+        return PairResult(name=name, t_actuation_s=elapsed, path=path)
+
+    async def scale_down(self, isc_name: str, engine_port: int) -> None:
+        """Delete this ISC's requesters; wait until the engine reports
+        sleeping (the instance survives for the next warm hit)."""
+        for pod in self.ks.list("Pod", self.cfg.namespace):
+            ann = pod["metadata"].get("annotations") or {}
+            if ann.get(C.INFERENCE_SERVER_CONFIG_ANNOTATION) == isc_name:
+                self.ks.delete("Pod", self.cfg.namespace, pod["metadata"]["name"])
+        deadline = time.monotonic() + self.cfg.timeout_s
+        while time.monotonic() < deadline:
+            try:
+                body = await self._http_json(
+                    "GET", f"http://127.0.0.1:{engine_port}/is_sleeping"
+                )
+                if body.get("is_sleeping"):
+                    return
+            except aiohttp.ClientError:
+                pass
+            await asyncio.sleep(self.cfg.readiness_poll_s)
+        raise TimeoutError("instance never went to sleep after scale-down")
+
+
+async def run_baseline_live(cfg: LiveConfig) -> ScenarioReport:
+    """cold -> scale-down -> warm, measured over the live stack (the
+    reference baseline scenario shape)."""
+    bench = LiveBenchmark(cfg)
+    await bench.start()
+    report = ScenarioReport("baseline", "live", time_scale=0.0)
+    try:
+        port = bench.deploy_config("bench-isc")
+        report.pairs.append(await bench.actuate("bench-isc", port))
+        await bench.scale_down("bench-isc", port)
+        report.pairs.append(await bench.actuate("bench-isc", port))
+        report.extra["paths_in_order"] = [p.path for p in report.pairs]
+    finally:
+        await bench.stop()
+    return report

@@ -115,7 +115,43 @@ def main(argv=None) -> None:
         help="pair count for baseline/scaling (new_variant is sized by its model list)",
     )
     p.add_argument("--time-scale", type=float, default=0.01)
+    p.add_argument(
+        "--mode",
+        choices=["simulated", "live"],
+        default="simulated",
+        help="simulated = in-process fakes with scaled latencies; live = "
+        "measure a running stack over HTTP (see --api-base et al.)",
+    )
+    p.add_argument("--api-base", default="", help="live: apiserver base URL")
+    p.add_argument("--namespace", default="bench")
+    p.add_argument("--node", default="n1")
+    p.add_argument("--spi-port", type=int, default=0, help="live: requester stub SPI port")
+    p.add_argument("--probes-port", type=int, default=0, help="live: requester stub probes port")
     args = p.parse_args(argv)
+
+    if args.mode == "live":
+        from .live import LiveConfig, run_baseline_live
+
+        if not (args.api_base and args.spi_port and args.probes_port):
+            p.error("--mode live needs --api-base, --spi-port, --probes-port")
+        if args.scenario not in ("baseline", "all") or args.pairs != 4:
+            p.error(
+                "--mode live currently measures the baseline scenario only "
+                "(cold -> warm); --scenario/--pairs do not apply"
+            )
+        report = asyncio.run(
+            run_baseline_live(
+                LiveConfig(
+                    api_base=args.api_base,
+                    namespace=args.namespace,
+                    node=args.node,
+                    spi_port=args.spi_port,
+                    probes_port=args.probes_port,
+                )
+            )
+        )
+        print(json.dumps(report.summary(), indent=2))
+        return
 
     cfg = BenchmarkConfig(time_scale=args.time_scale)
     if args.scenario == "baseline":

@@ -431,6 +431,32 @@ class DualPodsController:
             sd = ServerData(requester_uid=uid)
             self.server_data[uid] = sd
 
+        # A requester with no provider yet on a cordoned node can never be
+        # served — delete it so its ReplicaSet reschedules elsewhere
+        # (inference-server.go:603-613).
+        if provider is None:
+            node_obj = self.store.try_get("Node", "", node)
+            if node_obj is not None and (node_obj.get("spec") or {}).get(
+                "unschedulable"
+            ):
+                logger.warning(
+                    "deleting requester %s: node %s unschedulable and no "
+                    "provider bound",
+                    name,
+                    node,
+                )
+                try:
+                    # uid precondition: never delete a newer incarnation that
+                    # raced in under the same name
+                    await asyncio.to_thread(
+                        self.store.delete, "Pod", ns, name, expect_uid=uid
+                    )
+                except (NotFound, Conflict):
+                    pass
+                await self._remove_finalizer("Pod", ns, name)
+                self.server_data.pop(uid, None)
+                return
+
         # chip discovery via the requester SPI (once)
         if sd.chip_ids is None:
             spi = self.transports.requester_spi(req)

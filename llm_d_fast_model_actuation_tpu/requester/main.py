@@ -89,23 +89,10 @@ def memory_backend(args: argparse.Namespace, chip_ids: List[str]):
 
 
 async def serve(args: argparse.Namespace) -> None:
-    ready = ReadyFlag(False)
-    sink = LogSink()
-    chips, cleanup = resolve_chips(args)
-    logger.info("requester stub: chips=%s", chips)
-    spi = SpiServer(chips, ready, memory_backend(args, chips), sink)
-    probes = ProbesServer(ready)
-
-    runners = []
-    for app, port in ((spi.build_app(), args.spi_port), (probes.build_app(), args.probes_port)):
-        runner = web.AppRunner(app)
-        await runner.setup()
-        site = web.TCPSite(runner, args.host, port)
-        await site.start()
-        runners.append(runner)
-    logger.info("SPI on :%s, probes on :%s", args.spi_port, args.probes_port)
-    # SIGTERM must run the cleanup path: the alloc backend's ConfigMap claims
-    # are released on exit (gpu-allocation.go's defer-release equivalent)
+    # SIGTERM must run the cleanup path — the alloc backend's ConfigMap
+    # claims are released on exit (gpu-allocation.go's defer-release
+    # equivalent) — so install handlers BEFORE the (blocking, up to
+    # --alloc-timeout) allocation loop runs.
     import signal
 
     stop = asyncio.Event()
@@ -115,9 +102,29 @@ async def serve(args: argparse.Namespace) -> None:
             loop.add_signal_handler(sig, stop.set)
         except (NotImplementedError, RuntimeError):
             pass
+
+    ready = ReadyFlag(False)
+    sink = LogSink()
+    chips, cleanup = resolve_chips(args)
+    logger.info("requester stub: chips=%s", chips)
+    runners = []
     try:
+        spi = SpiServer(chips, ready, memory_backend(args, chips), sink)
+        probes = ProbesServer(ready)
+        for app, port in (
+            (spi.build_app(), args.spi_port),
+            (probes.build_app(), args.probes_port),
+        ):
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, args.host, port)
+            await site.start()
+            runners.append(runner)
+        logger.info("SPI on :%s, probes on :%s", args.spi_port, args.probes_port)
         await stop.wait()
     finally:
+        # covers server-startup failures too: a claim must never outlive
+        # the process that holds it
         for runner in runners:
             await runner.cleanup()
         if cleanup is not None:

@@ -50,3 +50,23 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs[:8]
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared helper for subprocess e2e)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def port_free(port: int) -> bool:
+    import socket
+
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+            return True
+        except OSError:
+            return False

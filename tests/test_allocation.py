@@ -97,13 +97,9 @@ def test_two_requesters_race_over_http(tmp_path):
     import socket
     import sys
 
-    from conftest import cpu_subprocess_env
+    from conftest import cpu_subprocess_env, free_port, port_free
     from fake_apiserver import FakeApiServer
 
-    def free_port():
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
 
     srv = FakeApiServer()
     srv.start()

@@ -6,6 +6,8 @@ logic — path classification, hit rates, report shape — is what's under test.
 
 import asyncio
 
+import pytest
+
 from llm_d_fast_model_actuation_tpu.benchmark import (
     BenchmarkConfig,
     run_baseline,
@@ -44,3 +46,116 @@ def test_simulated_latencies_scale_timings():
     out = asyncio.run(run_baseline(1, cfg))
     # cold path = launcher start + instance create >= 60 s unscaled
     assert out["T_actuation_s"]["min"] >= 50, out
+
+
+@pytest.mark.e2e
+def test_live_mode_measures_real_stack(tmp_path):
+    """Live benchmark mode (the reference's kind/remote modes,
+    benchmark_base.py:34-99): cold then warm actuation measured over the
+    real subprocess stack, classified from outside observation."""
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    import requests as _requests
+
+    from conftest import cpu_subprocess_env, free_port, port_free
+    from fake_apiserver import FakeApiServer
+    from llm_d_fast_model_actuation_tpu.api import constants as C
+    from llm_d_fast_model_actuation_tpu.benchmark.live import (
+        LiveConfig,
+        run_baseline_live,
+    )
+
+
+
+    if not port_free(C.LAUNCHER_SERVICE_PORT):
+        pytest.skip("launcher port busy")
+
+    srv = FakeApiServer()
+    srv.start()
+    spi, probes = free_port(), free_port()
+    procs = []
+    try:
+        for args, log in (
+            (
+                [
+                    "llm_d_fast_model_actuation_tpu.launcher.main",
+                    "--mock-chips", "--mock-chip-count", "4",
+                    "--mock-topology", "2x2",
+                    "--host", "127.0.0.1",
+                    "--port", str(C.LAUNCHER_SERVICE_PORT),
+                    "--log-dir", str(tmp_path / "llogs"),
+                ],
+                tmp_path / "launcher.log",
+            ),
+            (
+                [
+                    "llm_d_fast_model_actuation_tpu.requester.main",
+                    "--host", "127.0.0.1",
+                    "--backend", "static",
+                    "--chips", "tpu-mock-0-0",
+                    "--spi-port", str(spi),
+                    "--probes-port", str(probes),
+                ],
+                tmp_path / "requester.log",
+            ),
+        ):
+            with open(log, "wb") as out:
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, "-m", *args],
+                        env=cpu_subprocess_env(),
+                        stdout=out,
+                        stderr=subprocess.STDOUT,
+                    )
+                )
+        deadline = _time.time() + 90
+        while _time.time() < deadline:
+            try:
+                if (
+                    _requests.get(
+                        f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/health",
+                        timeout=2,
+                    ).status_code
+                    == 200
+                    and _requests.get(
+                        f"http://127.0.0.1:{spi}/v1/dual-pods/accelerators",
+                        timeout=2,
+                    ).status_code
+                    == 200
+                ):
+                    break
+            except _requests.RequestException:
+                pass
+            _time.sleep(0.3)
+
+        report = asyncio.run(
+            run_baseline_live(
+                LiveConfig(
+                    api_base=f"http://127.0.0.1:{srv.port}",
+                    namespace="bench-live",
+                    spi_port=spi,
+                    probes_port=probes,
+                    engine_port_base=free_port(),
+                )
+            )
+        )
+        summary = report.summary()
+        assert summary["pairs"] == 2
+        assert summary["paths"] == {"cold": 1, "warm": 1}
+        assert summary["T_actuation_s"]["max"] > 0
+        # live mode reports wall time unscaled
+        assert summary["T_actuation_measured_s"]["avg"] == pytest.approx(
+            summary["T_actuation_s"]["avg"]
+        )
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        srv.stop()

@@ -454,3 +454,53 @@ def test_status_annotation_on_bad_isc():
         assert any("missing-isc" in e for e in status["Errors"])
 
     run_scenario(h, body)
+
+
+def test_unschedulable_node_deletes_unbound_requester():
+    """A requester with no provider on a cordoned node is deleted so its
+    ReplicaSet can reschedule (inference-server.go:603-613)."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+    h.store.create(
+        {"kind": "Node", "metadata": {"name": "n1"}, "spec": {"unschedulable": True}}
+    )
+
+    async def body():
+        h.add_requester("reqA", "iscA")
+        await h.settle()
+        assert h.store.try_get("Pod", h.ns, "reqA") is None
+        assert h.launcher_pods() == []
+
+    run_scenario(h, body)
+
+
+def test_unschedulable_node_keeps_bound_requester():
+    """Cordoning a node does NOT tear down an already-bound pair (the
+    reference deletes only when providingPod == nil)."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+    h.store.create({"kind": "Node", "metadata": {"name": "n1"}, "spec": {}})
+
+    async def body():
+        h.add_requester("reqA", "iscA")
+        await h.settle()
+        assert h.spis["reqA"].ready
+
+        def cordon(node):
+            node.setdefault("spec", {})["unschedulable"] = True
+            return node
+
+        h.store.mutate("Node", "", "n1", cordon)
+        # nudge the requester and let the controller look again
+        h.store.mutate(
+            "Pod", h.ns, "reqA",
+            lambda p: (p["metadata"].setdefault("annotations", {}).__setitem__(
+                "poke", "1") or p),
+        )
+        await h.settle()
+        assert h.store.try_get("Pod", h.ns, "reqA") is not None
+        assert h.spis["reqA"].ready
+
+    run_scenario(h, body)

@@ -37,26 +37,12 @@ from llm_d_fast_model_actuation_tpu.controller.dualpods import (
 )
 from llm_d_fast_model_actuation_tpu.controller.kubestore import KubeStore
 
+from conftest import free_port, port_free
 from fake_apiserver import FakeApiServer
 
 NODE = "n1"
 CHIP = "tpu-mock-0-0"
 CHIP2 = "tpu-mock-0-1"
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def port_free(port: int) -> bool:
-    with socket.socket() as s:
-        try:
-            s.bind(("127.0.0.1", port))
-            return True
-        except OSError:
-            return False
 
 
 def wait_http(url: str, timeout: float = 90.0) -> None:

@@ -17,11 +17,7 @@ import time
 import pytest
 import requests
 
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from conftest import free_port
 
 
 def wait_http(url: str, timeout: float = 60.0) -> None:
