@@ -9,8 +9,11 @@ token. Prints ONE JSON line:
   value   host->HBM wake bandwidth in GiB/s
   vs_baseline  value / 21.33 GiB/s (the reference's published wake rate)
 
-Extra fields carry the full actuation breakdown (sleep s, wake s, TTFT after
-wake, decode tok/s) for BENCH_r{N}.json archaeology.
+Extra fields carry the full actuation breakdown: checkpoint load (the real
+cold-start path), decode throughput at batch, TTFT after wake, and the
+device-release cycle (sleep that actually frees the TPU chip for another
+process + wake that re-acquires it — the dual-pods time-sharing mechanism;
+engine/device.py).
 """
 
 import json
@@ -19,7 +22,6 @@ import sys
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 # Persistent compile cache (the launcher arms the same for serving children):
@@ -33,15 +35,15 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 def main() -> None:
     from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
-    from llm_d_fast_model_actuation_tpu.engine.sleep import attach_sleep
-    from llm_d_fast_model_actuation_tpu.models import llama
-
     from llm_d_fast_model_actuation_tpu.engine.server import MODEL_CONFIGS
+    from llm_d_fast_model_actuation_tpu.engine.sleep import attach_sleep
+    from llm_d_fast_model_actuation_tpu.models import checkpoint, llama
 
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
-        # ~1.4B params (2.8 GiB bf16) + 1.6 GiB KV pool: sized for one v5e chip.
-        model = MODEL_CONFIGS["bench-1b"]()
+        # ~1.26B params (2.4 GiB bf16) + KV pool: sized for one v5e chip.
+        model_name = "bench-1b"
+        model = MODEL_CONFIGS[model_name]()
         cfg = EngineConfig(
             model=model, max_batch=8, page_size=16, num_pages=512,
             max_seq_len=1024, decode_chunk=16,
@@ -50,12 +52,33 @@ def main() -> None:
         # single-step drain tail).
         prompt_len, decode_steps = 128, 65
     else:
+        model_name = "tiny"
         model = llama.LlamaConfig.tiny()
         cfg = EngineConfig(model=model, max_batch=4, page_size=8, num_pages=64, max_seq_len=64)
         prompt_len, decode_steps = 16, 8
 
+    # --- the real cold path: weights come from a checkpoint ------------------
+    ckpt_dir = os.environ.get(
+        "FMA_BENCH_CKPT", f"/tmp/fma-bench-ckpt-{model_name}"
+    )
+    if not os.path.isdir(os.path.join(ckpt_dir, checkpoint.PARAMS_DIR)):
+        t0 = time.monotonic()
+        params = llama.init_params(jax.random.key(0), model)
+        params = jax.block_until_ready(params)
+        checkpoint.save_params(ckpt_dir, model, params)
+        del params
+        seed_s = time.monotonic() - t0
+    else:
+        seed_s = 0.0
+
     t0 = time.monotonic()
-    eng = InferenceEngine(cfg, seed=0)
+    params = checkpoint.load_params(ckpt_dir, model)
+    params = jax.block_until_ready(params)
+    ckpt_load_s = time.monotonic() - t0
+    param_gib = sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30
+
+    t0 = time.monotonic()
+    eng = InferenceEngine(cfg, params=params, seed=0)
     jax.block_until_ready(eng.params)
     init_s = time.monotonic() - t0
 
@@ -76,8 +99,7 @@ def main() -> None:
     for p in prompts:
         eng.add_request(p, max_new_tokens=decode_steps)
     while eng._waiting:
-        finished = eng.step()
-        reqs.extend(finished)
+        reqs.extend(eng.step())
     live = [r for r in eng._slots if r is not None]
     emitted_at_t0 = sum(len(r.out_tokens) for r in live) + sum(
         len(r.out_tokens) for r in reqs
@@ -91,7 +113,7 @@ def main() -> None:
         (total_emitted - emitted_at_t0) / decode_s if decode_s > 0 else 0.0
     )
 
-    # --- the actuation cycle -------------------------------------------------
+    # --- the actuation cycle: plain (in-HBM-holder) sleep/wake ---------------
     mgr = attach_sleep(eng)
     state_bytes = sum(
         x.nbytes
@@ -112,6 +134,19 @@ def main() -> None:
     ttft_after_wake = time.monotonic() - t_ttft0
     assert first[0] == warm[0], "generation changed across sleep/wake"
 
+    # --- the device-release cycle: the chip is actually freed ---------------
+    info = mgr.sleep(1, release=True)
+    release_sleep_s = info["last_sleep_seconds"]
+    assert info["devices_released"]
+
+    t0 = time.monotonic()
+    info = mgr.wake_up()
+    wake_reacquire_s = time.monotonic() - t0
+    t_ttft0 = time.monotonic()
+    first2 = eng.generate([prompt], max_new_tokens=1)[0]
+    ttft_after_reacquire = time.monotonic() - t_ttft0
+    assert first2[0] == warm[0], "generation changed across device release"
+
     wake_gibps = gib / wake_s if wake_s > 0 else 0.0
     baseline_gibps = 64.0 / 3.0  # reference: 64 GiB in ~3 s
     result = {
@@ -126,7 +161,18 @@ def main() -> None:
             "wake_s": round(wake_s, 4),
             "wake_to_first_token_s": round(wake_s + ttft_after_wake, 4),
             "ttft_after_wake_s": round(ttft_after_wake, 4),
+            "release_sleep_s": round(release_sleep_s, 4),
+            "wake_with_reacquire_s": round(wake_reacquire_s, 4),
+            "ttft_after_reacquire_s": round(ttft_after_reacquire, 4),
+            "reacquire_to_first_token_s": round(
+                wake_reacquire_s + ttft_after_reacquire, 4
+            ),
             "decode_tok_s": round(decode_tok_s, 1),
+            "checkpoint_load_s": round(ckpt_load_s, 2),
+            "checkpoint_load_gibps": round(
+                param_gib / ckpt_load_s if ckpt_load_s > 0 else 0.0, 2
+            ),
+            "checkpoint_seed_s": round(seed_s, 2),
             "engine_init_s": round(init_s, 2),
             "first_compile_s": round(compile_s, 2),
             "model_params": model.num_params(),

@@ -92,6 +92,12 @@ def make_arg_parser() -> argparse.ArgumentParser:
         help="tear down the TPU client on sleep so other instances can use "
         "the chip (auto = on for TPU, off elsewhere)",
     )
+    p.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help="load weights from this Orbax checkpoint (and reload from it "
+        "on level-2 wake) instead of random init",
+    )
     return p
 
 
@@ -135,6 +141,14 @@ class EngineService:
             from ..parallel.mesh import MeshPlan, make_mesh
 
             mesh = make_mesh(MeshPlan(tp=args.tensor_parallel_size))
+        params = None
+        self.checkpoint_dir = getattr(args, "checkpoint_dir", "") or ""
+        if self.checkpoint_dir:
+            from ..models import checkpoint
+
+            params = checkpoint.load_params(
+                self.checkpoint_dir, model_cfg, mesh=mesh
+            )
         self.engine = InferenceEngine(
             EngineConfig(
                 model=model_cfg,
@@ -146,6 +160,7 @@ class EngineService:
                 attention_impl=args.attention_impl,
                 decode_chunk=args.decode_chunk,
             ),
+            params=params,
             mesh=mesh,
             seed=args.seed,
         )
@@ -265,7 +280,18 @@ class EngineService:
                     from ..parallel.mesh import shard_pytree
                     from .kv_cache import PagePool
 
-                    params = _llama.init_params(jax.random.key(self.args.seed), m)
+                    if self.checkpoint_dir:
+                        # level-2 wake = reload from disk (the reference's
+                        # L2 wake re-reads weights; README.md:16-26)
+                        from ..models import checkpoint as _ckpt
+
+                        params = _ckpt.load_params(
+                            self.checkpoint_dir, m, mesh=eng.mesh
+                        )
+                    else:
+                        params = _llama.init_params(
+                            jax.random.key(self.args.seed), m
+                        )
                     if eng.mesh is not None:
                         params = shard_pytree(
                             params, eng.mesh, _llama.param_logical_axes(m)

@@ -1,0 +1,168 @@
+"""Checkpoint save/load for engine weights — the real cold-start path.
+
+The reference's dominant cold-start cost is model download + load into HBM
+(vLLM's weight loading; the launcher exists to hide exactly this). Here the
+serving engine loads params from an Orbax checkpoint directory:
+
+  * sharding-aware restore: each leaf is restored DIRECTLY into its target
+    NamedSharding/device placement (no host-then-scatter double copy) —
+    Orbax on TPU reads from disk into per-device buffers;
+  * level-2 wake (`engine/sleep.py` L2_DISCARD) re-loads from the same
+    checkpoint, so a discard-sleep's wake is a disk read, not a re-init;
+  * `save_params` exists so deployments can seed checkpoints from any
+    source (HF export scripts, trainers) in the exact pytree layout
+    `llama.init_params` defines.
+
+Format: one Orbax StandardCheckpoint under ``<dir>/params`` plus a
+``config.json`` carrying the LlamaConfig fields it was written with, so a
+mismatched ISC option string fails loudly at load time instead of silently
+serving shape-mangled weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from . import llama
+
+CONFIG_FILE = "config.json"
+PARAMS_DIR = "params"
+
+#: LlamaConfig fields that must match between checkpoint and engine config
+#: (dtype/attention_impl are runtime choices, not weight-layout facts).
+_SHAPE_FIELDS = (
+    "vocab_size",
+    "hidden_size",
+    "num_layers",
+    "num_heads",
+    "num_kv_heads",
+    "head_dim",
+    "intermediate_size",
+    "tie_embeddings",
+)
+
+
+def _config_dict(cfg: llama.LlamaConfig) -> Dict[str, Any]:
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = str(cfg.dtype.__name__ if hasattr(cfg.dtype, "__name__") else cfg.dtype)
+    return d
+
+
+def save_params(
+    directory: str, cfg: llama.LlamaConfig, params: Dict[str, Any]
+) -> None:
+    """Write params + config to `directory` (created; must not already hold
+    a checkpoint)."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(directory, PARAMS_DIR), params)
+        ckptr.wait_until_finished()
+    with open(os.path.join(directory, CONFIG_FILE), "w") as f:
+        json.dump(_config_dict(cfg), f, indent=2, sort_keys=True)
+
+
+def validate_config(directory: str, cfg: llama.LlamaConfig) -> None:
+    path = os.path.join(directory, CONFIG_FILE)
+    try:
+        with open(path) as f:
+            saved = json.load(f)
+    except OSError as e:
+        raise FileNotFoundError(f"no checkpoint config at {path}") from e
+    mismatches = {
+        k: (saved.get(k), getattr(cfg, k))
+        for k in _SHAPE_FIELDS
+        if saved.get(k) != getattr(cfg, k)
+    }
+    if mismatches:
+        raise ValueError(
+            f"checkpoint {directory} was written for a different model shape: "
+            + ", ".join(
+                f"{k}: ckpt={a} engine={b}" for k, (a, b) in mismatches.items()
+            )
+        )
+
+
+def load_params(
+    directory: str,
+    cfg: llama.LlamaConfig,
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> Dict[str, Any]:
+    """Restore params from `directory`, directly into their serving
+    placement (sharded over `mesh` when given, committed to the default
+    device otherwise)."""
+    import orbax.checkpoint as ocp
+
+    validate_config(directory, cfg)
+    directory = os.path.abspath(directory)
+
+    # Build the target pytree abstractly: shapes/dtypes from init logic
+    # without materializing weights (eval_shape), shardings from the same
+    # logical-axis rules the engine serves with.
+    abstract = jax.eval_shape(
+        lambda: llama.init_params(jax.random.key(0), cfg)
+    )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import named_sharding
+
+        axes = llama.param_logical_axes(cfg)
+
+        def to_target(a, ax):
+            sh = NamedSharding(mesh, P()) if ax is None else named_sharding(mesh, ax)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+
+        target = jax.tree.map(
+            to_target,
+            abstract,
+            axes,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+    else:
+        sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        target = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding),
+            abstract,
+        )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.join(directory, PARAMS_DIR), target)
+
+
+def main(argv=None) -> int:
+    """Seed a checkpoint directory (`python -m ...models.checkpoint --model
+    bench-1b --out /ckpts/bench-1b`): random-init weights in the serving
+    layout — deployments replace this with converted real weights."""
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(prog="fma-seed-checkpoint")
+    p.add_argument("--model", required=True, help="MODEL_CONFIGS key")
+    p.add_argument("--out", required=True)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    from ..engine.server import MODEL_CONFIGS
+
+    cfg = MODEL_CONFIGS[args.model]()
+    t0 = time.monotonic()
+    params = llama.init_params(jax.random.key(args.seed), cfg)
+    params = jax.block_until_ready(params)
+    save_params(args.out, cfg, params)
+    nbytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    print(
+        f"wrote {args.model} ({nbytes / 2**30:.2f} GiB) to {args.out} "
+        f"in {time.monotonic() - t0:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
