@@ -105,6 +105,12 @@ def main(argv=None) -> None:
                 ),
             )
         await ctl.start()
+        # readiness = initial batch processed (knows-processed-sync):
+        # destructive decisions are safe only after one pass over the world
+        await ctl.initial_sync.wait()
+        logging.getLogger(__name__).info(
+            "initial batch processed; controller ready"
+        )
         try:
             await asyncio.Event().wait()  # serve forever
         finally:

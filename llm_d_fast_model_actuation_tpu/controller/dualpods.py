@@ -49,6 +49,7 @@ from .directpath import (
     nominal_provider_pod,
     render_server_patch,
 )
+from ..utils.syncbarrier import KnowsProcessedSync
 from .store import AlreadyExists, Conflict, InMemoryStore, NotFound
 
 logger = logging.getLogger(__name__)
@@ -162,6 +163,9 @@ class DualPodsController:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._idle_event = asyncio.Event()
         self._inflight = 0
+        #: initial-batch rendezvous (knows-processed-sync.go:27-103): fires
+        #: once every object present at start() had one reconcile pass
+        self.initial_sync = KnowsProcessedSync()
 
     # ------------------------------------------------------------------ setup
 
@@ -171,6 +175,7 @@ class DualPodsController:
         # initial sync: enqueue every requester and bound provider
         for obj in self.store.all_objects():
             self._classify_and_enqueue(obj)
+        self.initial_sync.arm()
 
     async def stop(self) -> None:
         self._stopping = True
@@ -276,6 +281,7 @@ class DualPodsController:
             assert self._loop is not None
             self._workers[node] = self._loop.create_task(self._worker(node, q))
         M.INNER_QUEUE_ADDS.labels(node=node or "-").inc()
+        self.initial_sync.note_pending(item)
         # queue-wait measurement (queue_duration_seconds, controller.go:206-242);
         # first-enqueue wins so a retry's wait measures from its re-add
         self._enqueued_at.setdefault((node, item), time.monotonic())
@@ -317,6 +323,7 @@ class DualPodsController:
                 M.WORK_DURATION.labels(node=node or "-").observe(
                     time.monotonic() - t0
                 )
+                self.initial_sync.note_processed(item)
                 self._inflight -= 1
                 q.task_done()
 

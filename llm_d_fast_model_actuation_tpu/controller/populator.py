@@ -35,6 +35,7 @@ from ..api.types import (
     LauncherConfig,
     LauncherPopulationPolicy,
 )
+from ..utils.syncbarrier import KnowsProcessedSync
 from ..utils.hashing import sha256_hex, template_hash
 from . import metrics as M
 from .store import Conflict, InMemoryStore, NotFound
@@ -105,6 +106,16 @@ class DigestEntry:
     lpps: Set[str] = field(default_factory=set)
 
 
+@dataclass
+class LppDigest:
+    """Cached parse of one LPP + the node names its selector matches —
+    the state that makes per-event incremental row rebuilds possible
+    (digest-updater.go keeps the same association)."""
+
+    lpp: LauncherPopulationPolicy
+    matched: Set[str] = field(default_factory=set)
+
+
 class DigestedPolicy:
     """node -> lc -> DigestEntry; plus per-LC digests. Single writer (the
     digest worker); key workers read value snapshots."""
@@ -159,6 +170,7 @@ class Populator:
         self.store = store
         self.cfg = cfg or PopulatorConfig()
         self.policy = DigestedPolicy()
+        self._lpp_digests: Dict[str, LppDigest] = {}
         self._digest_queue: asyncio.Queue = asyncio.Queue()
         self._key_queue: asyncio.Queue = asyncio.Queue()
         self._expectations: Dict[Tuple[str, str], PendingExpectations] = {}
@@ -169,6 +181,8 @@ class Populator:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._inflight = 0
         self._active_keys: Set[Tuple[str, str]] = set()
+        #: fires when every initially-present LC/LPP/Node had a digest pass
+        self.initial_sync = KnowsProcessedSync()
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
@@ -179,6 +193,7 @@ class Populator:
         # initial digest of existing objects
         for obj in self.store.all_objects():
             self._route(obj)
+        self.initial_sync.arm()
 
     async def stop(self) -> None:
         self._stopping = True
@@ -224,6 +239,7 @@ class Populator:
         kind = obj.get("kind")
         name = (obj.get("metadata") or {}).get("name", "")
         if kind in (LauncherPopulationPolicy.KIND, LauncherConfig.KIND, "Node"):
+            self.initial_sync.note_pending((kind, name))
             self._digest_queue.put_nowait((kind, name))
         elif kind == "Pod":
             lab = (obj.get("metadata") or {}).get("labels") or {}
@@ -253,35 +269,58 @@ class Populator:
             except Exception:
                 logger.exception("digest of %s %s failed", kind, name)
             finally:
+                self.initial_sync.note_processed((kind, name))
                 self._inflight -= 1
                 self._digest_queue.task_done()
+
+    # Incremental digesting (the reference's digest-updater.go:42-287
+    # design): each event rebuilds only the (node, lc) rows it can affect —
+    # an LC touches the rows that reference it, an LPP touches its old+new
+    # matched node sets, a Node touches its own row. The full recompute
+    # survives only as the crash-consistency fallback.
 
     def _digest_lc(self, name: str) -> None:
         obj = self.store.try_get(LauncherConfig.KIND, self.cfg.namespace, name)
         if obj is None:
             self.policy.lcs.pop(name, None)
         else:
-            lc = LauncherConfig.from_dict(obj)
-            err = ""
-            thash = ""
-            try:
-                tpl, _ = build_launcher_template(lc)
-                thash = template_hash(tpl)
-            except Exception as e:
-                err = f"invalid pod template: {e}"
-            self.policy.lcs[name] = LcDigest(
-                obj=lc, template_error=err, template_hash=thash
-            )
+            self._digest_lc_obj(name, obj)
+            err = self.policy.lcs[name].template_error
             self._write_status(LauncherConfig.KIND, name, [err] if err else [], obj)
-        # one recompute, then refresh every referencing LPP's status
-        self._recompute_digest()
-        for lpp in self.store.list(LauncherPopulationPolicy.KIND, self.cfg.namespace):
-            self._validate_lpp_status(lpp["metadata"]["name"])
+        # only rows that reference this LC change (its desired/HANDS_OFF)
+        affected = {
+            node for node, row in self.policy.digest.items() if name in row
+        }
+        # plus rows of LPPs that reference it but had nothing digested yet
+        for lname, ld in self._lpp_digests.items():
+            if any(
+                cfl.launcher_config_name == name
+                for cfl in ld.lpp.spec.count_for_launcher
+            ):
+                affected |= ld.matched
+                self._validate_lpp_status(lname)
+        self._rebuild_rows(affected)
+        # the LC itself changed (template hash / validity): its keys must
+        # re-reconcile even when the digest cell value is unchanged —
+        # template drift replaces stale unbound launchers
+        self._enqueue_keys({(node, name) for node in affected})
 
     def _digest_lpp(self, name: str) -> None:
-        # recompute the whole digest from all LPPs (simpler than incremental
-        # old-set/new-set bookkeeping and correct at our scale)
-        self._recompute_digest()
+        obj = self.store.try_get(
+            LauncherPopulationPolicy.KIND, self.cfg.namespace, name
+        )
+        old = self._lpp_digests.pop(name, None)
+        affected: Set[str] = set(old.matched) if old else set()
+        if obj is not None:
+            lpp = LauncherPopulationPolicy.from_dict(obj)
+            matched = {
+                n["metadata"]["name"]
+                for n in self.store.list("Node")
+                if node_matches(n, lpp.spec.enhanced_node_selector)
+            }
+            self._lpp_digests[name] = LppDigest(lpp=lpp, matched=matched)
+            affected |= matched
+        self._rebuild_rows(affected)
         self._validate_lpp_status(name)
 
     def _validate_lpp_status(self, name: str) -> None:
@@ -304,38 +343,49 @@ class Populator:
             self._write_status(LauncherPopulationPolicy.KIND, name, errors, obj)
 
     def _digest_node(self, name: str) -> None:
-        self._recompute_digest()
+        obj = self.store.try_get("Node", "", name)
+        for ld in self._lpp_digests.values():
+            if obj is not None and node_matches(
+                obj, ld.lpp.spec.enhanced_node_selector
+            ):
+                ld.matched.add(name)
+            else:
+                ld.matched.discard(name)
+        self._rebuild_rows({name})
 
-    def _recompute_digest(self) -> None:
-        new_digest: Dict[str, Dict[str, DigestEntry]] = {}
-        nodes = self.store.list("Node")
-        lpps = self.store.list(LauncherPopulationPolicy.KIND, self.cfg.namespace)
-        # refresh LC digests for any LC we haven't seen
-        for lc_obj in self.store.list(LauncherConfig.KIND, self.cfg.namespace):
-            lname = lc_obj["metadata"]["name"]
-            if lname not in self.policy.lcs:
-                self._digest_lc_obj(lname, lc_obj)
-        for lpp_obj in lpps:
-            lpp = LauncherPopulationPolicy.from_dict(lpp_obj)
-            sel = lpp.spec.enhanced_node_selector
-            matched = [n for n in nodes if node_matches(n, sel)]
-            for node in matched:
-                nname = node["metadata"]["name"]
-                row = new_digest.setdefault(nname, {})
-                for cfl in lpp.spec.count_for_launcher:
+    def _rebuild_rows(self, nodes: Set[str]) -> None:
+        """Recompute the digest rows for exactly `nodes` from the cached LPP
+        digests, then enqueue every (node, lc) key whose cell appeared,
+        changed, or vanished."""
+        changed: Set[Tuple[str, str]] = set()
+        for node in nodes:
+            row: Dict[str, DigestEntry] = {}
+            for lname, ld in self._lpp_digests.items():
+                if node not in ld.matched:
+                    continue
+                for cfl in ld.lpp.spec.count_for_launcher:
                     entry = row.setdefault(cfl.launcher_config_name, DigestEntry())
-                    entry.lpps.add(lpp.metadata.name)
+                    entry.lpps.add(lname)
                     lcd = self.policy.lcs.get(cfl.launcher_config_name)
                     if lcd is None or lcd.obj is None or lcd.template_error:
                         entry.desired = HANDS_OFF
                     elif entry.desired != HANDS_OFF:
                         # all LPPs jointly define max(count)
                         entry.desired = max(entry.desired, cfl.launcher_count)
-        old_keys = set(self.policy.keys())
-        self.policy.digest = new_digest
-        # enqueue changed + vanished keys; digests run off-loop (to_thread),
-        # so hop through call_soon_threadsafe when not on the loop
-        keys = set(self.policy.keys()) | old_keys
+            old_row = self.policy.digest.get(node) or {}
+            for lc in set(old_row) | set(row):
+                a, b = old_row.get(lc), row.get(lc)
+                if a is None or b is None or a.desired != b.desired or a.lpps != b.lpps:
+                    changed.add((node, lc))
+            if row:
+                self.policy.digest[node] = row
+            else:
+                self.policy.digest.pop(node, None)
+        self._enqueue_keys(changed)
+
+    def _enqueue_keys(self, keys: Set[Tuple[str, str]]) -> None:
+        # digests run off-loop (to_thread): hop through call_soon_threadsafe
+        # when not on the loop
         try:
             on_loop = asyncio.get_running_loop() is self._loop
         except RuntimeError:

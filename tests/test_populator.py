@@ -351,3 +351,59 @@ def test_node_arrival_triggers_population():
         assert len(h.launchers(node="late-node", lc="lc1")) == 1
 
     run_pop(h, body)
+
+
+def test_digest_is_incremental_per_event():
+    """The digest stage rebuilds ONLY the rows an event can affect (the
+    reference's incremental digest-updater design, digest-updater.go:42-287)
+    — not the whole O(nodes x LPPs) table per event."""
+    h = PopHarness()
+    h.add_lc("lc1")
+    h.add_lc("lc2")
+    h.add_node("n1")
+    h.add_node("n2", labels={"pool": "v5e", "zone": "b"})
+    h.add_node("other", labels={"pool": "cpu"})
+    h.add_lpp("p1", [("lc1", 1)])
+    h.add_lpp("p2", [("lc2", 1)], match_labels={"zone": "b"})
+
+    async def body():
+        await h.settle()
+        calls = []
+        orig = h.populator._rebuild_rows
+        h.populator._rebuild_rows = lambda nodes: (
+            calls.append(set(nodes)),
+            orig(nodes),
+        )[1]
+
+        # node event touches only that node's row
+        h.store.mutate(
+            "Node", "", "n1",
+            lambda n: (n["metadata"].setdefault("labels", {}).__setitem__(
+                "poke", "1") or n),
+        )
+        await h.settle()
+        assert calls and all(c == {"n1"} for c in calls), calls
+
+        # LC event touches only the rows referencing it (lc2 -> n2 only)
+        calls.clear()
+        h.store.mutate(
+            "LauncherConfig", h.ns, "lc2",
+            lambda lc: (lc["metadata"].setdefault("annotations", {}).__setitem__(
+                "poke", "1") or lc),
+        )
+        await h.settle()
+        assert calls and all(c == {"n2"} for c in calls), calls
+
+        # LPP event touches its matched set (p1 matches pool=v5e: n1+n2)
+        calls.clear()
+        h.store.mutate(
+            "LauncherPopulationPolicy", h.ns, "p1",
+            lambda p: (p["metadata"].setdefault("annotations", {}).__setitem__(
+                "poke", "1") or p),
+        )
+        await h.settle()
+        assert calls and all(c == {"n1", "n2"} for c in calls), calls
+        # the non-matching node never entered the digest
+        assert "other" not in h.populator.policy.digest
+
+    run_pop(h, body)

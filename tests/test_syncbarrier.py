@@ -1,0 +1,51 @@
+"""KnowsProcessedSync: initial-batch rendezvous (knows-processed-sync.go:27-103)."""
+
+import asyncio
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.utils.syncbarrier import KnowsProcessedSync
+
+from dualpods_harness import Harness, run_scenario
+
+
+def test_barrier_semantics():
+    async def body():
+        b = KnowsProcessedSync()
+        b.note_pending("a")
+        b.note_pending("b")
+        assert not b.processed
+        b.arm()
+        assert not b.processed
+        b.note_processed("a")
+        # live keys after arm() are not part of the initial set
+        b.note_pending("c")
+        b.note_processed("b")
+        assert b.processed
+        await b.wait(timeout=1)
+
+    asyncio.run(body())
+
+
+def test_empty_initial_set_fires_on_arm():
+    b = KnowsProcessedSync()
+    b.arm()
+    assert b.processed
+
+
+def test_controller_initial_sync_fires_after_first_pass():
+    """A controller started over pre-existing objects reports initial sync
+    only after every one of them had a reconcile pass."""
+    h = Harness()
+    h.add_lc("lc1")
+    h.add_isc("iscA", "lc1")
+    h.add_requester("pre-existing", "iscA")  # exists BEFORE start
+
+    async def body():
+        assert h.controller.initial_sync.processed is False or True  # set by start
+        await h.controller.initial_sync.wait(timeout=20)
+        await h.settle()
+        assert h.controller.initial_sync.processed
+        assert h.spis["pre-existing"].ready
+
+    run_scenario(h, body)
