@@ -32,12 +32,22 @@ import time
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
+from prometheus_client import Gauge
 
 from ..models import llama
 from .engine import EngineConfig, InferenceEngine
 from .sleep import attach_sleep
 
 logger = logging.getLogger(__name__)
+
+#: Scheduling pressure: waiting + in-flight requests. The HPA's per-pod
+#: scaling signal (deploy/hpa/hpa.yaml); labeled by model because two
+#: engine instances can share one process in tests.
+ENGINE_QUEUE_DEPTH = Gauge(
+    "fma_engine_queue_depth",
+    "Requests waiting or in flight in this engine",
+    ["model"],
+)
 
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
@@ -242,6 +252,12 @@ class EngineService:
 
     # -- API used by handlers (event-loop thread) ---------------------------
 
+    def queue_depth(self) -> int:
+        """Waiting + in-flight request count (the HPA pressure signal)."""
+        eng = self.engine
+        running = sum(1 for s in eng._slots if s is not None)
+        return len(self._pending) + len(eng._waiting) + running
+
     def submit(
         self, prompt: List[int], max_tokens: int, temperature: float
     ) -> concurrent.futures.Future:
@@ -251,6 +267,7 @@ class EngineService:
             return fut
         self._pending.append((prompt, max_tokens, temperature, fut))
         self._new_work.set()
+        ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
         return fut
 
     def sleep(self, level: int) -> Dict[str, Any]:
@@ -373,6 +390,17 @@ def build_app(service: EngineService) -> web.Application:
             {"object": "list", "data": [{"id": service.args.model, "object": "model"}]}
         )
 
+    async def metrics(request: web.Request) -> web.Response:
+        from prometheus_client import generate_latest
+
+        ENGINE_QUEUE_DEPTH.labels(model=service.args.model).set(
+            service.queue_depth()
+        )
+        return web.Response(
+            body=generate_latest(),
+            content_type="text/plain",
+        )
+
     async def completions(request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -426,6 +454,7 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
     app.router.add_get("/v1/models", models)
+    app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
 
     if os.environ.get("FMA_DEBUG_ENDPOINTS") == "1":

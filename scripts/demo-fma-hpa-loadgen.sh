@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Sustained completion load against the serving endpoint to trigger HPA
+# scale-up (reference: demo-fma-hpa-loadgen.sh).
+# Env: NAMESPACE (fma-hpa), TARGET (service URL), WORKERS (50), DURATION (120s)
+set -euo pipefail
+NAMESPACE="${NAMESPACE:-fma-hpa}"
+TARGET="${TARGET:-http://fma-gateway.$NAMESPACE.svc:8000}"
+WORKERS="${WORKERS:-50}"
+DURATION="${DURATION:-120}"
+
+kubectl -n "$NAMESPACE" delete pod fma-loadgen --ignore-not-found
+kubectl -n "$NAMESPACE" run fma-loadgen --restart=Never --image=python:3.12-slim -- \
+  python - <<PY
+import concurrent.futures, json, time, urllib.request
+deadline = time.time() + $DURATION
+def worker(i):
+    n = 0
+    while time.time() < deadline:
+        req = urllib.request.Request(
+            "$TARGET/v1/completions", method="POST",
+            data=json.dumps({"prompt": [1,2,3,4], "max_tokens": 64}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=30).read()
+            n += 1
+        except Exception:
+            time.sleep(0.5)
+    return n
+with concurrent.futures.ThreadPoolExecutor($WORKERS) as ex:
+    total = sum(ex.map(worker, range($WORKERS)))
+print("completions:", total)
+PY

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Watch the HPA drive FMA: requester replicas, provider/sleeper states, and
+# actuation paths side by side (reference: demo-fma-hpa-monitor.sh).
+set -euo pipefail
+NAMESPACE="${NAMESPACE:-fma-hpa}"
+watch -n 2 "
+kubectl -n $NAMESPACE get hpa fma-requesters 2>/dev/null | tail -1;
+echo '--- requesters';
+kubectl -n $NAMESPACE get pods -l 'dual-pods.llm-d.ai/dual' -o wide 2>/dev/null | head -12;
+echo '--- providers (sleeping label)';
+kubectl -n $NAMESPACE get pods -l 'llm-d.ai/component=launcher' \
+  -o 'custom-columns=NAME:.metadata.name,SLEEPING:.metadata.labels.dual-pods\.llm-d\.ai/sleeping' 2>/dev/null
+"

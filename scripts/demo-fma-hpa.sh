@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# FMA + HPA demo on a TPU cluster: deploy the FMA stack, an example
+# ISC/LC/requester Deployment, prometheus-adapter rules, and the HPA —
+# then drive load (demo-fma-hpa-loadgen.sh) and watch replicas become
+# actuations (demo-fma-hpa-monitor.sh).
+#
+# TPU analogue of the reference's test/e2e/demo-fma-hpa/demo-fma-hpa-ocp.sh.
+#
+# Env: NAMESPACE (default fma-hpa), CHART (default deploy/chart/fma-tpu-controllers)
+set -euo pipefail
+NAMESPACE="${NAMESPACE:-fma-hpa}"
+HERE="$(cd "$(dirname "$0")/.." && pwd)"
+CHART="${CHART:-$HERE/deploy/chart/fma-tpu-controllers}"
+
+kubectl get ns "$NAMESPACE" >/dev/null 2>&1 || kubectl create ns "$NAMESPACE"
+
+echo ">>> CRDs + admission policies"
+kubectl apply -f "$HERE/deploy/crds/"
+kubectl apply -f "$HERE/deploy/policies/" || true
+
+echo ">>> FMA controllers (helm)"
+helm upgrade --install fma "$CHART" -n "$NAMESPACE"
+
+echo ">>> chip map for TPU nodes"
+"$HERE/scripts/ensure-nodes-mapped.sh" --namespace "$NAMESPACE"
+
+echo ">>> prometheus-adapter rules (requires prometheus-community repo)"
+helm upgrade --install fma-metrics-adapter prometheus-community/prometheus-adapter \
+  -n "$NAMESPACE" -f "$HERE/deploy/hpa/prometheus-adapter-rules.yaml" || \
+  echo "WARN: prometheus-adapter install failed (no prometheus?); HPA will lack metrics"
+kubectl apply -n "$NAMESPACE" -f "$HERE/deploy/hpa/servicemonitor.yaml" || true
+
+echo ">>> HPA over the requester Deployment"
+kubectl apply -n "$NAMESPACE" -f "$HERE/deploy/hpa/hpa.yaml"
+
+echo
+echo "Deployed. Next:"
+echo "  scripts/demo-fma-hpa-loadgen.sh   # sustained /v1/completions load"
+echo "  scripts/demo-fma-hpa-monitor.sh   # watch replicas vs actuations"

@@ -1,6 +1,7 @@
 """Deploy-layer sanity: CRDs/policies parse, schemas cover the API types,
 chart templates reference flags the CLI actually has."""
 
+import os
 import pathlib
 import re
 
@@ -76,3 +77,40 @@ def test_controller_cli_kube_store_needs_cluster():
 
     with pytest.raises(SystemExit):
         main(["dual-pods-controller", "--namespace", "ns"])
+
+
+def test_hpa_integration_manifests():
+    """HPA stack (the reference's WVA/HPA demo glue, test/e2e/demo-fma-hpa/):
+    adapter rules must reference series our metrics catalog actually
+    registers, and the HPA must target the requester Deployment."""
+    import yaml
+
+    root = os.path.join(os.path.dirname(__file__), "..", "deploy", "hpa")
+    rules = yaml.safe_load(open(os.path.join(root, "prometheus-adapter-rules.yaml")))
+    series = [r["seriesQuery"].split("{")[0] for r in rules["rules"]]
+    import llm_d_fast_model_actuation_tpu.controller.metrics  # noqa: F401
+    from prometheus_client import REGISTRY
+
+    registered = set()
+    for fam in REGISTRY.collect():
+        registered.add(fam.name)
+        registered.update(s.name for s in fam.samples)
+    for s in series:
+        base = s.replace("_bucket", "")
+        assert base in registered or s in registered, (
+            f"adapter rule references unregistered series {s}"
+        )
+
+    hpa = yaml.safe_load(open(os.path.join(root, "hpa.yaml")))
+    assert hpa["spec"]["scaleTargetRef"]["kind"] == "Deployment"
+    # the HPA's pods metric is exported by the engine server's /metrics
+    import llm_d_fast_model_actuation_tpu.engine.server  # noqa: F401
+    registered2 = set()
+    for fam in REGISTRY.collect():
+        registered2.add(fam.name)
+    hpa_metric = hpa["spec"]["metrics"][0]["pods"]["metric"]["name"]
+    assert hpa_metric in registered2, f"HPA metric {hpa_metric} not exported"
+    assert hpa["spec"]["minReplicas"] == 0, "scale-to-zero is the FMA contract"
+
+    sm = yaml.safe_load(open(os.path.join(root, "servicemonitor.yaml")))
+    assert sm["spec"]["endpoints"][0]["path"] == "/metrics"
