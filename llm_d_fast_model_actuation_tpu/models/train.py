@@ -31,17 +31,28 @@ def forward_train(
     tokens: jnp.ndarray,  # [b, s]
     seq_lens: jnp.ndarray,  # [b]
     remat: bool = True,
+    mesh: Optional[Any] = None,
 ) -> jnp.ndarray:
-    """Dense causal forward (no KV cache), logits fp32 [b, s, vocab]."""
+    """Dense causal forward (no KV cache), logits fp32 [b, s, vocab].
+
+    With a `mesh` whose ``sp`` axis is > 1, attention runs as RING attention
+    (ops/ring_attention.py): K/V chunks rotate the sp ring instead of GSPMD
+    all-gathering the whole sequence — the long-context path."""
     b, s = tokens.shape
     cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     x = params["embed"][tokens].astype(cfg.dtype)
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring:
+        from ..ops.ring_attention import ring_prefill_attention
 
     def layer(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
         q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
-        attn = causal_prefill_attention(q, k, v, seq_lens)
+        if use_ring:
+            attn = ring_prefill_attention(q, k, v, seq_lens, mesh)
+        else:
+            attn = causal_prefill_attention(q, k, v, seq_lens)
         x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
@@ -59,9 +70,10 @@ def lm_loss(
     cfg: LlamaConfig,
     tokens: jnp.ndarray,
     seq_lens: jnp.ndarray,
+    mesh: Optional[Any] = None,
 ) -> jnp.ndarray:
     """Masked next-token cross-entropy."""
-    logits = forward_train(params, cfg, tokens, seq_lens)
+    logits = forward_train(params, cfg, tokens, seq_lens, mesh=mesh)
     targets = tokens[:, 1:]
     logits = logits[:, :-1]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -98,12 +110,16 @@ def train_step(
     tokens: jnp.ndarray,
     seq_lens: jnp.ndarray,
     optimizer: Optional[optax.GradientTransformation] = None,
+    mesh: Optional[Any] = None,
 ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
     """One optimizer step. Under a mesh, data arrays sharded (dp, sp) and
     params sharded per `param_logical_axes` make GSPMD insert the grad
-    all-reduces; no hand-written collectives."""
+    all-reduces — except attention under sp>1, which runs as explicit ring
+    attention (pass `mesh`); no other hand-written collectives."""
     optimizer = optimizer or make_optimizer()
-    loss, grads = jax.value_and_grad(lm_loss)(state.params, cfg, tokens, seq_lens)
+    loss, grads = jax.value_and_grad(lm_loss)(
+        state.params, cfg, tokens, seq_lens, mesh
+    )
     updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return (
