@@ -114,9 +114,13 @@ class InferenceEngine:
 
             m = dataclasses.replace(m, attention_impl=impl)
         if params is None:
-            params = llama.init_params(jax.random.key(seed), m)
+            from ..models.registry import init_params_for
+
+            params = init_params_for(jax.random.key(seed), m)
         if mesh is not None:
-            params = shard_pytree(params, mesh, llama.param_logical_axes(m))
+            from ..models.registry import logical_axes_for
+
+            params = shard_pytree(params, mesh, logical_axes_for(m))
         else:
             # Commit to the default device: committed-ness is part of the jit
             # cache key, and the post-wake device_put restore produces

@@ -49,10 +49,14 @@ ENGINE_QUEUE_DEPTH = Gauge(
     ["model"],
 )
 
+from ..models.moe import MoeConfig
+
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
     "llama3-70b": llama.LlamaConfig.llama3_70b,
+    "tiny-moe": MoeConfig.tiny_moe,
+    "mixtral-8x7b": MoeConfig.mixtral_8x7b,
     "bench-1b": lambda: llama.LlamaConfig(
         vocab_size=32000,
         hidden_size=2048,

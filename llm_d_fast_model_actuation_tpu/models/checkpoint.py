@@ -106,15 +106,19 @@ def load_params(
     # Build the target pytree abstractly: shapes/dtypes from init logic
     # without materializing weights (eval_shape), shardings from the same
     # logical-axis rules the engine serves with.
+    from .registry import init_params_for
+
     abstract = jax.eval_shape(
-        lambda: llama.init_params(jax.random.key(0), cfg)
+        lambda: init_params_for(jax.random.key(0), cfg)
     )
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..parallel.mesh import named_sharding
 
-        axes = llama.param_logical_axes(cfg)
+        from .registry import logical_axes_for
+
+        axes = logical_axes_for(cfg)
 
         def to_target(a, ax):
             sh = NamedSharding(mesh, P()) if ax is None else named_sharding(mesh, ax)

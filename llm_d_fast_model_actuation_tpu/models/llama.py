@@ -171,6 +171,15 @@ def _mlp(x, gate, up, down):
     return (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ down
 
 
+def _ffn(cfg: "LlamaConfig", lp, x):
+    """Dense SwiGLU or routed MoE, by config family (models/moe.py)."""
+    if getattr(cfg, "num_experts", 0) > 1:
+        from .moe import moe_ffn
+
+        return moe_ffn(cfg, lp, x)
+    return _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
 def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
     """x: [b, s, h] -> q [b,s,heads,hd], k/v [b,s,kvh,hd], roped."""
     b, s, _ = x.shape
@@ -238,7 +247,7 @@ def prefill(
         attn = causal_prefill_attention(q, k, v, seq_lens, impl=cfg.attention_impl)
         x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(cfg, lp, h)
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -300,7 +309,7 @@ def decode_step(
         )
         x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(cfg, lp, h)
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(
@@ -364,7 +373,7 @@ def _decode_step_scatter_first(
         )
         x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(cfg, lp, h)
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -1,0 +1,172 @@
+"""Mixtral-style sparse-MoE decoder — the second model family.
+
+Same attention trunk as the Llama family (models/llama.py — one scanned
+layer body, paged KV, GQA); the FFN is a top-k router over E experts.
+
+TPU/SPMD design:
+  * expert weights are stacked ``[layers, experts, ...]`` and the experts
+    axis carries the ``expert -> ep`` logical sharding rule
+    (parallel/mesh.py LOGICAL_RULES): each ep shard holds E/ep experts;
+  * dispatch is DENSE-compute, sparse-weight: every expert runs on every
+    token and the router's (renormalized) top-k probabilities weight the
+    sum. Under ep sharding each device computes only its local experts and
+    the weighted sum's contraction over E becomes one psum over ep — no
+    scatter/gather, no capacity factors, no dynamic shapes, which is
+    exactly what XLA wants. The FLOPs cost vs token-dropping dispatch is
+    E/k per device group, paid deliberately for static shapes (the
+    standard small-scale JAX MoE trade; swap in a ragged Pallas dispatch
+    when expert counts grow past the arithmetic-intensity break-even).
+
+Reference parity: the reference serves MoE through vLLM's Mixtral support
+(SURVEY §2.9 model families); this is the TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from . import llama
+
+
+@dataclass(frozen=True)
+class MoeConfig(llama.LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "MoeConfig":
+        return cls(
+            vocab_size=32000,
+            hidden_size=4096,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            intermediate_size=14336,
+            rope_theta=1e6,
+            max_seq_len=32768,
+            num_experts=8,
+            experts_per_token=2,
+        )
+
+    @classmethod
+    def tiny_moe(cls, vocab: int = 256) -> "MoeConfig":
+        return cls(
+            vocab_size=vocab,
+            hidden_size=64,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            intermediate_size=96,
+            rope_theta=10000.0,
+            max_seq_len=128,
+            num_experts=4,
+            experts_per_token=2,
+        )
+
+    def num_params(self) -> int:
+        h, f, e = self.hidden_size, self.intermediate_size, self.num_experts
+        per_layer = (
+            2 * h  # norms
+            + h * self.q_dim
+            + 2 * h * self.kv_dim
+            + self.q_dim * h
+            + h * e  # router
+            + e * 3 * h * f  # experts
+        )
+        head = 0 if self.tie_embeddings else h * self.vocab_size
+        return (
+            self.vocab_size * h + self.num_layers * per_layer + h + head
+        )
+
+
+def init_params(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
+    """Random-init params in the Llama layout, with per-layer expert stacks
+    (``[L, E, ...]``) and a router replacing the dense FFN."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    h, L, E, f = (
+        cfg.hidden_size,
+        cfg.num_layers,
+        cfg.num_experts,
+        cfg.intermediate_size,
+    )
+
+    def norm_init(shape):
+        return jnp.ones(shape, dtype=cfg.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, dtype=jnp.float32) * fan_in**-0.5
+        ).astype(cfg.dtype)
+
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": norm_init((L, h)),
+        "wq": dense_init(ks[0], (L, h, cfg.q_dim), h),
+        "wk": dense_init(ks[1], (L, h, cfg.kv_dim), h),
+        "wv": dense_init(ks[2], (L, h, cfg.kv_dim), h),
+        "wo": dense_init(ks[3], (L, cfg.q_dim, h), cfg.q_dim),
+        "mlp_norm": norm_init((L, h)),
+        "router": dense_init(ks[4], (L, h, E), h),
+        "w_gate": dense_init(ks[5], (L, E, h, f), h),
+        "w_up": dense_init(ks[6], (L, E, h, f), h),
+        "w_down": dense_init(ks[7], (L, E, f, h), f),
+    }
+    params = {
+        "embed": dense_init(k_embed, (cfg.vocab_size, h), h),
+        "layers": layers,
+        "final_norm": norm_init((h,)),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense_init(k_head, (h, cfg.vocab_size), h)
+    return params
+
+
+def param_logical_axes(cfg: MoeConfig) -> Dict[str, Any]:
+    layers = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "router": ("layers", "embed", None),  # router replicated (tiny)
+        "w_gate": ("layers", "expert", "embed", "mlp"),
+        "w_up": ("layers", "expert", "embed", "mlp"),
+        "w_down": ("layers", "expert", "mlp", "embed"),
+    }
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": layers,
+        "final_norm": ("embed",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def moe_ffn(cfg: MoeConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    """Top-k routed expert FFN, dense-compute sparse-weight.
+
+    x: [..., hidden]; lp["router"]: [h, E]; experts [E, h, f]/[E, f, h].
+    """
+    k = cfg.experts_per_token
+    logits = (x @ lp["router"]).astype(jnp.float32)  # [..., E]
+    top_vals, top_idx = jax.lax.top_k(logits, k)  # [..., k]
+    top_probs = jax.nn.softmax(top_vals, axis=-1)  # renormalized over top-k
+    # scatter the k probabilities back to a dense [.., E] weight vector
+    onehot = jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.float32)
+    weights = jnp.einsum("...k,...ke->...e", top_probs, onehot)
+
+    g = jnp.einsum("...h,ehf->...ef", x, lp["w_gate"])
+    u = jnp.einsum("...h,ehf->...ef", x, lp["w_up"])
+    act = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype)) * u
+    y = jnp.einsum("...ef,efh->...eh", act, lp["w_down"])
+    # contraction over E: with experts ep-sharded this is the one psum
+    out = jnp.einsum("...eh,...e->...h", y.astype(jnp.float32), weights)
+    return out.astype(x.dtype)

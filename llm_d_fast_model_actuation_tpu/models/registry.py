@@ -1,0 +1,27 @@
+"""Model-family dispatch: init/sharding by config type.
+
+The forward path (prefill/decode_step in models/llama.py) is shared across
+families — the scanned layer body dispatches its FFN on the config
+(`llama._ffn`), so the engine never branches. Only initialization and the
+logical-axes pytree differ per family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+from . import llama, moe
+
+
+def init_params_for(key: jax.Array, cfg: llama.LlamaConfig) -> Dict[str, Any]:
+    if isinstance(cfg, moe.MoeConfig):
+        return moe.init_params(key, cfg)
+    return llama.init_params(key, cfg)
+
+
+def logical_axes_for(cfg: llama.LlamaConfig) -> Dict[str, Any]:
+    if isinstance(cfg, moe.MoeConfig):
+        return moe.param_logical_axes(cfg)
+    return llama.param_logical_axes(cfg)

@@ -21,7 +21,7 @@ import optax
 
 from ..ops.attention import causal_prefill_attention
 from ..ops.norm import rms_norm
-from .llama import LlamaConfig, _mlp, _project_qkv, param_logical_axes  # noqa: F401
+from .llama import LlamaConfig, _ffn, _project_qkv, param_logical_axes  # noqa: F401
 from ..ops.rope import rope_table
 
 
@@ -55,7 +55,7 @@ def forward_train(
             attn = causal_prefill_attention(q, k, v, seq_lens)
         x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _mlp(h, lp["w_gate"], lp["w_up"], lp["w_down"])
+        x = x + _ffn(cfg, lp, h)
         return x, None
 
     body = jax.checkpoint(layer) if remat else layer
