@@ -35,6 +35,7 @@ from aiohttp import web
 from prometheus_client import Gauge
 
 from ..models import llama
+from ..models.moe import MoeConfig
 from .engine import EngineConfig, InferenceEngine
 from .sleep import attach_sleep
 
@@ -48,8 +49,6 @@ ENGINE_QUEUE_DEPTH = Gauge(
     "Requests waiting or in flight in this engine",
     ["model"],
 )
-
-from ..models.moe import MoeConfig
 
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
@@ -303,20 +302,26 @@ class EngineService:
 
                     if self.checkpoint_dir:
                         # level-2 wake = reload from disk (the reference's
-                        # L2 wake re-reads weights; README.md:16-26)
+                        # L2 wake re-reads weights; README.md:16-26);
+                        # load_params already lands on the mesh placement
                         from ..models import checkpoint as _ckpt
 
                         params = _ckpt.load_params(
                             self.checkpoint_dir, m, mesh=eng.mesh
                         )
                     else:
-                        params = _llama.init_params(
+                        from ..models.registry import (
+                            init_params_for,
+                            logical_axes_for,
+                        )
+
+                        params = init_params_for(
                             jax.random.key(self.args.seed), m
                         )
-                    if eng.mesh is not None:
-                        params = shard_pytree(
-                            params, eng.mesh, _llama.param_logical_axes(m)
-                        )
+                        if eng.mesh is not None:
+                            params = shard_pytree(
+                                params, eng.mesh, logical_axes_for(m)
+                            )
                     pool = PagePool.create(
                         m.num_layers,
                         eng.cfg.num_pages,

@@ -44,6 +44,7 @@ _SHAPE_FIELDS = (
     "head_dim",
     "intermediate_size",
     "tie_embeddings",
+    "num_experts",  # MoE family: expert count is a weight-layout fact
 )
 
 
@@ -77,9 +78,9 @@ def validate_config(directory: str, cfg: llama.LlamaConfig) -> None:
     except OSError as e:
         raise FileNotFoundError(f"no checkpoint config at {path}") from e
     mismatches = {
-        k: (saved.get(k), getattr(cfg, k))
+        k: (saved.get(k), getattr(cfg, k, None))
         for k in _SHAPE_FIELDS
-        if saved.get(k) != getattr(cfg, k)
+        if saved.get(k) != getattr(cfg, k, None)
     }
     if mismatches:
         raise ValueError(

@@ -85,13 +85,17 @@ class ChipAllocator:
         pool: List[str],
         timeout_s: float = 60.0,
         poll_s: float = 0.2,
+        should_stop=None,
     ) -> List[str]:
         """Claim `count` chips of `pool` on this node; blocks (polling) while
         capacity is taken by other holders. Idempotent: existing claims by
-        this holder count toward `count` (crash-restart safe)."""
+        this holder count toward `count` (crash-restart safe).
+        `should_stop()` (e.g. a SIGTERM flag) aborts the wait promptly."""
         self._ensure_cm()
         deadline = time.monotonic() + timeout_s
         while True:
+            if should_stop is not None and should_stop():
+                raise OutOfChips(f"{self.holder}: allocation aborted (stopping)")
             got: Optional[List[str]] = None
 
             def apply(cm: Dict[str, Any]) -> Optional[Dict[str, Any]]:

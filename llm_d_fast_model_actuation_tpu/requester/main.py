@@ -31,7 +31,7 @@ from .spi import LogSink, ReadyFlag, SpiServer
 logger = logging.getLogger(__name__)
 
 
-def resolve_chips(args: argparse.Namespace):
+def resolve_chips(args: argparse.Namespace, should_stop=None):
     """Returns (chip_ids, cleanup_fn_or_None)."""
     if args.backend == "static":
         return [c for c in args.chips.split(",") if c], None
@@ -48,9 +48,16 @@ def resolve_chips(args: argparse.Namespace):
         store = KubeStore(args.api_base, args.namespace, kinds=None)
         holder = args.pod_name or os.environ.get("POD_NAME") or f"req-{os.getpid()}"
         alloc = ChipAllocator(store, args.namespace, args.node, holder)
-        chips = alloc.allocate(
-            args.alloc_count, pool, timeout_s=args.alloc_timeout
-        )
+        try:
+            chips = alloc.allocate(
+                args.alloc_count,
+                pool,
+                timeout_s=args.alloc_timeout,
+                should_stop=should_stop,
+            )
+        except Exception:
+            alloc.release()  # never leak a partial/prior claim on failure
+            raise
         return chips, alloc.release
     if args.backend == "env":
         from ..parallel.topology import ChipMap
@@ -91,8 +98,8 @@ def memory_backend(args: argparse.Namespace, chip_ids: List[str]):
 async def serve(args: argparse.Namespace) -> None:
     # SIGTERM must run the cleanup path — the alloc backend's ConfigMap
     # claims are released on exit (gpu-allocation.go's defer-release
-    # equivalent) — so install handlers BEFORE the (blocking, up to
-    # --alloc-timeout) allocation loop runs.
+    # equivalent) — so install handlers BEFORE the allocation runs (which is
+    # pushed to a thread below so the loop stays responsive to the signal).
     import signal
 
     stop = asyncio.Event()
@@ -105,7 +112,25 @@ async def serve(args: argparse.Namespace) -> None:
 
     ready = ReadyFlag(False)
     sink = LogSink()
-    chips, cleanup = resolve_chips(args)
+    # the alloc backend blocks (CAS polling up to --alloc-timeout): run it in
+    # a thread so the installed SIGTERM handler can actually fire mid-wait
+    alloc_task = asyncio.create_task(
+        asyncio.to_thread(resolve_chips, args, stop.is_set)
+    )
+    stop_task = asyncio.create_task(stop.wait())
+    done, _ = await asyncio.wait(
+        {alloc_task, stop_task}, return_when=asyncio.FIRST_COMPLETED
+    )
+    if stop_task in done and alloc_task not in done:
+        # terminated while waiting for chips: the allocator sees stop on its
+        # next poll, releases anything it won, and raises
+        try:
+            await alloc_task
+        except Exception:
+            pass
+        return
+    stop_task.cancel()
+    chips, cleanup = await alloc_task
     logger.info("requester stub: chips=%s", chips)
     runners = []
     try:

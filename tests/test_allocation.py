@@ -94,10 +94,9 @@ def test_two_requesters_race_over_http(tmp_path):
     """Two real requester subprocesses, one 2-chip node, fake apiserver:
     deterministic outcome — disjoint single-chip claims, both SPIs serve
     their allocation, and killing one releases its claim."""
-    import socket
     import sys
 
-    from conftest import cpu_subprocess_env, free_port, port_free
+    from conftest import cpu_subprocess_env, free_port
     from fake_apiserver import FakeApiServer
 
 

@@ -53,7 +53,6 @@ def test_live_mode_measures_real_stack(tmp_path):
     """Live benchmark mode (the reference's kind/remote modes,
     benchmark_base.py:34-99): cold then warm actuation measured over the
     real subprocess stack, classified from outside observation."""
-    import socket
     import subprocess
     import sys
     import time as _time

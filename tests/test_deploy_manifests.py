@@ -110,7 +110,15 @@ def test_hpa_integration_manifests():
         registered2.add(fam.name)
     hpa_metric = hpa["spec"]["metrics"][0]["pods"]["metric"]["name"]
     assert hpa_metric in registered2, f"HPA metric {hpa_metric} not exported"
-    assert hpa["spec"]["minReplicas"] == 0, "scale-to-zero is the FMA contract"
+    # ...and the adapter must actually expose it to the HPA
+    assert any(r["seriesQuery"].split("{")[0] == hpa_metric for r in rules["rules"]), (
+        f"no adapter rule covers the HPA metric {hpa_metric}"
+    )
+    pm = yaml.safe_load(open(os.path.join(root, "podmonitor.yaml")))
+    assert pm["spec"]["podMetricsEndpoints"][0]["path"] == "/metrics", (
+        "engine pods must be scraped for the HPA metric"
+    )
+    assert hpa["spec"]["minReplicas"] == 1, "portable default (0 needs HPAScaleToZero)"
 
     sm = yaml.safe_load(open(os.path.join(root, "servicemonitor.yaml")))
     assert sm["spec"]["endpoints"][0]["path"] == "/metrics"

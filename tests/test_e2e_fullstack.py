@@ -21,7 +21,6 @@ Cases (reference analogue in parens):
 """
 
 import asyncio
-import socket
 import subprocess
 import sys
 import time

@@ -9,7 +9,6 @@ surfaces the controllers use.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time

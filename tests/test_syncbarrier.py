@@ -42,7 +42,6 @@ def test_controller_initial_sync_fires_after_first_pass():
     h.add_requester("pre-existing", "iscA")  # exists BEFORE start
 
     async def body():
-        assert h.controller.initial_sync.processed is False or True  # set by start
         await h.controller.initial_sync.wait(timeout=20)
         await h.settle()
         assert h.controller.initial_sync.processed
