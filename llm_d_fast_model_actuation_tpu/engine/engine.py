@@ -429,6 +429,24 @@ class InferenceEngine:
     def has_work(self) -> bool:
         return bool(self._waiting) or any(s is not None for s in self._slots)
 
+    def abort(self, seq_id: int, reason: str = "aborted") -> bool:
+        """Abort one request (client disconnect): waiting requests are
+        dropped, in-flight ones retired — their pages return to the pool and
+        the slot frees this step instead of decoding to max_new_tokens."""
+        for i, req in enumerate(self._waiting):
+            if req.seq_id == seq_id:
+                self._waiting.pop(i)
+                req.done = True
+                req.error = reason
+                return True
+        for req in self._slots:
+            if req is not None and req.seq_id == seq_id:
+                self._retire(req)
+                req.done = True
+                req.error = reason
+                return True
+        return False
+
     def abort_all(self, reason: str) -> List[Request]:
         """Fail every waiting and in-flight request and reset the scheduler
         (slots, page tables, allocator). Used when continuity of generation

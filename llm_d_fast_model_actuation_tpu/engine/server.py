@@ -56,6 +56,28 @@ MODEL_CONFIGS = {
     "llama3-70b": llama.LlamaConfig.llama3_70b,
     "tiny-moe": MoeConfig.tiny_moe,
     "mixtral-8x7b": MoeConfig.mixtral_8x7b,
+    "qwen2-7b": lambda: llama.LlamaConfig(
+        vocab_size=152064,
+        hidden_size=3584,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        intermediate_size=18944,
+        rope_theta=1e6,
+        max_seq_len=32768,
+    ),
+    "tinyllama-1.1b": lambda: llama.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=2048,
+        num_layers=22,
+        num_heads=32,
+        num_kv_heads=4,
+        head_dim=64,
+        intermediate_size=5632,
+        rope_theta=10000.0,
+        max_seq_len=2048,
+    ),
     "bench-1b": lambda: llama.LlamaConfig(
         vocab_size=32000,
         hidden_size=2048,
@@ -144,7 +166,9 @@ class EngineService:
         self._new_work = threading.Event()
         self._stop = False
         self._futures: Dict[int, concurrent.futures.Future] = {}
+        self._fut_seq: Dict[int, int] = {}  # id(future) -> seq_id
         self._pending: List[Any] = []
+        self._abort_q: List[Any] = []  # futures whose client went away
         self.failure: Optional[str] = None
         self.started_at = time.monotonic()
 
@@ -215,10 +239,28 @@ class EngineService:
 
     # -- engine thread -------------------------------------------------------
 
+    def _drain_aborts(self) -> None:
+        """Apply client-disconnect aborts on the engine thread (the only
+        thread allowed to touch engine scheduler state)."""
+        while self._abort_q:
+            fut = self._abort_q.pop(0)
+            # still pending? drop it before admission
+            for i, entry in enumerate(self._pending):
+                if entry[3] is fut:
+                    self._pending.pop(i)
+                    break
+            seq_id = self._fut_seq.pop(id(fut), None)
+            if seq_id is not None:
+                self.engine.abort(seq_id, reason="client disconnected")
+                self._futures.pop(seq_id, None)
+            if not fut.done():
+                fut.cancel()
+
     def _run(self) -> None:
         while not self._stop:
             try:
                 with self._lock:
+                    self._drain_aborts()
                     if not self.sleeper.is_sleeping:
                         while self._pending:
                             prompt, max_tokens, temperature, fut = self._pending.pop(0)
@@ -227,13 +269,16 @@ class EngineService:
                                     prompt, max_tokens, temperature
                                 )
                                 self._futures[seq_id] = fut
+                                self._fut_seq[id(fut)] = seq_id
                             except Exception as e:
                                 fut.set_exception(e)
                         if self.engine.has_work():
                             for req in self.engine.step():
                                 fut = self._futures.pop(req.seq_id, None)
-                                if fut is not None and not fut.done():
-                                    fut.set_result(req)
+                                if fut is not None:
+                                    self._fut_seq.pop(id(fut), None)
+                                    if not fut.done():
+                                        fut.set_result(req)
                             continue
             except Exception as e:  # device/runtime failure: fail loudly
                 logger.exception("engine loop failed")
@@ -272,6 +317,13 @@ class EngineService:
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
         return fut
+
+    def abort(self, fut: concurrent.futures.Future) -> None:
+        """Client went away: stop generating for its request (vLLM's abort;
+        decode cycles on a disconnected request are pure waste). Applied by
+        the engine thread at the next loop iteration."""
+        self._abort_q.append(fut)
+        self._new_work.set()
 
     def sleep(self, level: int) -> Dict[str, Any]:
         with self._lock:
@@ -428,6 +480,10 @@ def build_app(service: EngineService) -> web.Application:
             req = await asyncio.wrap_future(fut)
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        except asyncio.CancelledError:
+            # client disconnected: free the slot instead of decoding on
+            service.abort(fut)
+            raise
         ttft = (
             (req.first_token_time - req.submit_time)
             if req.first_token_time

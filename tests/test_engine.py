@@ -1,5 +1,7 @@
 """Engine: continuous batching, page accounting, sleep/wake."""
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -156,3 +158,61 @@ def test_level2_discard_and_reinit():
     mgr.wake_up(reinit=reinit)
     out = eng.generate([[1, 2]], max_new_tokens=3)[0]
     assert len(out) == 3
+
+
+def test_abort_waiting_and_inflight():
+    """abort(seq_id) (client disconnect): waiting requests drop before
+    admission; in-flight ones retire and their pages return to the pool."""
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(),
+        max_batch=1,  # slot pressure: second request stays waiting
+        page_size=8,
+        num_pages=32,
+        max_seq_len=64,
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    a = eng.add_request([1, 2, 3], max_new_tokens=30)
+    b = eng.add_request([4, 5, 6], max_new_tokens=30)
+    eng.step()  # admits a (prefill + chunk); b waits
+    assert eng._waiting and eng._waiting[0].seq_id == b
+
+    assert eng.abort(b, "client gone") is True
+    assert not eng._waiting
+
+    assert eng.abort(a, "client gone") is True
+    assert all(s is None for s in eng._slots)
+    assert eng.allocator.available == cfg.num_pages - 1, "pages all returned"
+    assert eng.abort(999) is False
+    assert not eng.has_work()
+
+
+def test_service_abort_frees_slot():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --max-batch 2 --page-size 8 "
+            "--max-model-len 64 --sleep-release-devices never"
+        )
+    )
+    try:
+        fut = svc.submit(list(range(1, 9)), 40, 0.0)
+        svc.abort(fut)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if fut.done() and not svc.engine.has_work():
+                break
+            time.sleep(0.05)
+        assert fut.cancelled() or fut.done()
+        assert not svc.engine.has_work(), "aborted request must not keep decoding"
+        assert (
+            svc.engine.allocator.available == svc.engine.cfg.num_pages - 1
+        )
+        # the engine still serves new work afterwards
+        out = svc.submit([1, 2, 3], 4, 0.0).result(timeout=60)
+        assert len(out.out_tokens) == 4
+    finally:
+        svc.shutdown()
