@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence as Seq
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +89,9 @@ class Request:
     error: Optional[str] = None
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    #: Streaming hook: called as on_token(req, token) for every emitted
+    #: token, on the engine thread. Keep it cheap (enqueue, don't compute).
+    on_token: Optional[Callable[["Request", int], None]] = None
 
 
 class EngineAsleep(RuntimeError):
@@ -258,6 +261,7 @@ class InferenceEngine:
         prompt: Seq[int],
         max_new_tokens: int = 16,
         temperature: float = 0.0,
+        on_token: Optional[Callable[[Request, int], None]] = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -277,6 +281,7 @@ class InferenceEngine:
             prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             temperature=temperature,
+            on_token=on_token,
         )
         self._next_seq_id += 1
         self._waiting.append(req)
@@ -348,6 +353,8 @@ class InferenceEngine:
             or token == self.cfg.eos_token_id
         ):
             req.done = True
+        if req.on_token is not None:
+            req.on_token(req, token)
 
     def _retire(self, req: Request) -> None:
         self.allocator.free(req.pages)

@@ -11,8 +11,13 @@ contract is engine-agnostic and matches what the dual-pods controller speaks
   POST /wake_up
 
 Inference:
-  POST /v1/completions  {"prompt": str | [int], "max_tokens", "temperature"}
+  POST /v1/completions       {"prompt": str | [int], "max_tokens",
+                              "temperature", "stream": bool}
+  POST /v1/chat/completions  {"messages": [{role, content}...], ...}
   GET  /v1/models
+
+Both generation endpoints stream OpenAI-style SSE (`data: {json}` per token,
+`data: [DONE]` terminator) when `"stream": true`.
 
 The engine loop runs on a dedicated thread (device steps block); HTTP
 handlers enqueue requests and await futures. Sleep acquires the step lock, so
@@ -24,6 +29,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import concurrent.futures
+import json
 import logging
 import os
 import shlex
@@ -263,10 +269,13 @@ class EngineService:
                     self._drain_aborts()
                     if not self.sleeper.is_sleeping:
                         while self._pending:
-                            prompt, max_tokens, temperature, fut = self._pending.pop(0)
+                            prompt, max_tokens, temperature, fut, on_token = (
+                                self._pending.pop(0)
+                            )
                             try:
                                 seq_id = self.engine.add_request(
-                                    prompt, max_tokens, temperature
+                                    prompt, max_tokens, temperature,
+                                    on_token=on_token,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -289,7 +298,7 @@ class EngineService:
             self._new_work.clear()
 
     def _fail_all(self, exc: Exception) -> None:
-        for _, _, _, fut in self._pending:
+        for _, _, _, fut, _ in self._pending:
             if not fut.done():
                 fut.set_exception(exc)
         self._pending.clear()
@@ -297,6 +306,7 @@ class EngineService:
             if not fut.done():
                 fut.set_exception(exc)
         self._futures.clear()
+        self._fut_seq.clear()
 
     # -- API used by handlers (event-loop thread) ---------------------------
 
@@ -307,13 +317,20 @@ class EngineService:
         return len(self._pending) + len(eng._waiting) + running
 
     def submit(
-        self, prompt: List[int], max_tokens: int, temperature: float
+        self,
+        prompt: List[int],
+        max_tokens: int,
+        temperature: float,
+        on_token: Optional[Any] = None,
     ) -> concurrent.futures.Future:
+        """Enqueue a request. `on_token(req, tok)` — if given — fires on the
+        engine thread for every emitted token (the streaming hook); keep it
+        to an enqueue."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         if self.failure is not None:
             fut.set_exception(RuntimeError(self.failure))
             return fut
-        self._pending.append((prompt, max_tokens, temperature, fut))
+        self._pending.append((prompt, max_tokens, temperature, fut, on_token))
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
         return fut
@@ -340,8 +357,10 @@ class EngineService:
                 exc = RuntimeError("aborted by level-2 sleep (KV discarded)")
                 for req in aborted:
                     fut = self._futures.pop(req.seq_id, None)
-                    if fut is not None and not fut.done():
-                        fut.set_exception(exc)
+                    if fut is not None:
+                        self._fut_seq.pop(id(fut), None)
+                        if not fut.done():
+                            fut.set_exception(exc)
                 eng = self.engine
                 m = eng.cfg.model
 
@@ -408,6 +427,32 @@ def _tokenize(prompt: Any) -> List[int]:
     raise ValueError("prompt must be a string or a list of token ids")
 
 
+def _chat_prompt(messages: Any) -> List[int]:
+    """Flatten OpenAI-style chat messages into the engine's byte-level token
+    stream (role-tagged lines + assistant cue; a real tokenizer slots in
+    here when models ship with one)."""
+    if not isinstance(messages, list) or not messages:
+        raise ValueError("messages must be a non-empty list")
+    parts: List[str] = []
+    for m in messages:
+        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+            raise ValueError("each message needs role and content")
+        parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+    parts.append("<|assistant|>\n")
+    return list("".join(parts).encode("utf-8"))
+
+
+def _detok(tokens: List[int]) -> str:
+    return bytes(t % 256 for t in tokens).decode("utf-8", errors="replace")
+
+
+def _finish_reason(service: "EngineService", req: Any) -> str:
+    eos = service.engine.cfg.eos_token_id
+    return (
+        "stop" if req.out_tokens and req.out_tokens[-1] == eos else "length"
+    )
+
+
 def build_app(service: EngineService) -> web.Application:
     app = web.Application()
     vocab = service.engine.cfg.model.vocab_size
@@ -462,28 +507,141 @@ def build_app(service: EngineService) -> web.Application:
             content_type="text/plain",
         )
 
-    async def completions(request: web.Request) -> web.Response:
+    def _parse_generation(body: Dict[str, Any], tokens: List[int]):
+        tokens = [t % vocab for t in tokens]
+        if not tokens:
+            raise ValueError("empty prompt")
+        max_tokens = int(body.get("max_tokens", 16))
+        temperature = float(body.get("temperature", 0.0))
+        # pre-validate everything add_request would reject, so streaming
+        # requests fail with a 400 instead of an SSE error after headers
+        # are out
+        cfg = service.engine.cfg
+        if len(tokens) + max_tokens > cfg.seq_len:
+            raise ValueError(
+                f"prompt+generation {len(tokens)}+{max_tokens} exceeds "
+                f"max_seq_len {cfg.seq_len}"
+            )
+        from .kv_cache import PageAllocator
+
+        need = PageAllocator.pages_needed(
+            len(tokens) + max_tokens, cfg.page_size
+        )
+        if need > cfg.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{cfg.num_pages - 1}"
+            )
+        return tokens, max_tokens, temperature
+
+    async def _stream_sse(
+        request: web.Request,
+        tokens: List[int],
+        max_tokens: int,
+        temperature: float,
+        make_chunk,
+    ) -> web.StreamResponse:
+        """OpenAI-style SSE stream: one `data: {json}` event per emitted
+        token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
+        event-loop boundary via call_soon_threadsafe into an asyncio queue,
+        so delivery granularity is the engine's decode chunk."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_token(req, tok: int) -> None:
+            loop.call_soon_threadsafe(q.put_nowait, (tok, req.done))
+
+        fut = service.submit(tokens, max_tokens, temperature, on_token=on_token)
+        afut = asyncio.ensure_future(asyncio.wrap_future(fut))
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        qtask: Optional[asyncio.Task] = None
         try:
-            body = await request.json()
-        except Exception:
-            raise web.HTTPBadRequest(text="invalid JSON body")
+            # inside the try: a disconnect cancelling this await must still
+            # abort the in-flight generation
+            await resp.prepare(request)
+            index = 0
+            while True:
+                if qtask is None:
+                    qtask = asyncio.ensure_future(q.get())
+                done_set, _ = await asyncio.wait(
+                    {qtask, afut}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if qtask in done_set:
+                    tok, req_done = qtask.result()
+                    qtask = None
+                    payload = json.dumps(make_chunk(tok, index))
+                    index += 1
+                    await resp.write(f"data: {payload}\n\n".encode())
+                    if req_done:
+                        break
+                elif afut.done():
+                    # finished without a terminal token event: submit error,
+                    # engine failure, or an abort — surface it as an SSE
+                    # error event (headers are already gone)
+                    exc = (
+                        afut.exception()
+                        if not afut.cancelled()
+                        else RuntimeError("request aborted")
+                    )
+                    if exc is not None:
+                        err = json.dumps({"error": str(exc)})
+                        await resp.write(f"data: {err}\n\n".encode())
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            service.abort(fut)
+            raise
+        finally:
+            if qtask is not None:
+                qtask.cancel()
+            afut.cancel()
+        await resp.write_eof()
+        return resp
+
+    async def _await_generation(fut):
         try:
-            tokens = [t % vocab for t in _tokenize(body.get("prompt"))]
-            if not tokens:
-                raise ValueError("empty prompt")
-            max_tokens = int(body.get("max_tokens", 16))
-            temperature = float(body.get("temperature", 0.0))
-        except ValueError as e:
-            raise web.HTTPBadRequest(text=str(e))
-        fut = service.submit(tokens, max_tokens, temperature)
-        try:
-            req = await asyncio.wrap_future(fut)
+            return await asyncio.wrap_future(fut)
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         except asyncio.CancelledError:
             # client disconnected: free the slot instead of decoding on
             service.abort(fut)
             raise
+
+    async def completions(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        try:
+            tokens, max_tokens, temperature = _parse_generation(
+                body, _tokenize(body.get("prompt"))
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+
+        if body.get("stream"):
+            def chunk(tok: int, index: int) -> Dict[str, Any]:
+                return {
+                    "object": "text_completion",
+                    "model": service.args.model,
+                    "choices": [
+                        {"index": 0, "text": _detok([tok]), "token_ids": [tok]}
+                    ],
+                }
+
+            return await _stream_sse(
+                request, tokens, max_tokens, temperature, chunk
+            )
+
+        req = await _await_generation(
+            service.submit(tokens, max_tokens, temperature)
+        )
         ttft = (
             (req.first_token_time - req.submit_time)
             if req.first_token_time
@@ -497,19 +655,66 @@ def build_app(service: EngineService) -> web.Application:
                     {
                         "index": 0,
                         "token_ids": req.out_tokens,
-                        "text": bytes(
-                            t % 256 for t in req.out_tokens
-                        ).decode("utf-8", errors="replace"),
-                        "finish_reason": "stop"
-                        if req.out_tokens
-                        and req.out_tokens[-1] == service.engine.cfg.eos_token_id
-                        else "length",
+                        "text": _detok(req.out_tokens),
+                        "finish_reason": _finish_reason(service, req),
                     }
                 ],
                 "usage": {
                     "prompt_tokens": len(tokens),
                     "completion_tokens": len(req.out_tokens),
                     "time_to_first_token_s": ttft,
+                },
+            }
+        )
+
+    async def chat_completions(request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        try:
+            tokens, max_tokens, temperature = _parse_generation(
+                body, _chat_prompt(body.get("messages"))
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+
+        if body.get("stream"):
+            def chunk(tok: int, index: int) -> Dict[str, Any]:
+                delta: Dict[str, Any] = {"content": _detok([tok])}
+                if index == 0:
+                    delta["role"] = "assistant"
+                return {
+                    "object": "chat.completion.chunk",
+                    "model": service.args.model,
+                    "choices": [{"index": 0, "delta": delta}],
+                }
+
+            return await _stream_sse(
+                request, tokens, max_tokens, temperature, chunk
+            )
+
+        req = await _await_generation(
+            service.submit(tokens, max_tokens, temperature)
+        )
+        return web.json_response(
+            {
+                "object": "chat.completion",
+                "model": service.args.model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {
+                            "role": "assistant",
+                            "content": _detok(req.out_tokens),
+                            "token_ids": req.out_tokens,
+                        },
+                        "finish_reason": _finish_reason(service, req),
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(tokens),
+                    "completion_tokens": len(req.out_tokens),
                 },
             }
         )
@@ -521,6 +726,7 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/chat/completions", chat_completions)
 
     if os.environ.get("FMA_DEBUG_ENDPOINTS") == "1":
         # test-server role (SURVEY §4): crash induction for the

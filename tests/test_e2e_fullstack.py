@@ -18,6 +18,10 @@ Cases (reference analogue in parens):
   * controller restart recovery          ("restart recovery")
   * crashed-instance recovery through the real notifier
                                          ("stopped-instance recovery")
+  * switch instances warm both ways      ("switch instances")
+  * obsolete sleeping instance GC        ("obsolete instance GC")
+  * obsolete awake instance delete-on-unbind
+                                         ("obsolete awake instance")
 """
 
 import asyncio
@@ -663,6 +667,162 @@ def test_crashed_instance_recovery_via_notifier(scenario):
             except (asyncio.CancelledError, Exception):
                 pass
             await source.close()
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_switch_instances_warm_both_ways(scenario, tmp_path):
+    """Alternate two ISCs on one launcher (different chips): A -> B -> A -> B.
+    After both have cold-started once, every later actuation is a warm wake
+    of the existing instance — never a recreation (reference 'switch
+    instances', test-cases.sh:512-554)."""
+    sc = scenario
+    port_a, port_b = free_port(), free_port()
+    stub2, spi2, probes2 = spawn_requester_stub([CHIP2], tmp_path / "stub2.log")
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc(max_instances=2)
+            sc.add_isc("isc-a", port_a)
+            sc.add_isc("isc-b", port_b)
+            sc.add_launcher_pod()
+
+            # A cold
+            sc.add_requester("req-a", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            out_a = complete(port_a)
+            sc.ks.delete("Pod", sc.ns, "req-a")
+            await sc.wait_engine_sleeping(port_a, True)
+
+            # B cold (coexists with sleeping A)
+            sc.add_requester("req-b", "isc-b", spi2)
+            await sc.wait_ready(probes2)
+            out_b = complete(port_b)
+            sc.ks.delete("Pod", sc.ns, "req-b")
+            await sc.wait_engine_sleeping(port_b, True)
+            assert launcher_instances()["total_instances"] == 2
+
+            # switch back to A: warm wake, not a third instance
+            reset_stub(sc.default_spi)
+            sc.add_requester("req-a2", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            await sc.wait_engine_sleeping(port_a, False)
+            assert complete(port_a) == out_a
+            assert launcher_instances()["total_instances"] == 2, (
+                "switch must wake, never recreate"
+            )
+            sc.ks.delete("Pod", sc.ns, "req-a2")
+            await sc.wait_engine_sleeping(port_a, True)
+
+            # and back to B
+            reset_stub(spi2)
+            sc.add_requester("req-b2", "isc-b", spi2)
+            await sc.wait_ready(probes2)
+            await sc.wait_engine_sleeping(port_b, False)
+            assert complete(port_b) == out_b
+            assert launcher_instances()["total_instances"] == 2
+        finally:
+            await sc.stop()
+            stub2.terminate()
+            stub2.wait(timeout=10)
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_obsolete_sleeping_instance_gc_on_isc_update(scenario):
+    """A sleeping instance whose ISC spec changed is garbage-collected: the
+    instance hash no longer matches, so keeping the sleeper would wake the
+    WRONG server config (reference 'obsolete sleeping instance GC',
+    test-cases.sh:719-737)."""
+    sc = scenario
+    engine_port = free_port()
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc1", engine_port)
+            sc.add_launcher_pod()
+            sc.add_requester("req1", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+
+            sc.ks.delete("Pod", sc.ns, "req1")
+            await sc.wait_engine_sleeping(engine_port, True)
+            assert launcher_instances()["total_instances"] == 1
+
+            # ISC spec changes while the instance sleeps -> GC deletes it
+            def bump(isc):
+                msc = isc["spec"]["modelServerConfig"]
+                msc["options"] = msc["options"] + " --seed 7"
+                return isc
+
+            sc.ks.mutate("InferenceServerConfig", sc.ns, "isc1", bump)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if launcher_instances()["total_instances"] == 0:
+                    break
+                await asyncio.sleep(0.3)
+            assert launcher_instances()["total_instances"] == 0, (
+                "obsolete sleeper must be deleted after ISC update"
+            )
+
+            # re-actuation cold-starts the NEW config
+            reset_stub(sc.default_spi)
+            sc.add_requester("req2", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert len(complete(engine_port)) == 3
+            assert launcher_instances()["total_instances"] == 1
+        finally:
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_obsolete_awake_instance_deleted_on_unbind(scenario):
+    """The ISC changes while its instance is BOUND and serving; on unbind the
+    controller must DELETE the now-obsolete instance instead of sleeping it
+    (reference 'obsolete awake instance', test-cases.sh:744-776)."""
+    sc = scenario
+    engine_port = free_port()
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc1", engine_port)
+            sc.add_launcher_pod()
+            sc.add_requester("req1", "isc1", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            assert len(complete(engine_port)) == 3
+
+            # spec changes under a live binding (no immediate effect)
+            def bump(isc):
+                msc = isc["spec"]["modelServerConfig"]
+                msc["options"] = msc["options"] + " --seed 9"
+                return isc
+
+            sc.ks.mutate("InferenceServerConfig", sc.ns, "isc1", bump)
+            await asyncio.sleep(1.0)
+            assert launcher_instances()["total_instances"] == 1, (
+                "bound instance keeps serving through an ISC update"
+            )
+
+            # unbind: obsolete awake instance is deleted, not slept
+            sc.ks.delete("Pod", sc.ns, "req1")
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if launcher_instances()["total_instances"] == 0:
+                    break
+                await asyncio.sleep(0.3)
+            assert launcher_instances()["total_instances"] == 0, (
+                "obsolete awake instance must be deleted on unbind"
+            )
+        finally:
             await sc.stop()
 
     run(body())

@@ -137,3 +137,109 @@ def test_engine_loop_failure_fails_health_and_requests(service):
         assert "injected device failure" in body["error"]
 
     run_async(_client(service, scenario))
+
+
+async def _read_sse(resp):
+    """Collect SSE data events until [DONE]; returns the decoded JSON list."""
+    events = []
+    async for line in resp.content:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            return events, True
+        import json
+
+        events.append(json.loads(payload))
+    return events, False
+
+
+def test_streaming_completion_delivers_every_token(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 5, "stream": True},
+        )
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        events, done = await _read_sse(r)
+        assert done
+        toks = [e["choices"][0]["token_ids"][0] for e in events]
+        assert len(toks) == 5
+
+        # the streamed tokens match a non-streamed run of the same prompt
+        r2 = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 5}
+        )
+        body = await r2.json()
+        assert body["choices"][0]["token_ids"] == toks
+
+    run_async(_client(service, scenario))
+
+
+def test_streaming_submit_error_is_sse_error_event(service):
+    async def scenario(client):
+        # request larger than max_model_len fails at admission, after SSE
+        # headers are committed: must surface as an error event, not a hang
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1] * 63, "max_tokens": 10, "stream": True},
+        )
+        assert r.status == 400  # rejected before streaming starts
+
+        # an engine-loop failure mid-stream surfaces as an SSE error event
+        def boom():
+            raise RuntimeError("injected stream failure")
+
+        service.engine.step = boom
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2], "max_tokens": 4, "stream": True},
+        )
+        assert r.status == 200
+        events, done = await _read_sse(r)
+        assert done
+        assert any("error" in e for e in events)
+
+    run_async(_client(service, scenario))
+
+
+def test_chat_completions_roundtrip_and_stream(service):
+    async def scenario(client):
+        msgs = [
+            {"role": "system", "content": "be terse"},
+            {"role": "user", "content": "hi"},
+        ]
+        r = await client.post(
+            "/v1/chat/completions", json={"messages": msgs, "max_tokens": 4}
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert body["object"] == "chat.completion"
+        msg = body["choices"][0]["message"]
+        assert msg["role"] == "assistant" and len(msg["token_ids"]) == 4
+
+        # streamed chat: first delta carries the role, deltas concatenate
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": msgs, "max_tokens": 4, "stream": True},
+        )
+        assert r.status == 200
+        events, done = await _read_sse(r)
+        assert done and len(events) == 4
+        assert events[0]["choices"][0]["delta"]["role"] == "assistant"
+        streamed = "".join(
+            e["choices"][0]["delta"]["content"] for e in events
+        )
+        assert streamed == msg["content"]
+
+        # malformed messages are 400s
+        r = await client.post("/v1/chat/completions", json={"messages": []})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/chat/completions", json={"messages": [{"role": "user"}]}
+        )
+        assert r.status == 400
+
+    run_async(_client(service, scenario))
