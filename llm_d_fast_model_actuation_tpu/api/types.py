@@ -185,11 +185,17 @@ class SliceTopology:
 class AcceleratorSpec:
     """TPU accelerator requirements of one engine instance."""
 
-    #: Number of chips (tensor-parallel degree for the engine).
+    #: Number of chips (tensor-parallel degree for the engine). For a
+    #: multi-host slice this is chips PER HOST.
     chips: int = 1
     #: Required sub-slice topology, e.g. "2x2"; empty = any `chips` chips on
-    #: one host.
+    #: one host. With hosts > 1 this is the GLOBAL slice topology (e.g.
+    #: "4x4" over two 2x4 hosts).
     topology: str = ""
+    #: Hosts the slice spans. 1 = single-host (the reference's only case);
+    #: > 1 actuates a gang of requester/provider pairs whose engine
+    #: processes form one jax.distributed job (parallel/multihost.py).
+    hosts: int = 1
     #: Whether the ISC explicitly declared an accelerator spec. Only then is
     #: placement validated against it (an absent spec accepts whatever the
     #: scheduler assigned, matching the reference's behavior).
@@ -199,6 +205,8 @@ class AcceleratorSpec:
         d: Dict[str, Any] = {"chips": self.chips}
         if self.topology:
             d["topology"] = self.topology
+        if self.hosts != 1:
+            d["hosts"] = self.hosts
         return d
 
     @classmethod
@@ -206,6 +214,7 @@ class AcceleratorSpec:
         return cls(
             chips=int(d.get("chips", 1) or 1),
             topology=d.get("topology", ""),
+            hosts=int(d.get("hosts", 1) or 1),
             specified=bool(d),
         )
 

@@ -38,6 +38,12 @@ def main(argv=None) -> None:
     _common(dpc)
     dpc.add_argument("--sleeper-limit", type=int, default=1)
     dpc.add_argument("--accelerator-sleeping-memory-limit-bytes", type=int, default=0)
+    dpc.add_argument(
+        "--disable-slice-gangs",
+        action="store_true",
+        help="don't run the slice-gang coordinator (multi-host ISCs will "
+        "never actuate)",
+    )
 
     pop = sub.add_parser("launcher-populator", help="proactive launcher population")
     _common(pop)
@@ -79,6 +85,7 @@ def main(argv=None) -> None:
     async def run() -> None:
         if hasattr(store, "start"):
             await store.start()
+        gang = None
         if args.cmd == "dual-pods-controller":
             from .clients import HttpTransports
             from .dualpods import DualPodsConfig, DualPodsController
@@ -92,6 +99,10 @@ def main(argv=None) -> None:
                     accelerator_sleeping_memory_limit_bytes=args.accelerator_sleeping_memory_limit_bytes,
                 ),
             )
+            if not args.disable_slice_gangs:
+                from .gang import SliceGangCoordinator
+
+                gang = SliceGangCoordinator(store, args.namespace)
         else:
             from .populator import Populator, PopulatorConfig
 
@@ -105,6 +116,8 @@ def main(argv=None) -> None:
                 ),
             )
         await ctl.start()
+        if gang is not None:
+            await gang.start()
         # readiness = initial batch processed (knows-processed-sync):
         # destructive decisions are safe only after one pass over the world
         await ctl.initial_sync.wait()
@@ -114,6 +127,8 @@ def main(argv=None) -> None:
         try:
             await asyncio.Event().wait()  # serve forever
         finally:
+            if gang is not None:
+                await gang.stop()
             await ctl.stop()
             if hasattr(store, "stop"):
                 await store.stop()

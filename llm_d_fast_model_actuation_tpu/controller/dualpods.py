@@ -509,7 +509,13 @@ class DualPodsController:
             await self._set_status(ns, name, acc_errors)
             return
 
-        engine_cfg, instance_id = self._desired_instance(isc, isc_name, sd.chip_ids)
+        gang_env: Optional[Dict[str, str]] = None
+        if isc.spec.engine_server_config.accelerator.hosts > 1:
+            gang_env = await self._await_gang_assignment(ns, name, sd)
+
+        engine_cfg, instance_id = self._desired_instance(
+            isc, isc_name, sd.chip_ids, extra_env=gang_env
+        )
         sd.instance_id = instance_id
         sd.server_port = isc.spec.engine_server_config.port
         sd.engine_config = engine_cfg
@@ -561,7 +567,11 @@ class DualPodsController:
                 f"chips {sorted(chip_ids)} are not ICI-contiguous "
                 "(TP collectives would leave the mesh)"
             )
-        if spec.topology and not errors:
+        # With hosts > 1, spec.topology is the GLOBAL slice shape; one
+        # host's bounding box is only a tile of it, so the shape check is
+        # the gang planner's job (parallel/multihost.plan_slice). Per-host
+        # contiguity above still applies.
+        if spec.topology and spec.hosts == 1 and not errors:
             want = SliceTopology.parse(spec.topology)
             spans = []
             ndim = len(coords[0]) if coords else 0
@@ -611,8 +621,36 @@ class DualPodsController:
             return None
         return coords
 
+    async def _await_gang_assignment(
+        self, ns: str, req_name: str, sd: "ServerData"
+    ) -> Dict[str, str]:
+        """Multi-host ISC: publish this requester's chips so the slice-gang
+        coordinator (controller/gang.py) can plan, then wait for the gang
+        stamp. Its env makes the engine child join the jax.distributed job."""
+        from .gang import gang_env_of
+
+        chips = ",".join(sorted(sd.chip_ids or []))
+
+        def publish(pod):
+            ann = pod["metadata"].setdefault("annotations", {})
+            if ann.get(C.ACCELERATORS_ANNOTATION) == chips:
+                return None
+            ann[C.ACCELERATORS_ANNOTATION] = chips
+            return pod
+
+        await self._amutate("Pod", ns, req_name, publish)
+        pod = self.store.try_get("Pod", ns, req_name)
+        env = gang_env_of(pod) if pod is not None else None
+        if env is None:
+            raise Retry("waiting for slice-gang assignment", after=0.5)
+        return env
+
     def _desired_instance(
-        self, isc: InferenceServerConfig, isc_name: str, chip_ids: List[str]
+        self,
+        isc: InferenceServerConfig,
+        isc_name: str,
+        chip_ids: List[str],
+        extra_env: Optional[Dict[str, str]] = None,
     ) -> Tuple[Dict[str, Any], str]:
         """Desired instance config + deterministic ID
         (computeDesiredInstanceState, inference-server.go:1015-1057)."""
@@ -620,13 +658,13 @@ class DualPodsController:
         cfg = {
             "options": esc.options,
             "gpu_uuids": sorted(chip_ids),
-            "env_vars": dict(esc.env_vars),
+            "env_vars": {**esc.env_vars, **(extra_env or {})},
             "annotations": {
                 ISC_NAME_ANNOTATION: isc_name,
                 INFERENCE_PORT_ANNOTATION: str(esc.port),
             },
         }
-        iid = instance_id_for(esc, chip_ids)
+        iid = instance_id_for(esc, chip_ids, extra_env=extra_env)
         return cfg, iid
 
     # ------------------------------------------------------ launcher selection

@@ -1,0 +1,356 @@
+"""Slice-gang coordinator: actuating multi-host InferenceServerConfigs.
+
+The reference's largest serving unit is one node's GPUs; a TPU slice can
+span hosts (v5e-16 = 2 hosts x 2x4), served by ONE engine running as N
+jax.distributed processes — one per host (SURVEY.md §7 hard part #5;
+`parallel/multihost.py`). Under dual-pods that means a GANG of
+requester/provider pairs. This controller owns the gang lifecycle:
+
+  * **group**: gang-less requesters of a multi-host ISC — chips discovered
+    (accelerators annotation stamped by the dual-pods controller), on
+    distinct nodes — are grouped into gangs of exactly ``accelerator.hosts``
+    members;
+  * **plan**: the slice is planned from the chip-map ConfigMap (host
+    shapes + ``origin:`` lines give each host's corner in global slice
+    coordinates); planning failures are surfaced on the ISC status;
+  * **stamp**: each member gets the gang id and its member coordination
+    env (FMA_NUM_PROCESSES / FMA_PROCESS_ID / FMA_COORDINATOR_ADDRESS) as
+    annotations. The dual-pods controller defers instance creation for
+    multi-host requesters until the stamp exists, then merges the env into
+    the engine instance config — jax.distributed.initialize in each child
+    blocks until the whole gang joins, so readiness needs no extra gating;
+  * **degrade**: an SPMD job cannot lose a process and continue. When a
+    gang member disappears, the remaining members' requesters are deleted
+    (UID preconditions — the relay pattern of inference-server.go:256-289)
+    so their ReplicaSet re-creates them and a fresh gang forms.
+
+The coordinator address uses the process-0 member's requester Pod IP:
+on TPU hosts the requester and its provider run hostNetwork, so the node
+address is stable across the pair (and in the TPU-less e2e everything is
+loopback).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import constants as C
+from ..api.types import InferenceServerConfig
+from ..parallel.multihost import (
+    COORDINATOR_PORT,
+    SlicePlanError,
+    plan_slice,
+)
+from ..parallel.topology import HostTopology
+from .directpath import load_chip_map
+from .store import Conflict, NotFound
+
+logger = logging.getLogger(__name__)
+
+#: Gang id a member belongs to (short content hash; a fresh grouping mints
+#: a fresh id, so stale stamps are detectable).
+GANG_ANNOTATION = "dual-pods.llm-d.ai/slice-gang"
+#: JSON env this member's engine child needs to join the gang.
+GANG_ENV_ANNOTATION = "dual-pods.llm-d.ai/slice-gang-env"
+
+
+def gang_env_of(pod: Dict[str, Any]) -> Optional[Dict[str, str]]:
+    """The member coordination env stamped on a requester, if any."""
+    ann = (pod.get("metadata") or {}).get("annotations") or {}
+    raw = ann.get(GANG_ENV_ANNOTATION, "")
+    if not raw:
+        return None
+    try:
+        env = json.loads(raw)
+    except ValueError:
+        return None
+    return {str(k): str(v) for k, v in env.items()}
+
+
+def is_multihost(isc: InferenceServerConfig) -> bool:
+    return isc.spec.engine_server_config.accelerator.hosts > 1
+
+
+class SliceGangCoordinator:
+    """Watches requesters of multi-host ISCs; forms, stamps, and degrades
+    gangs. Store-agnostic like the other controllers."""
+
+    def __init__(
+        self,
+        store: Any,
+        namespace: str,
+        coordinator_port: int = COORDINATOR_PORT,
+    ) -> None:
+        self.store = store
+        self.ns = namespace
+        self.port = coordinator_port
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queued: set = set()
+        self._task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._unsub = None
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._unsub = self.store.subscribe(self._on_event)
+        self._task = self._loop.create_task(self._run())
+        # initial sync: every multi-host ISC present at startup
+        for obj in self.store.list(InferenceServerConfig.KIND, self.ns):
+            self._enqueue(obj["metadata"]["name"])
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._unsub:
+            self._unsub()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def _on_event(self, event: str, obj: Dict[str, Any]) -> None:
+        md = obj.get("metadata") or {}
+        if md.get("namespace") != self.ns:
+            return
+        kind = obj.get("kind")
+        if kind == InferenceServerConfig.KIND:
+            self._enqueue(md["name"])
+        elif kind == "Pod":
+            isc = (md.get("annotations") or {}).get(
+                C.INFERENCE_SERVER_CONFIG_ANNOTATION
+            )
+            if isc:
+                self._enqueue(isc)
+
+    def _enqueue(self, isc_name: str) -> None:
+        # Store subscribers run on whichever thread commits the write (our
+        # own mutations run via asyncio.to_thread) — asyncio.Queue is not
+        # thread-safe, so hop onto the loop like the sibling controllers do.
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def put() -> None:
+            if isc_name in self._queued:
+                return
+            self._queued.add(isc_name)
+            self._queue.put_nowait(isc_name)
+
+        try:
+            loop.call_soon_threadsafe(put)
+        except RuntimeError:  # loop gone during shutdown
+            pass
+
+    async def _run(self) -> None:
+        while not self._stopping:
+            isc_name = await self._queue.get()
+            self._queued.discard(isc_name)
+            try:
+                await self._reconcile(isc_name)
+            except Exception:
+                logger.exception("gang reconcile %s failed", isc_name)
+                await asyncio.sleep(0.5)
+                self._enqueue(isc_name)
+
+    # -- reconcile -----------------------------------------------------------
+
+    async def _reconcile(self, isc_name: str) -> None:
+        obj = self.store.try_get(InferenceServerConfig.KIND, self.ns, isc_name)
+        if obj is None:
+            return
+        isc = InferenceServerConfig.from_dict(obj)
+        if not is_multihost(isc):
+            return
+        hosts_needed = isc.spec.engine_server_config.accelerator.hosts
+
+        members: List[Dict[str, Any]] = []
+        for pod in self.store.list("Pod", self.ns):
+            md = pod.get("metadata") or {}
+            ann = md.get("annotations") or {}
+            if ann.get(C.INFERENCE_SERVER_CONFIG_ANNOTATION) != isc_name:
+                continue
+            if md.get("deletionTimestamp"):
+                continue
+            members.append(pod)
+
+        # ---- degrade broken gangs ------------------------------------------
+        by_gang: Dict[str, List[Dict[str, Any]]] = {}
+        for pod in members:
+            gid = (pod["metadata"].get("annotations") or {}).get(
+                GANG_ANNOTATION
+            )
+            if gid:
+                by_gang.setdefault(gid, []).append(pod)
+        for gid, pods in by_gang.items():
+            if len(pods) >= hosts_needed:
+                continue
+            # a member is gone: the SPMD job is dead — relay-delete the rest
+            for pod in pods:
+                md = pod["metadata"]
+                logger.info(
+                    "gang %s degraded (%d/%d members): deleting %s",
+                    gid, len(pods), hosts_needed, md["name"],
+                )
+                try:
+                    await asyncio.to_thread(
+                        self.store.delete,
+                        "Pod", self.ns, md["name"],
+                        expect_uid=md.get("uid"),
+                    )
+                except (NotFound, Conflict):
+                    pass
+
+        # ---- form a new gang from unassigned members -----------------------
+        unassigned = [
+            p
+            for p in members
+            if not (p["metadata"].get("annotations") or {}).get(GANG_ANNOTATION)
+            and (p["metadata"].get("annotations") or {}).get(
+                C.ACCELERATORS_ANNOTATION
+            )
+            and (p.get("spec") or {}).get("nodeName")
+        ]
+        # one candidate per node (two requesters of one ISC on one node
+        # can't be in the same gang)
+        by_node: Dict[str, Dict[str, Any]] = {}
+        for p in sorted(unassigned, key=lambda p: p["metadata"]["name"]):
+            by_node.setdefault(p["spec"]["nodeName"], p)
+        if len(by_node) < hosts_needed:
+            # not enough members yet; pod events re-enqueue us. Clear any
+            # stale planning error — the world has changed since it was set.
+            await self._set_status(isc_name, [])
+            return
+
+        topo = isc.spec.engine_server_config.accelerator.topology
+        if not topo:
+            await self._set_status(
+                isc_name,
+                ["multi-host ISC must declare accelerator.topology (the "
+                 "global slice shape)"],
+            )
+            return
+        chip_map = load_chip_map(self.store, self.ns)
+        if chip_map is None:
+            await self._set_status(
+                isc_name,
+                ["multi-host ISC needs the chip-map ConfigMap (host "
+                 "origins) to plan the slice"],
+            )
+            return
+
+        # Select by slice origin, not node-name order: one host per origin
+        # cell (alphabetical tie-break), lexicographic origins starting at
+        # the zero corner — extra candidates (e.g. hosts of another slice)
+        # must not poison the selection.
+        by_origin: Dict[Tuple[int, ...], str] = {}
+        for node in sorted(by_node):
+            if chip_map.host(node) is None:
+                continue  # unmapped node can't be planned; skip
+            by_origin.setdefault(tuple(chip_map.origin(node)), node)
+        origins = sorted(by_origin)
+        if len(origins) < hosts_needed or not origins or any(
+            o != 0 for o in origins[0]
+        ):
+            await self._set_status(isc_name, [])  # waiting, not an error
+            return
+        chosen = {
+            by_origin[o]: by_node[by_origin[o]]
+            for o in origins[:hosts_needed]
+        }
+
+        plan_input: Dict[str, Tuple[Tuple[int, ...], HostTopology]] = {}
+        for node, pod in chosen.items():
+            host = chip_map.host(node)
+            reported = (
+                pod["metadata"]["annotations"][C.ACCELERATORS_ANNOTATION]
+            ).split(",")
+            by_id = host.by_id()
+            missing = [c for c in reported if c not in by_id]
+            if missing:
+                await self._set_status(
+                    isc_name,
+                    [f"node {node}: chips {missing} absent from chip-map"],
+                )
+                return
+            local = HostTopology(
+                topology=host.topology,
+                chips=[by_id[c] for c in reported],
+            )
+            plan_input[node] = (chip_map.origin(node), local)
+
+        try:
+            plan = plan_slice(topo, plan_input)
+        except SlicePlanError as e:
+            await self._set_status(isc_name, [f"slice planning: {e}"])
+            return
+
+        coord_pod = chosen[plan.coordinator_node]
+        coord_ip = (coord_pod.get("status") or {}).get("podIP", "")
+        if not coord_ip:
+            await self._set_status(isc_name, [])
+            return  # no IP yet; pod update re-enqueues us
+
+        import secrets
+
+        gid = f"g{secrets.token_hex(4)}"
+        # Per-gang coordinator port: a degraded gang's process-0 engine may
+        # still be alive (asleep) holding the old port on hostNetwork; a
+        # fixed port would make the next gang's bind fail. Derived from the
+        # gang id so all members agree without another round-trip.
+        port = self.port + int(gid[1:], 16) % 512
+        for node, pod in chosen.items():
+            assignment = plan.assignment_for(node)
+            env = plan.coordination_env(assignment.process_id, coord_ip, port)
+            # the gang id makes the env — and therefore the engine instance
+            # identity (utils/hashing.instance_id_for) — unique per gang: a
+            # sleeping member of a dead gang must never be woken into a new
+            # gang (jax.distributed.initialize cannot re-run in-process)
+            env["FMA_GANG_ID"] = gid
+            name = pod["metadata"]["name"]
+
+            def stamp(p, env=env):
+                ann = p["metadata"].setdefault("annotations", {})
+                if ann.get(GANG_ANNOTATION):
+                    return None  # raced: someone stamped already
+                ann[GANG_ANNOTATION] = gid
+                ann[GANG_ENV_ANNOTATION] = json.dumps(env, sort_keys=True)
+                return p
+
+            try:
+                await asyncio.to_thread(
+                    self.store.mutate, "Pod", self.ns, name, stamp
+                )
+            except (NotFound, Conflict):
+                # member vanished mid-stamp: the partial gang will degrade
+                # on the next event
+                return
+        await self._set_status(isc_name, [])
+        logger.info(
+            "gang %s formed for %s: %s",
+            gid, isc_name,
+            [(h.node, h.process_id) for h in plan.hosts],
+        )
+
+    async def _set_status(self, isc_name: str, errors: List[str]) -> None:
+        def apply(obj):
+            status = obj.setdefault("status", {})
+            cur = status.get("gangErrors") or []
+            if cur == errors:
+                return None
+            status["gangErrors"] = errors
+            return obj
+
+        try:
+            await asyncio.to_thread(
+                self.store.mutate,
+                InferenceServerConfig.KIND, self.ns, isc_name, apply,
+            )
+        except (NotFound, Conflict):
+            pass

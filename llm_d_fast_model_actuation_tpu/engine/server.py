@@ -139,7 +139,40 @@ def make_arg_parser() -> argparse.ArgumentParser:
         help="load weights from this Orbax checkpoint (and reload from it "
         "on level-2 wake) instead of random init",
     )
+    # Multi-host slice coordination (parallel/multihost.py): N engine
+    # processes — one per host — form one jax.distributed job. Defaults
+    # come from the FMA_NUM_PROCESSES / FMA_PROCESS_ID /
+    # FMA_COORDINATOR_ADDRESS env the gang coordinator ships.
+    p.add_argument("--num-processes", type=int, default=0)
+    p.add_argument("--process-id", type=int, default=-1)
+    p.add_argument("--coordinator-address", default="")
     return p
+
+
+def resolve_distributed(args: argparse.Namespace) -> Optional[Dict[str, Any]]:
+    """CLI flags > gang env > single-process default. Returns kwargs for
+    jax.distributed.initialize, or None when single-process."""
+    num = args.num_processes or int(os.environ.get("FMA_NUM_PROCESSES", "0") or 0)
+    if num <= 1:
+        return None
+    pid = (
+        args.process_id
+        if args.process_id >= 0
+        else int(os.environ.get("FMA_PROCESS_ID", "-1"))
+    )
+    addr = args.coordinator_address or os.environ.get(
+        "FMA_COORDINATOR_ADDRESS", ""
+    )
+    if pid < 0 or pid >= num or not addr:
+        raise ValueError(
+            f"multi-host engine needs process-id in [0,{num}) and a "
+            f"coordinator address (got id={pid}, addr={addr!r})"
+        )
+    return {
+        "coordinator_address": addr,
+        "num_processes": num,
+        "process_id": pid,
+    }
 
 
 def validate_parsed_args(args: argparse.Namespace) -> None:
@@ -178,6 +211,25 @@ class EngineService:
         self.failure: Optional[str] = None
         self.started_at = time.monotonic()
 
+        dist = resolve_distributed(args)
+        if dist is not None and args.tensor_parallel_size <= 1:
+            # an unsharded multi-process engine would device_put onto
+            # non-addressable global devices; the gang contract is SPMD
+            # over the whole slice
+            raise ValueError(
+                "multi-host engine requires --tensor-parallel-size equal "
+                "to the global chip count (got "
+                f"{args.tensor_parallel_size})"
+            )
+        if dist is not None:
+            # Must run before any device/backend touch: every process of the
+            # gang joins the coordination service, and jax.devices() becomes
+            # the GLOBAL device set. initialize() blocks until all
+            # num_processes join — so this engine reporting healthy implies
+            # the whole multi-host gang formed.
+            import jax
+
+            jax.distributed.initialize(**dist)
         model_cfg = MODEL_CONFIGS[args.model]()
         mesh = None
         if args.tensor_parallel_size > 1:

@@ -1,0 +1,192 @@
+"""Multi-host slice planning: one SPMD engine spanning several hosts.
+
+The reference never needed this — its largest unit is one node's GPUs
+(`docs/dual-pods.md:189-190`, multi-GPU via `CUDA_VISIBLE_DEVICES`). On TPU
+a slice bigger than one host (e.g. v5e-16 = 2 hosts x 2x4) is served by ONE
+engine running as N coordinated processes, one per host, joined through
+jax.distributed: every process opens its local chips, and the jit'd programs
+see the global device set (SURVEY.md §7 hard part #5).
+
+Dual-pods consequence: a multi-host InferenceServerConfig is actuated by a
+GANG of requester/provider pairs — one per host — whose engine processes
+form one jax.distributed job. This module is the pure planning layer:
+
+  * which hosts, in which process order (lowest slice-origin first — the
+    libtpu convention that process 0 owns the lowest coordinates),
+  * which chips each process opens,
+  * the coordination env each engine child needs
+    (FMA_NUM_PROCESSES / FMA_PROCESS_ID / FMA_COORDINATOR_ADDRESS).
+
+The gang lifecycle (grouping requesters, stamping plans, degrading on
+member loss) lives in `controller/gang.py`; the engine-side consumption in
+`engine/server.py` (jax.distributed.initialize before device init).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ..api.types import SliceTopology
+from .topology import HostTopology
+
+#: Base port for the jax.distributed coordination service the process-0
+#: engine child binds (distinct from the serving port). The gang
+#: coordinator derives a per-gang port from this base so a lingering
+#: (asleep) member of a dead gang can't block the next gang's bind.
+COORDINATOR_PORT = 8476
+
+
+class SlicePlanError(ValueError):
+    """The offered hosts cannot realize the requested slice."""
+
+
+@dataclass(frozen=True)
+class HostAssignment:
+    """One host's share of a multi-host slice."""
+
+    node: str
+    process_id: int
+    origin: Tuple[int, ...]  #: host origin in global slice coordinates
+    chip_ids: Tuple[str, ...]  #: local chips this process opens, index order
+
+
+@dataclass(frozen=True)
+class SlicePlan:
+    """An ordered gang of host assignments realizing one slice."""
+
+    topology: SliceTopology  #: the global slice, e.g. 4x4
+    hosts: Tuple[HostAssignment, ...]  #: ordered by process_id
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def coordinator_node(self) -> str:
+        return self.hosts[0].node
+
+    def assignment_for(self, node: str) -> Optional[HostAssignment]:
+        for h in self.hosts:
+            if h.node == node:
+                return h
+        return None
+
+    def coordination_env(
+        self, process_id: int, coordinator_ip: str, port: int = COORDINATOR_PORT
+    ) -> Dict[str, str]:
+        """Env for one engine child. The engine reads these (or the
+        equivalent CLI flags) and calls jax.distributed.initialize before
+        touching devices; initialize blocks until all processes join, so
+        per-member "serving" implies the whole gang formed."""
+        return {
+            "FMA_NUM_PROCESSES": str(self.num_processes),
+            "FMA_PROCESS_ID": str(process_id),
+            "FMA_COORDINATOR_ADDRESS": f"{coordinator_ip}:{port}",
+        }
+
+
+def plan_slice(
+    requested: "str | SliceTopology",
+    members: Mapping[str, Tuple[Sequence[int], HostTopology]],
+) -> SlicePlan:
+    """Plan a multi-host slice over `members`: node -> (origin, host).
+
+    `origin` is the host's corner in global slice coordinates (from the
+    chip-map's `origin:` line). Validates that the member hosts tile the
+    requested topology exactly — same host shape everywhere, origins
+    aligned to the host dims, dense cover, no overlap. Raises
+    SlicePlanError otherwise.
+    """
+    topo = (
+        SliceTopology.parse(requested)
+        if isinstance(requested, str)
+        else requested
+    )
+    if not members:
+        raise SlicePlanError("no member hosts offered")
+
+    # uniform host shape
+    shapes = {tuple(h.topology.dims) for _, h in members.values()}
+    if len(shapes) != 1:
+        raise SlicePlanError(f"member hosts have mixed shapes: {sorted(shapes)}")
+    host_dims = shapes.pop()
+    gdims = tuple(topo.dims)
+    if len(host_dims) != len(gdims):
+        raise SlicePlanError(
+            f"host topology {'x'.join(map(str, host_dims))} and slice "
+            f"topology {topo} have different ranks"
+        )
+    per_host = 1
+    for d in host_dims:
+        per_host *= d
+    if per_host * len(members) != topo.num_chips:
+        raise SlicePlanError(
+            f"{len(members)} hosts x {per_host} chips != slice {topo} "
+            f"({topo.num_chips} chips)"
+        )
+
+    # origins: aligned, in-bounds, unique, dense
+    seen: Dict[Tuple[int, ...], str] = {}
+    for node, (origin, _) in members.items():
+        o = tuple(int(x) for x in origin)
+        if len(o) != len(gdims):
+            raise SlicePlanError(f"{node}: origin {o} has wrong rank")
+        for ax, (ov, hd, gd) in enumerate(zip(o, host_dims, gdims)):
+            if ov % hd != 0:
+                raise SlicePlanError(
+                    f"{node}: origin axis {ax} = {ov} not aligned to host "
+                    f"dim {hd}"
+                )
+            if ov + hd > gd:
+                raise SlicePlanError(
+                    f"{node}: host at origin {o} exceeds slice {topo} on "
+                    f"axis {ax}"
+                )
+        if o in seen:
+            raise SlicePlanError(
+                f"{node} and {seen[o]} share slice origin {o}"
+            )
+        seen[o] = node
+
+    # process order: lexicographic by origin (process 0 = lowest corner)
+    ordered = sorted(members.items(), key=lambda kv: tuple(kv[1][0]))
+    if tuple(ordered[0][1][0]) != (0,) * len(gdims):
+        raise SlicePlanError(
+            f"no host at slice origin {(0,) * len(gdims)}; lowest is "
+            f"{tuple(ordered[0][1][0])}"
+        )
+
+    assignments = []
+    for pid, (node, (origin, host)) in enumerate(ordered):
+        chips = tuple(
+            c.chip_id for c in sorted(host.chips, key=lambda c: c.index)
+        )
+        if len(chips) != per_host:
+            raise SlicePlanError(
+                f"{node}: {len(chips)} chips mapped, host shape needs {per_host}"
+            )
+        assignments.append(
+            HostAssignment(
+                node=node,
+                process_id=pid,
+                origin=tuple(int(x) for x in origin),
+                chip_ids=chips,
+            )
+        )
+    return SlicePlan(topology=topo, hosts=tuple(assignments))
+
+
+def hosts_needed(requested: "str | SliceTopology", host: HostTopology) -> int:
+    """How many hosts of `host`'s shape a slice needs (1 = single-host)."""
+    topo = (
+        SliceTopology.parse(requested)
+        if isinstance(requested, str)
+        else requested
+    )
+    per_host = host.topology.num_chips
+    if per_host <= 0 or topo.num_chips % per_host != 0:
+        raise SlicePlanError(
+            f"slice {topo} not tileable by hosts of {host.topology}"
+        )
+    return topo.num_chips // per_host

@@ -210,11 +210,15 @@ class ChipMap:
     The TPU edition of the reference's `gpu-map` ConfigMap
     (controller.go:888-924): each node's value is lines of
     ``<index> <chip_id> <x,y[,z]> [topology]``. Parsed leniently; the
-    topology token (first line) records the host slice shape.
+    topology token (first line) records the host slice shape. An optional
+    ``origin: x,y[,z]`` line records the host's corner in the GLOBAL
+    coordinates of a multi-host slice (absent = single-host slice at the
+    origin) — the input `parallel/multihost.py` plans gangs from.
     """
 
     def __init__(self) -> None:
         self._hosts: Dict[str, HostTopology] = {}
+        self._origins: Dict[str, Tuple[int, ...]] = {}
 
     @classmethod
     def parse(cls, data: Dict[str, str]) -> "ChipMap":
@@ -222,12 +226,16 @@ class ChipMap:
         for node, text in data.items():
             chips: List[ChipInfo] = []
             topo: Optional[SliceTopology] = None
+            origin: Optional[Tuple[int, ...]] = None
             for line in text.strip().splitlines():
                 parts = line.split()
                 if not parts:
                     continue
                 if parts[0] == "topology:":
                     topo = SliceTopology.parse(parts[1])
+                    continue
+                if parts[0] == "origin:":
+                    origin = tuple(int(x) for x in parts[1].split(","))
                     continue
                 idx = int(parts[0])
                 cid = parts[1]
@@ -238,17 +246,35 @@ class ChipMap:
             if topo is None:
                 topo = SliceTopology.parse(str(max(1, len(chips))))
             cm._hosts[node] = HostTopology(topology=topo, chips=chips)
+            if origin is not None:
+                cm._origins[node] = origin
         return cm
 
     def dump(self) -> Dict[str, str]:
         out: Dict[str, str] = {}
         for node, host in self._hosts.items():
             lines = [f"topology: {host.topology}"]
+            if node in self._origins:
+                lines.append(
+                    "origin: " + ",".join(str(x) for x in self._origins[node])
+                )
             for c in sorted(host.chips, key=lambda c: c.index):
                 coord = ",".join(str(x) for x in c.coords)
                 lines.append(f"{c.index} {c.chip_id} {coord}")
             out[node] = "\n".join(lines)
         return out
+
+    def origin(self, node: str) -> Tuple[int, ...]:
+        """Host origin in global slice coords ((0,...) if unrecorded)."""
+        host = self._hosts.get(node)
+        o = self._origins.get(node)
+        if o is not None:
+            return o
+        ndim = len(host.topology.dims) if host is not None else 2
+        return (0,) * ndim
+
+    def set_origin(self, node: str, origin: Tuple[int, ...]) -> None:
+        self._origins[node] = tuple(origin)
 
     def host(self, node: str) -> Optional[HostTopology]:
         return self._hosts.get(node)

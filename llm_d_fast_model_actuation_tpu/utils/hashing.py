@@ -33,16 +33,30 @@ def sha256_hex(*parts: str) -> str:
     return h.hexdigest()
 
 
-def instance_id_for(engine_config: Any, chip_ids: Sequence[str]) -> str:
+def instance_id_for(
+    engine_config: Any,
+    chip_ids: Sequence[str],
+    extra_env: Any = None,
+) -> str:
     """Deterministic engine-instance ID from (config, chip set).
 
     Format "I<base64url(sha256)>i" — the reference's shape
     (inference-server.go:1030-1045); base64url keeps it label-safe.
     Chip order is normalized: the same chips in any order are the same
     instance.
+
+    `extra_env` (the slice-gang coordination env, which includes the unique
+    gang id) is hashed in when present: a process that joined one
+    jax.distributed gang can never serve another (initialize cannot re-run
+    in-process), so instances of different gangs must never be identified —
+    a sleeping member of a dead gang is left for reclaim, not woken.
+    `None` keeps single-host IDs identical to the pre-gang scheme.
     """
     cfg = engine_config.to_dict() if hasattr(engine_config, "to_dict") else engine_config
-    payload = canonical_json({"config": cfg, "chips": sorted(chip_ids)})
+    body = {"config": cfg, "chips": sorted(chip_ids)}
+    if extra_env:
+        body["gang_env"] = dict(extra_env)
+    payload = canonical_json(body)
     digest = hashlib.sha256(payload.encode()).digest()
     return "I" + base64.urlsafe_b64encode(digest).decode().rstrip("=") + "i"
 

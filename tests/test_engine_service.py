@@ -243,3 +243,47 @@ def test_chat_completions_roundtrip_and_stream(service):
         assert r.status == 400
 
     run_async(_client(service, scenario))
+
+
+def test_resolve_distributed_flags_and_env(monkeypatch):
+    from llm_d_fast_model_actuation_tpu.engine.server import resolve_distributed
+
+    # single-process default
+    args = parse_engine_options("--model tiny")
+    assert resolve_distributed(args) is None
+
+    # CLI flags
+    args = parse_engine_options(
+        "--model tiny --num-processes 2 --process-id 1 "
+        "--coordinator-address 10.0.0.1:8476"
+    )
+    assert resolve_distributed(args) == {
+        "coordinator_address": "10.0.0.1:8476",
+        "num_processes": 2,
+        "process_id": 1,
+    }
+
+    # gang env (what the slice-gang coordinator ships)
+    monkeypatch.setenv("FMA_NUM_PROCESSES", "4")
+    monkeypatch.setenv("FMA_PROCESS_ID", "3")
+    monkeypatch.setenv("FMA_COORDINATOR_ADDRESS", "10.0.0.2:8476")
+    args = parse_engine_options("--model tiny")
+    assert resolve_distributed(args) == {
+        "coordinator_address": "10.0.0.2:8476",
+        "num_processes": 4,
+        "process_id": 3,
+    }
+
+    # CLI beats env
+    args = parse_engine_options(
+        "--model tiny --num-processes 2 --process-id 0 "
+        "--coordinator-address 10.0.0.3:1"
+    )
+    assert resolve_distributed(args)["num_processes"] == 2
+
+    # incomplete coordination config is an error
+    monkeypatch.delenv("FMA_PROCESS_ID")
+    monkeypatch.delenv("FMA_COORDINATOR_ADDRESS")
+    args = parse_engine_options("--model tiny --num-processes 2")
+    with pytest.raises(ValueError):
+        resolve_distributed(args)

@@ -1,0 +1,388 @@
+"""Multi-host slice planning + the slice-gang coordinator.
+
+The engine-side jax.distributed plumbing is covered in
+test_engine_service.py (flag/env resolution); the full gang actuation over
+the dual-pods controller is in test_dualpods.py (gang hook) — here: the
+pure planner and the coordinator's group/stamp/degrade lifecycle against
+the in-memory store.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.api import constants as C
+from llm_d_fast_model_actuation_tpu.controller.gang import (
+    GANG_ANNOTATION,
+    GANG_ENV_ANNOTATION,
+    SliceGangCoordinator,
+    gang_env_of,
+)
+from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+from llm_d_fast_model_actuation_tpu.parallel.multihost import (
+    SlicePlanError,
+    hosts_needed,
+    plan_slice,
+)
+from llm_d_fast_model_actuation_tpu.parallel.topology import ChipMap, HostTopology
+
+NS = "ns1"
+
+
+def two_host_map():
+    """Two 2x4 hosts tiling a 4x4 slice: n1 at origin, n2 at (2,0)."""
+    cm = ChipMap()
+    cm.set_host("n1", HostTopology.make("2x4", node="n1"))
+    cm.set_host("n2", HostTopology.make("2x4", node="n2"))
+    cm.set_origin("n1", (0, 0))
+    cm.set_origin("n2", (2, 0))
+    return cm
+
+
+# ------------------------------------------------------------- the planner
+
+
+def _members(cm, nodes):
+    return {n: (cm.origin(n), cm.host(n)) for n in nodes}
+
+
+def test_plan_slice_orders_by_origin():
+    cm = two_host_map()
+    plan = plan_slice("4x4", _members(cm, ["n2", "n1"]))
+    assert plan.num_processes == 2
+    assert [h.node for h in plan.hosts] == ["n1", "n2"]  # lowest origin first
+    assert plan.coordinator_node == "n1"
+    assert plan.hosts[0].process_id == 0 and plan.hosts[1].process_id == 1
+    assert len(plan.hosts[0].chip_ids) == 8
+    env = plan.coordination_env(1, "10.0.0.1")
+    assert env["FMA_NUM_PROCESSES"] == "2"
+    assert env["FMA_PROCESS_ID"] == "1"
+    assert env["FMA_COORDINATOR_ADDRESS"].startswith("10.0.0.1:")
+
+
+def test_plan_slice_rejects_bad_tilings():
+    cm = two_host_map()
+    # wrong chip total
+    with pytest.raises(SlicePlanError):
+        plan_slice("2x4", _members(cm, ["n1", "n2"]))
+    # overlapping origins
+    cm2 = two_host_map()
+    cm2.set_origin("n2", (0, 0))
+    with pytest.raises(SlicePlanError):
+        plan_slice("4x4", _members(cm2, ["n1", "n2"]))
+    # unaligned origin
+    cm3 = two_host_map()
+    cm3.set_origin("n2", (1, 0))
+    with pytest.raises(SlicePlanError):
+        plan_slice("4x4", _members(cm3, ["n1", "n2"]))
+    # no host at the slice origin
+    cm4 = two_host_map()
+    cm4.set_origin("n1", (2, 0))
+    cm4.set_origin("n2", (4, 0))
+    with pytest.raises(SlicePlanError):
+        plan_slice("4x4", _members(cm4, ["n1", "n2"]))
+    # mixed host shapes
+    cm5 = two_host_map()
+    cm5.set_host("n2", HostTopology.make("1x4", node="n2"))
+    with pytest.raises(SlicePlanError):
+        plan_slice("4x4", _members(cm5, ["n1", "n2"]))
+
+
+def test_hosts_needed():
+    host = HostTopology.make("2x4")
+    assert hosts_needed("2x4", host) == 1
+    assert hosts_needed("4x4", host) == 2
+    assert hosts_needed("4x8", host) == 4
+    with pytest.raises(SlicePlanError):
+        hosts_needed("3x3", host)
+
+
+def test_chipmap_origin_roundtrip():
+    cm = two_host_map()
+    parsed = ChipMap.parse(cm.dump())
+    assert parsed.origin("n1") == (0, 0)
+    assert parsed.origin("n2") == (2, 0)
+    # absent origin defaults to the zero corner
+    cm2 = ChipMap()
+    cm2.set_host("n3", HostTopology.make("2x4", node="n3"))
+    assert ChipMap.parse(cm2.dump()).origin("n3") == (0, 0)
+
+
+# -------------------------------------------------------- gang coordinator
+
+
+def _isc(name="isc-mh", hosts=2, topology="4x4", chips=8):
+    return {
+        "kind": "InferenceServerConfig",
+        "metadata": {"name": name, "namespace": NS},
+        "spec": {
+            "modelServerConfig": {
+                "port": 8000,
+                "options": "--model tiny",
+                "accelerator": {
+                    "chips": chips,
+                    "topology": topology,
+                    "hosts": hosts,
+                },
+            },
+            "launcherConfigName": "lc1",
+        },
+    }
+
+
+def _requester(name, node, isc="isc-mh", chips=None, ip="127.0.0.1"):
+    ann = {C.INFERENCE_SERVER_CONFIG_ANNOTATION: isc}
+    if chips:
+        ann[C.ACCELERATORS_ANNOTATION] = ",".join(chips)
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": NS, "annotations": ann},
+        "spec": {"nodeName": node},
+        "status": {"podIP": ip},
+    }
+
+
+def _store_with_map():
+    store = InMemoryStore()
+    store.create(
+        {
+            "kind": "ConfigMap",
+            "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": NS},
+            "data": two_host_map().dump(),
+        }
+    )
+    return store
+
+
+async def _settle(coord, predicate, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError("condition never settled")
+
+
+def test_gang_forms_and_stamps_members():
+    store = _store_with_map()
+    cm = two_host_map()
+    store.create(_isc())
+    store.create(
+        _requester("req-1", "n1", chips=[c.chip_id for c in cm.host("n1").chips])
+    )
+    store.create(
+        _requester("req-2", "n2", chips=[c.chip_id for c in cm.host("n2").chips])
+    )
+
+    async def body():
+        coord = SliceGangCoordinator(store, NS)
+        await coord.start()
+        try:
+            def formed():
+                pods = [store.get("Pod", NS, n) for n in ("req-1", "req-2")]
+                return all(
+                    (p["metadata"].get("annotations") or {}).get(GANG_ANNOTATION)
+                    for p in pods
+                )
+
+            await _settle(coord, formed)
+        finally:
+            await coord.stop()
+
+    asyncio.run(body())
+    p1 = store.get("Pod", NS, "req-1")
+    p2 = store.get("Pod", NS, "req-2")
+    g1 = p1["metadata"]["annotations"][GANG_ANNOTATION]
+    assert g1 == p2["metadata"]["annotations"][GANG_ANNOTATION]
+    env1, env2 = gang_env_of(p1), gang_env_of(p2)
+    # n1 owns the slice origin -> process 0 and the coordinator address
+    assert env1["FMA_PROCESS_ID"] == "0"
+    assert env2["FMA_PROCESS_ID"] == "1"
+    assert env1["FMA_NUM_PROCESSES"] == env2["FMA_NUM_PROCESSES"] == "2"
+    assert env1["FMA_COORDINATOR_ADDRESS"] == env2["FMA_COORDINATOR_ADDRESS"]
+    assert env1["FMA_COORDINATOR_ADDRESS"].startswith("127.0.0.1:")
+    isc = store.get("InferenceServerConfig", NS, "isc-mh")
+    assert not (isc.get("status") or {}).get("gangErrors")
+
+
+def test_gang_waits_for_enough_members_then_degrades_on_loss():
+    store = _store_with_map()
+    cm = two_host_map()
+    store.create(_isc())
+    store.create(
+        _requester("req-1", "n1", chips=[c.chip_id for c in cm.host("n1").chips])
+    )
+
+    async def body():
+        coord = SliceGangCoordinator(store, NS)
+        await coord.start()
+        try:
+            await asyncio.sleep(0.3)
+            p1 = store.get("Pod", NS, "req-1")
+            assert GANG_ANNOTATION not in (
+                p1["metadata"].get("annotations") or {}
+            ), "no gang with 1/2 members"
+
+            # second member arrives -> gang forms
+            store.create(
+                _requester(
+                    "req-2", "n2",
+                    chips=[c.chip_id for c in cm.host("n2").chips],
+                )
+            )
+            await _settle(
+                coord,
+                lambda: gang_env_of(store.get("Pod", NS, "req-2")) is not None,
+            )
+
+            # member loss -> surviving member is relay-deleted
+            store.delete("Pod", NS, "req-1")
+            await _settle(
+                coord,
+                lambda: store.try_get("Pod", NS, "req-2") is None,
+            )
+        finally:
+            await coord.stop()
+
+    asyncio.run(body())
+
+
+def test_gang_reports_planning_errors_on_isc_status():
+    store = _store_with_map()
+    cm = two_host_map()
+    # topology that two 2x4 hosts cannot tile
+    store.create(_isc(topology="2x4"))
+    store.create(
+        _requester("req-1", "n1", chips=[c.chip_id for c in cm.host("n1").chips])
+    )
+    store.create(
+        _requester("req-2", "n2", chips=[c.chip_id for c in cm.host("n2").chips])
+    )
+
+    async def body():
+        coord = SliceGangCoordinator(store, NS)
+        await coord.start()
+        try:
+            await _settle(
+                coord,
+                lambda: (
+                    store.get("InferenceServerConfig", NS, "isc-mh").get("status")
+                    or {}
+                ).get("gangErrors"),
+            )
+        finally:
+            await coord.stop()
+
+    asyncio.run(body())
+    errs = store.get("InferenceServerConfig", NS, "isc-mh")["status"]["gangErrors"]
+    assert any("slice planning" in e for e in errs)
+
+
+def test_single_host_isc_ignored():
+    store = _store_with_map()
+    store.create(_isc(hosts=1, topology="2x4"))
+    store.create(_requester("req-1", "n1", chips=["tpu-n1-0-0"]))
+
+    async def body():
+        coord = SliceGangCoordinator(store, NS)
+        await coord.start()
+        await asyncio.sleep(0.3)
+        await coord.stop()
+
+    asyncio.run(body())
+    ann = store.get("Pod", NS, "req-1")["metadata"].get("annotations") or {}
+    assert GANG_ANNOTATION not in ann and GANG_ENV_ANNOTATION not in ann
+
+
+# ------------------------------------------- full actuation through dualpods
+
+
+def test_multihost_isc_actuates_gang_with_coordination_env():
+    """Dual-pods + gang coordinator on one store: a hosts=2 ISC actuates
+    two requester/provider pairs whose engine instance configs carry the
+    jax.distributed coordination env; instance creation is deferred until
+    the gang is stamped."""
+    from dualpods_harness import Harness, run_scenario
+
+    h = Harness(ns=NS)
+    cm = two_host_map()
+    h.store.create(
+        {
+            "kind": "ConfigMap",
+            "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": NS},
+            "data": cm.dump(),
+        }
+    )
+    h.add_lc("lc1", max_instances=2)
+    h.add_isc(
+        "isc-mh",
+        "lc1",
+        accelerator={"chips": 8, "topology": "4x4", "hosts": 2},
+    )
+
+    async def body():
+        coord = SliceGangCoordinator(h.store, NS)
+        await coord.start()
+        try:
+            h.add_requester(
+                "req-1", "isc-mh", node="n1",
+                chips=[c.chip_id for c in cm.host("n1").chips],
+            )
+            h.add_requester(
+                "req-2", "isc-mh", node="n2",
+                chips=[c.chip_id for c in cm.host("n2").chips],
+            )
+
+            def both_instances_created():
+                cfgs = [
+                    inst.config
+                    for fl in h.launchers.values()
+                    for inst in fl.instances.values()
+                ]
+                return len(cfgs) == 2
+
+            deadline = asyncio.get_running_loop().time() + 15
+            while asyncio.get_running_loop().time() < deadline:
+                if both_instances_created():
+                    break
+                await asyncio.sleep(0.1)
+            assert both_instances_created(), "gang never actuated"
+
+            envs = sorted(
+                (
+                    inst.config["env_vars"]["FMA_PROCESS_ID"],
+                    inst.config["env_vars"]["FMA_NUM_PROCESSES"],
+                    inst.config["env_vars"]["FMA_COORDINATOR_ADDRESS"],
+                )
+                for fl in h.launchers.values()
+                for inst in fl.instances.values()
+            )
+            assert [e[0] for e in envs] == ["0", "1"]
+            assert {e[1] for e in envs} == {"2"}
+            assert len({e[2] for e in envs}) == 1, "one coordinator address"
+        finally:
+            await coord.stop()
+
+    run_scenario(h, body)
+
+
+def test_gang_env_changes_instance_identity():
+    """A sleeping member of a dead gang must never be woken into a new gang
+    (jax.distributed.initialize cannot re-run in-process): the gang env —
+    which carries the unique gang id — is part of the instance identity."""
+    from llm_d_fast_model_actuation_tpu.api.types import EngineServerConfig
+    from llm_d_fast_model_actuation_tpu.utils.hashing import instance_id_for
+
+    esc = EngineServerConfig(port=8000, options="--model tiny")
+    chips = ["c1", "c0"]
+    base = instance_id_for(esc, chips)
+    env_g1 = {"FMA_GANG_ID": "g1", "FMA_PROCESS_ID": "0"}
+    env_g2 = {"FMA_GANG_ID": "g2", "FMA_PROCESS_ID": "0"}
+    assert instance_id_for(esc, chips, extra_env=env_g1) != base
+    assert instance_id_for(esc, chips, extra_env=env_g1) != instance_id_for(
+        esc, chips, extra_env=env_g2
+    )
+    # single-host IDs are unchanged by the new parameter (wake fast path
+    # across controller versions)
+    assert instance_id_for(esc, chips, extra_env=None) == base
