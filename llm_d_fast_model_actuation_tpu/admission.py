@@ -102,14 +102,25 @@ def validate_isc(obj: Dict[str, Any]) -> List[str]:
     chips = acc.get("chips", 1)
     if not isinstance(chips, int) or chips < 1:
         errors.append("spec.modelServerConfig.accelerator.chips must be >= 1")
+    hosts = acc.get("hosts", 1)
+    if not isinstance(hosts, int) or hosts < 1:
+        errors.append("spec.modelServerConfig.accelerator.hosts must be >= 1")
+        hosts = 1
+    if hosts > 1 and not acc.get("topology"):
+        errors.append(
+            "accelerator.hosts > 1 requires accelerator.topology (the "
+            "global slice shape)"
+        )
     topo = acc.get("topology", "")
     if topo:
         try:
             parsed = SliceTopology.parse(topo)
-            if isinstance(chips, int) and chips >= 1 and parsed.num_chips != chips:
+            # chips is per host; the topology is global (chips x hosts)
+            want = chips * hosts if isinstance(chips, int) and chips >= 1 else None
+            if want is not None and parsed.num_chips != want:
                 errors.append(
-                    f"accelerator.topology {topo} has {parsed.num_chips} chips "
-                    f"but accelerator.chips is {chips}"
+                    f"accelerator.topology {topo} has {parsed.num_chips} "
+                    f"chips but accelerator.chips x hosts is {want}"
                 )
         except ValueError as e:
             errors.append(f"accelerator.topology: {e}")

@@ -1377,7 +1377,16 @@ class DualPodsController:
             chips = cfg.get("gpu_uuids", [])
         except json.JSONDecodeError:
             return True
-        return instance_id_for(isc.spec.engine_server_config, chips) != instance_id
+        from .gang import gang_env_from_instance_env
+
+        return (
+            instance_id_for(
+                isc.spec.engine_server_config,
+                chips,
+                extra_env=gang_env_from_instance_env(cfg.get("env_vars")),
+            )
+            != instance_id
+        )
 
     # --------------------------------------------------------------- sweeping
 
@@ -1439,10 +1448,19 @@ class DualPodsController:
                     continue
                 obsolete = True
                 if isc_obj is not None:
+                    from .gang import gang_env_from_instance_env
+
                     isc = InferenceServerConfig.from_dict(isc_obj)
                     chips = st.get("gpu_uuids") or []
                     obsolete = (
-                        instance_id_for(isc.spec.engine_server_config, chips) != iid
+                        instance_id_for(
+                            isc.spec.engine_server_config,
+                            chips,
+                            extra_env=gang_env_from_instance_env(
+                                st.get("env_vars")
+                            ),
+                        )
+                        != iid
                     )
                 if obsolete:
                     try:

@@ -57,6 +57,37 @@ GANG_ANNOTATION = "dual-pods.llm-d.ai/slice-gang"
 GANG_ENV_ANNOTATION = "dual-pods.llm-d.ai/slice-gang-env"
 
 
+#: Exactly the env keys the coordinator stamps (coordination_env + gang id).
+GANG_ENV_KEYS = (
+    "FMA_NUM_PROCESSES",
+    "FMA_PROCESS_ID",
+    "FMA_COORDINATOR_ADDRESS",
+    "FMA_GANG_ID",
+)
+
+
+def gang_env_from_instance_env(
+    env_vars: Optional[Dict[str, Any]],
+) -> Optional[Dict[str, str]]:
+    """Recover the gang env from a committed engine-instance config's
+    env_vars. Obsolescence checks recompute the instance identity
+    (utils/hashing.instance_id_for) and must hash the SAME extra_env the
+    creation path used, else every gang instance would self-mismatch.
+
+    FMA_GANG_ID is the discriminator: the coordinator always stamps it,
+    while an operator hand-wiring coordination env into a single-host
+    ISC's env_vars (resolve_distributed reads those too) never does —
+    without it the keys are ISC-authored env, hashed as part of the spec
+    already, and returning them here would make a healthy single-host
+    instance permanently self-mismatch."""
+    env_vars = env_vars or {}
+    if "FMA_GANG_ID" not in env_vars:
+        return None
+    return {
+        str(k): str(v) for k, v in env_vars.items() if k in GANG_ENV_KEYS
+    }
+
+
 def gang_env_of(pod: Dict[str, Any]) -> Optional[Dict[str, str]]:
     """The member coordination env stamped on a requester, if any."""
     ann = (pod.get("metadata") or {}).get("annotations") or {}
@@ -245,25 +276,32 @@ class SliceGangCoordinator:
             )
             return
 
-        # Select by slice origin, not node-name order: one host per origin
-        # cell (alphabetical tie-break), lexicographic origins starting at
-        # the zero corner — extra candidates (e.g. hosts of another slice)
-        # must not poison the selection.
-        by_origin: Dict[Tuple[int, ...], str] = {}
+        # Select within ONE physical slice (hosts of different slices share
+        # origin coordinates but no ICI — a gang must never span slice
+        # ids), then by slice origin: one host per origin cell
+        # (alphabetical tie-break), lexicographic origins starting at the
+        # zero corner. Extra candidates — hosts of another slice, unmapped
+        # nodes — must not poison the selection.
+        by_slice: Dict[str, Dict[Tuple[int, ...], str]] = {}
         for node in sorted(by_node):
             if chip_map.host(node) is None:
                 continue  # unmapped node can't be planned; skip
-            by_origin.setdefault(tuple(chip_map.origin(node)), node)
-        origins = sorted(by_origin)
-        if len(origins) < hosts_needed or not origins or any(
-            o != 0 for o in origins[0]
-        ):
+            by_slice.setdefault(chip_map.slice_id(node), {}).setdefault(
+                tuple(chip_map.origin(node)), node
+            )
+        chosen: Dict[str, Dict[str, Any]] = {}
+        for _, by_origin in sorted(by_slice.items()):
+            origins = sorted(by_origin)
+            if len(origins) < hosts_needed or any(o != 0 for o in origins[0]):
+                continue  # this slice can't field a gang yet
+            chosen = {
+                by_origin[o]: by_node[by_origin[o]]
+                for o in origins[:hosts_needed]
+            }
+            break
+        if not chosen:
             await self._set_status(isc_name, [])  # waiting, not an error
             return
-        chosen = {
-            by_origin[o]: by_node[by_origin[o]]
-            for o in origins[:hosts_needed]
-        }
 
         plan_input: Dict[str, Tuple[Tuple[int, ...], HostTopology]] = {}
         for node, pod in chosen.items():
@@ -303,8 +341,12 @@ class SliceGangCoordinator:
         # Per-gang coordinator port: a degraded gang's process-0 engine may
         # still be alive (asleep) holding the old port on hostNetwork; a
         # fixed port would make the next gang's bind fail. Derived from the
-        # gang id so all members agree without another round-trip.
-        port = self.port + int(gid[1:], 16) % 512
+        # gang id so all members agree without another round-trip. A
+        # residual collision (1/4096) self-heals through the crash relay:
+        # the bind-failed engine goes STOPPED -> notifier -> controller
+        # deletes the requester -> this gang degrades -> the re-formed gang
+        # draws a fresh gid and port.
+        port = self.port + int(gid[1:], 16) % 4096
         for node, pod in chosen.items():
             assignment = plan.assignment_for(node)
             env = plan.coordination_env(assignment.process_id, coord_ip, port)

@@ -30,6 +30,7 @@ import json
 import logging
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -155,11 +156,25 @@ class KubeStore:
                 **({"Authorization": f"Bearer {bearer}"} if bearer else {}),
             },
         )
+        # Per-call latency logging discipline: every kube write logs its
+        # start time, latency, and the new resourceVersion — the reference
+        # does this on every write path (e.g. inference-server.go:1448-1459)
+        # and its benchmark log-parsing relies on it.
+        start = time.monotonic()
         try:
             with urllib.request.urlopen(
                 req, timeout=self._timeout, context=self._ssl
             ) as resp:
-                return json.loads(resp.read() or b"{}")
+                out = json.loads(resp.read() or b"{}")
+                if method != "GET" and logger.isEnabledFor(logging.DEBUG):
+                    logger.debug(
+                        "k8s %s %s latencySecs=%.4f rv=%s",
+                        method,
+                        path,
+                        time.monotonic() - start,
+                        (out.get("metadata") or {}).get("resourceVersion", ""),
+                    )
+                return out
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")[:500]
             if e.code == 404:
@@ -398,6 +413,27 @@ class KubeStore:
             self._emit(event, updated)
         return copy.deepcopy(updated)
 
+    #: CRD kinds installed with a status subresource (deploy/crds/*.yaml):
+    #: the apiserver STRIPS .status from main-resource writes for these, so
+    #: status changes must go to the /status subresource path.
+    STATUS_SUBRESOURCE_KINDS = frozenset(
+        {"InferenceServerConfig", "LauncherConfig", "LauncherPopulationPolicy"}
+    )
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """PUT the /status subresource (spec/metadata changes are ignored
+        by the server on this path, mirroring kube semantics)."""
+        kind = obj.get("kind") or ""
+        name = obj["metadata"]["name"]
+        ns = obj["metadata"].get("namespace") or None
+        updated = self._request(
+            "PUT", self._object_path(kind, name, ns) + "/status", obj
+        )
+        updated.setdefault("kind", kind)
+        if self._apply(MODIFIED, updated):
+            self._emit(MODIFIED, updated)
+        return copy.deepcopy(updated)
+
     def mutate(
         self,
         kind: str,
@@ -414,6 +450,20 @@ class KubeStore:
             if new is None:
                 return cur
             try:
+                if kind in self.STATUS_SUBRESOURCE_KINDS:
+                    # split the write the way the apiserver demands: the
+                    # main PUT drops .status, the /status PUT drops the rest
+                    def strip(o):
+                        return {k: v for k, v in o.items() if k != "status"}
+
+                    out = new
+                    if strip(new) != strip(cur):
+                        out = self.update(new)
+                    if new.get("status") != cur.get("status"):
+                        merged = copy.deepcopy(out)
+                        merged["status"] = new.get("status")
+                        out = self.update_status(merged)
+                    return out
                 return self.update(new)
             except Conflict:
                 continue

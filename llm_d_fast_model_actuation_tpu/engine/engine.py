@@ -160,6 +160,11 @@ class InferenceEngine:
         )  # uint32 key data; device-resident after first upload
         self._dev: Optional[Dict[str, Any]] = None  # device scheduler arrays
         self._dirty = True
+        #: Multi-host lockstep (engine/multihost.py): the gang leader's
+        #: engine broadcasts a control frame before every compiled dispatch
+        #: so follower processes replay the identical program. None when
+        #: single-host or follower.
+        self.lockstep: Optional[Any] = None
 
         model_cfg = m
         self._model_cfg = m
@@ -325,6 +330,8 @@ class InferenceEngine:
         seq_lens = np.array([n], dtype=np.int32)
         table = self._page_table[req.slot : req.slot + 1]
         temp = np.asarray([req.temperature], dtype=np.float32)
+        if self.lockstep is not None:
+            self.lockstep.prefill(req, bucket)
         tok, cache, self._raw_key = self._prefill_fn(
             self.params,
             tokens,
@@ -398,7 +405,10 @@ class InferenceEngine:
             # state always has >= decode_chunk tokens of demand. The drain
             # tail of a batch run falls back to single steps.
             T = self.cfg.decode_chunk if max_remaining >= self.cfg.decode_chunk else 1
-            if self._dirty or self._dev is None:
+            reupload = self._dirty or self._dev is None
+            if self.lockstep is not None:
+                self.lockstep.chunk(T, reupload)
+            if reupload:
                 self._upload_sched()
             d = self._dev
             toks_dev, lt, pos, budget, cache, self._raw_key = self._chunk_fn(T)(

@@ -1,0 +1,203 @@
+"""Multi-host serving data plane: leader/follower lockstep stepping.
+
+A multi-host engine is ONE SPMD job: every gang process must execute the
+same compiled programs in the same order with the same host-side inputs,
+or the collectives inside them deadlock. The control plane
+(controller/gang.py) forms the gang and `jax.distributed.initialize`
+joins it; this module keeps the gang in lockstep while SERVING:
+
+  * process 0 (the **leader**) runs the normal engine loop and the HTTP
+    API. Before every compiled call it broadcasts a fixed-shape control
+    frame — call kind, static args (prefill bucket / chunk length), and
+    the host scheduler mirrors — via
+    `jax.experimental.multihost_utils.broadcast_one_to_all` (itself a
+    collective, so followers block until the leader has work);
+  * processes 1..N-1 (**followers**) run `follower_loop`: receive a
+    frame, replay the identical compiled call on their local shards, and
+    keep their device state (KV pool, scheduler arrays, RNG key) in
+    lockstep. Followers never sync tokens to host — the leader alone
+    talks to clients.
+
+Determinism argument: both sides start from the same seed (the gang's
+ISC options are identical), every compiled call is the same program with
+the same inputs, and scheduler edges (admission, retirement) exist only
+on the leader — followers import their effects through the broadcast
+mirrors. vLLM's multi-host TPU serving solves this with an RPC executor
+broadcasting scheduler output per step; the lockstep frame is the
+XLA-native equivalent (one small collective per compiled dispatch).
+
+The frame is FIXED SHAPE for a given engine config, so the broadcast
+compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KIND_IDLE = 0
+KIND_PREFILL = 1
+KIND_CHUNK = 2
+KIND_SLEEP = 3
+KIND_WAKE = 4
+KIND_SHUTDOWN = 5
+
+
+def _frame_template(cfg) -> Dict[str, np.ndarray]:
+    b, p = cfg.max_batch, cfg.pages_per_seq
+    return {
+        "kind": np.zeros((), np.int32),
+        #: prefill bucket | chunk T | sleep level
+        "arg": np.zeros((), np.int32),
+        #: prefill slot | sleep release flag
+        "arg2": np.zeros((), np.int32),
+        "seq_len": np.zeros((), np.int32),
+        "temp": np.zeros((), np.float32),
+        "tokens": np.zeros((cfg.seq_len,), np.int32),
+        #: chunk: rebuild device scheduler state from the mirrors below
+        "reupload": np.zeros((), np.int32),
+        "lt": np.zeros((b,), np.int32),
+        "pos": np.zeros((b,), np.int32),
+        "budget": np.zeros((b,), np.int32),
+        "temps": np.zeros((b,), np.float32),
+        "page_table": np.zeros((b, p), np.int32),
+    }
+
+
+def _broadcast(frame: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(frame)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+class LockstepLeader:
+    """Installed on the leader's engine as `engine.lockstep`; the engine
+    calls these hooks immediately before its compiled dispatches."""
+
+    def __init__(self, engine: Any) -> None:
+        self.engine = engine
+        self._template = _frame_template(engine.cfg)
+
+    def _mirrors(self, f: Dict[str, np.ndarray]) -> None:
+        e = self.engine
+        f["lt"] = e._last_tokens.copy()
+        f["pos"] = e._positions.copy()
+        f["budget"] = e._budgets.copy()
+        f["temps"] = e._temps.copy()
+        f["page_table"] = e._page_table.copy()
+
+    def _send(self, **fields: Any) -> None:
+        f = dict(self._template)
+        self._mirrors(f)
+        for k, v in fields.items():
+            f[k] = np.asarray(v, f[k].dtype)
+        _broadcast(f)
+
+    # -- hooks ---------------------------------------------------------------
+
+    def prefill(self, req: Any, bucket: int) -> None:
+        tokens = np.zeros((self.engine.cfg.seq_len,), np.int32)
+        tokens[: len(req.prompt)] = req.prompt
+        self._send(
+            kind=KIND_PREFILL,
+            arg=bucket,
+            arg2=req.slot,
+            seq_len=len(req.prompt),
+            temp=req.temperature,
+            tokens=tokens,
+        )
+
+    def chunk(self, T: int, reupload: bool) -> None:
+        self._send(kind=KIND_CHUNK, arg=T, reupload=int(reupload))
+
+    def sleep(self, level: int, release: bool) -> None:
+        self._send(kind=KIND_SLEEP, arg=level, arg2=int(release))
+
+    def wake(self) -> None:
+        self._send(kind=KIND_WAKE)
+
+    def shutdown(self) -> None:
+        self._send(kind=KIND_SHUTDOWN)
+
+
+def follower_loop(engine: Any, sleeper: Optional[Any] = None) -> None:
+    """Run a follower process until the leader broadcasts SHUTDOWN.
+
+    `engine` must be constructed identically to the leader's (same config,
+    same seed, same mesh plan) — the gang ships identical ISC options to
+    every member, so this holds by construction.
+    """
+    template = _frame_template(engine.cfg)
+    while True:
+        f = _broadcast(template)
+        kind = int(f["kind"])
+        if kind == KIND_SHUTDOWN:
+            logger.info("follower: leader shut down")
+            return
+        if kind == KIND_PREFILL:
+            _replay_prefill(engine, f)
+        elif kind == KIND_CHUNK:
+            _replay_chunk(engine, f)
+        elif kind == KIND_SLEEP and sleeper is not None:
+            sleeper.sleep(int(f["arg"]), release=bool(int(f["arg2"])))
+        elif kind == KIND_WAKE and sleeper is not None:
+            sleeper.wake_up()
+
+
+def _sync_mirrors(engine: Any, f: Dict[str, np.ndarray]) -> None:
+    engine._last_tokens[:] = f["lt"]
+    engine._positions[:] = f["pos"]
+    engine._budgets[:] = f["budget"]
+    engine._temps[:] = f["temps"]
+    engine._page_table[:] = f["page_table"]
+
+
+def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
+    bucket = int(f["arg"])
+    slot = int(f["arg2"])
+    n = int(f["seq_len"])
+    _sync_mirrors(engine, f)
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, :] = f["tokens"][:bucket]
+    seq_lens = np.array([n], np.int32)
+    table = engine._page_table[slot : slot + 1]
+    temp = np.asarray([float(f["temp"])], np.float32)
+    _tok, cache, engine._raw_key = engine._prefill_fn(
+        engine.params,
+        tokens,
+        seq_lens,
+        engine.pool.as_tuple(),
+        table,
+        temp,
+        engine._raw_key,
+    )
+    engine.pool.replace(cache)
+    # no host sync: the leader alone consumes tokens
+
+
+def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
+    T = int(f["arg"])
+    if int(f["reupload"]) or engine._dev is None:
+        _sync_mirrors(engine, f)
+        engine._upload_sched()
+    d = engine._dev
+    _toks, lt, pos, budget, cache, engine._raw_key = engine._chunk_fn(T)(
+        engine.params,
+        d["lt"],
+        d["pos"],
+        d["budget"],
+        engine.pool.as_tuple(),
+        d["pt"],
+        d["temps"],
+        engine._raw_key,
+    )
+    engine.pool.replace(cache)
+    engine._dev = {
+        "lt": lt, "pos": pos, "budget": budget,
+        "pt": d["pt"], "temps": d["temps"],
+    }

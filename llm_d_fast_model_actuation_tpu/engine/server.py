@@ -267,9 +267,26 @@ class EngineService:
             mode == "always"
             or (mode == "auto" and jax.default_backend() == "tpu")
         )
+        if dist is not None:
+            # gang sleep is offload-only: device release would require
+            # every process to drop and re-join the distributed client in
+            # lockstep (engine/sleep.py raises on it)
+            self.release_on_sleep = False
+        # Multi-host lockstep roles (engine/multihost.py): process 0 leads
+        # (serves + broadcasts control frames); others follow (replay).
+        self.process_id = dist["process_id"] if dist else 0
+        self.is_follower = dist is not None and self.process_id > 0
+        if dist is not None and not self.is_follower:
+            from .multihost import LockstepLeader
+
+            self.engine.lockstep = LockstepLeader(self.engine)
         self._publisher = self._make_publisher()
         self._publish_usage()
-        self._thread = threading.Thread(target=self._run, daemon=True, name="engine-loop")
+        self._thread = threading.Thread(
+            target=self._run_follower if self.is_follower else self._run,
+            daemon=True,
+            name="engine-loop",
+        )
         self._thread.start()
 
     def _make_publisher(self):
@@ -349,6 +366,17 @@ class EngineService:
             self._new_work.wait(timeout=0.05)
             self._new_work.clear()
 
+    def _run_follower(self) -> None:
+        """Gang follower: replay the leader's compiled calls until it
+        shuts down. Exceptions fail /health so the crash relay heals us."""
+        from .multihost import follower_loop
+
+        try:
+            follower_loop(self.engine, self.sleeper)
+        except Exception as e:
+            logger.exception("follower loop failed")
+            self.failure = f"{type(e).__name__}: {e}"
+
     def _fail_all(self, exc: Exception) -> None:
         for _, _, _, fut, _ in self._pending:
             if not fut.done():
@@ -379,6 +407,14 @@ class EngineService:
         engine thread for every emitted token (the streaming hook); keep it
         to an enqueue."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
+        if self.is_follower:
+            fut.set_exception(
+                RuntimeError(
+                    "multi-host gang follower: requests are served by the "
+                    "gang leader (process 0)"
+                )
+            )
+            return fut
         if self.failure is not None:
             fut.set_exception(RuntimeError(self.failure))
             return fut
@@ -395,13 +431,39 @@ class EngineService:
         self._new_work.set()
 
     def sleep(self, level: int) -> Dict[str, Any]:
+        if self.is_follower:
+            # a follower can't unilaterally leave the collective loop; the
+            # leader's broadcast sleeps the whole gang
+            return {
+                "deferred": True,
+                "reason": "gang follower; sleep is driven by the leader",
+            }
+        if level not in (1, 2):
+            # validate BEFORE any broadcast: a bad level must 400 locally,
+            # never reach followers (their replay would raise and kill the
+            # follower loop, deadlocking the gang's next collective)
+            raise ValueError("sleep level must be 1 or 2")
         with self._lock:
+            if self.engine.lockstep is not None:
+                if level >= 2:
+                    raise ValueError(
+                        "level-2 sleep is not supported for multi-host "
+                        "gangs (followers cannot replay the reinit)"
+                    )
+                self.engine.lockstep.sleep(level, self.release_on_sleep)
             out = self.sleeper.sleep(level, release=self.release_on_sleep)
         self._publish_usage()
         return out
 
     def wake_up(self) -> Dict[str, Any]:
+        if self.is_follower:
+            return {
+                "deferred": True,
+                "reason": "gang follower; wake is driven by the leader",
+            }
         with self._lock:
+            if self.engine.lockstep is not None and self.sleeper.is_sleeping:
+                self.engine.lockstep.wake()
             if self.sleeper.level == 2:
                 # KV state is gone: abort anything mid-generation before the
                 # fresh state arrives, then rebuild params+pool in place.
@@ -466,7 +528,19 @@ class EngineService:
     def shutdown(self) -> None:
         self._stop = True
         self._new_work.set()
-        self._thread.join(timeout=5)
+        if not self.is_follower:
+            # follower threads block inside the broadcast collective and
+            # exit with the process (daemon); only the leader's loop joins
+            self._thread.join(timeout=5)
+        if self.engine.lockstep is not None:
+            try:
+                # under the lock: if the engine thread outlived the join
+                # timeout (long compile mid-step), its frame broadcasts must
+                # not interleave with the shutdown frame
+                with self._lock:
+                    self.engine.lockstep.shutdown()
+            except Exception:
+                logger.warning("lockstep shutdown broadcast failed", exc_info=True)
         if self._publisher is not None:
             self._publisher.clear()
 

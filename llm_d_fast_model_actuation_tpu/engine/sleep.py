@@ -96,6 +96,12 @@ class SleepManager:
         self._host_state: Optional[Any] = None
         self._shardings: Optional[Any] = None  # sharding objects (no release)
         self._sharding_specs: Optional[Any] = None  # device-free (release)
+        #: multi-process offload: per-leaf [(device, np shard), ...] — a
+        #: cross-process array is not fully addressable, so each gang
+        #: process stages exactly its own shards
+        self._staged: Optional[list] = None
+        self._staged_meta: Optional[list] = None  # per-leaf (shape, sharding)
+        self._treedef: Optional[Any] = None
         self._released = False
         self._use_memory_kind = _platform_supports_host_memory()
         self.stats = _Stats()
@@ -118,6 +124,12 @@ class SleepManager:
         level = SleepLevel(level)
         if level == SleepLevel.AWAKE:
             raise ValueError("sleep level must be 1 or 2")
+        if release and jax.process_count() > 1:
+            raise ValueError(
+                "device release is not supported for multi-host gangs: "
+                "every process would have to drop and re-join the "
+                "distributed client in lockstep"
+            )
         if self._level != SleepLevel.AWAKE:
             if level == SleepLevel.L2_DISCARD and self._level == SleepLevel.L1_HOST_OFFLOAD:
                 # Escalate 1 -> 2: give the host RAM back too.
@@ -125,6 +137,7 @@ class SleepManager:
                     for leaf in jax.tree.leaves(self._host_state):
                         leaf.delete()
                 self._host_state = None
+                self._staged = None
                 self._level = SleepLevel.L2_DISCARD
                 self.stats.bytes_offloaded = 0
             return self.describe()
@@ -143,6 +156,23 @@ class SleepManager:
                 self._host_state = jax.tree.map(np.asarray, state)
             else:
                 self._host_state = None
+        elif jax.process_count() > 1:
+            # Multi-host gang: every process sleeps in lockstep
+            # (engine/multihost.py broadcasts the sleep), each staging its
+            # OWN shards — the array is not fully addressable, so neither
+            # the memory-kind transfer nor np.asarray of the whole can run.
+            self._shardings = None
+            self._sharding_specs = None
+            if level == SleepLevel.L1_HOST_OFFLOAD:
+                leaves, self._treedef = jax.tree.flatten(state)
+                self._staged = [
+                    [(s.device, np.asarray(s.data)) for s in x.addressable_shards]
+                    for x in leaves
+                ]
+                self._staged_meta = [(x.shape, x.sharding) for x in leaves]
+            else:
+                self._staged = None
+            self._host_state = None
         else:
             self._shardings = jax.tree.map(lambda x: x.sharding, state)
             self._sharding_specs = None
@@ -186,7 +216,23 @@ class SleepManager:
             self.stats.last_reacquire_seconds = time.monotonic() - t0
             if self._on_reacquire is not None:
                 self._on_reacquire()
-        if self._level == SleepLevel.L1_HOST_OFFLOAD:
+        if self._level == SleepLevel.L1_HOST_OFFLOAD and self._staged is not None:
+            # multi-process restore: reassemble each global array from this
+            # process's staged shards (every gang process does the same)
+            from jax import make_array_from_single_device_arrays
+
+            restored = []
+            for (shape, sharding), shards in zip(self._staged_meta, self._staged):
+                arrs = [jax.device_put(buf, d) for d, buf in shards]
+                restored.append(
+                    make_array_from_single_device_arrays(shape, sharding, arrs)
+                )
+            state = jax.tree.unflatten(self._treedef, restored)
+            state = jax.block_until_ready(state)
+            self._staged = None
+            self._staged_meta = None
+            self._treedef = None
+        elif self._level == SleepLevel.L1_HOST_OFFLOAD:
             assert self._host_state is not None
             if self._released:
                 assert self._sharding_specs is not None

@@ -210,15 +210,18 @@ class ChipMap:
     The TPU edition of the reference's `gpu-map` ConfigMap
     (controller.go:888-924): each node's value is lines of
     ``<index> <chip_id> <x,y[,z]> [topology]``. Parsed leniently; the
-    topology token (first line) records the host slice shape. An optional
-    ``origin: x,y[,z]`` line records the host's corner in the GLOBAL
-    coordinates of a multi-host slice (absent = single-host slice at the
-    origin) — the input `parallel/multihost.py` plans gangs from.
+    topology token (first line) records the host slice shape. Two optional
+    lines support multi-host slices (`parallel/multihost.py`):
+    ``origin: x,y[,z]`` — the host's corner in the GLOBAL coordinates of
+    its slice (absent = the zero corner); ``slice: <id>`` — which physical
+    slice the host belongs to (hosts of different slices share origin
+    coordinates but no ICI, so a gang must never span slice ids).
     """
 
     def __init__(self) -> None:
         self._hosts: Dict[str, HostTopology] = {}
         self._origins: Dict[str, Tuple[int, ...]] = {}
+        self._slices: Dict[str, str] = {}
 
     @classmethod
     def parse(cls, data: Dict[str, str]) -> "ChipMap":
@@ -236,6 +239,9 @@ class ChipMap:
                     continue
                 if parts[0] == "origin:":
                     origin = tuple(int(x) for x in parts[1].split(","))
+                    continue
+                if parts[0] == "slice:":
+                    cm._slices[node] = parts[1]
                     continue
                 idx = int(parts[0])
                 cid = parts[1]
@@ -258,6 +264,8 @@ class ChipMap:
                 lines.append(
                     "origin: " + ",".join(str(x) for x in self._origins[node])
                 )
+            if node in self._slices:
+                lines.append(f"slice: {self._slices[node]}")
             for c in sorted(host.chips, key=lambda c: c.index):
                 coord = ",".join(str(x) for x in c.coords)
                 lines.append(f"{c.index} {c.chip_id} {coord}")
@@ -275,6 +283,14 @@ class ChipMap:
 
     def set_origin(self, node: str, origin: Tuple[int, ...]) -> None:
         self._origins[node] = tuple(origin)
+
+    def slice_id(self, node: str) -> str:
+        """Physical-slice identity ("" if unrecorded: clusters with a single
+        multi-host slice can omit it)."""
+        return self._slices.get(node, "")
+
+    def set_slice_id(self, node: str, slice_id: str) -> None:
+        self._slices[node] = slice_id
 
     def host(self, node: str) -> Optional[HostTopology]:
         return self._hosts.get(node)

@@ -15,7 +15,10 @@ from typing import Any, Dict, Optional, Tuple
 
 from aiohttp import web
 
-from llm_d_fast_model_actuation_tpu.controller.kubestore import KIND_PATHS
+from llm_d_fast_model_actuation_tpu.controller.kubestore import (
+    KIND_PATHS,
+    KubeStore,
+)
 from llm_d_fast_model_actuation_tpu.controller.store import (
     AlreadyExists,
     Conflict,
@@ -71,11 +74,26 @@ class FakeApiServer:
 
     # -- handlers (run on the server thread's loop) ---------------------------
 
+    #: kinds whose CRDs declare a status subresource (deploy/crds/*.yaml):
+    #: main-resource writes STRIP .status; only PUT <path>/status changes
+    #: it. Shared with the client so the fake can't drift from the split-
+    #: write logic it exists to exercise.
+    STATUS_SUBRESOURCE_KINDS = KubeStore.STATUS_SUBRESOURCE_KINDS
+
     async def _handle(self, request: web.Request) -> web.StreamResponse:
-        parsed = _parse(request.path)
+        path = request.path
+        subresource = ""
+        if path.endswith("/status"):
+            path, subresource = path[: -len("/status")], "status"
+        parsed = _parse(path)
         if parsed is None:
             return web.json_response({"kind": "Status", "message": "not found"}, status=404)
         kind, ns, name = parsed
+        if subresource and kind not in self.STATUS_SUBRESOURCE_KINDS:
+            return web.json_response(
+                {"kind": "Status", "message": f"no status subresource for {kind}"},
+                status=404,
+            )
         try:
             if request.method == "GET" and name is None:
                 if request.query.get("watch") == "1":
@@ -108,6 +126,16 @@ class FakeApiServer:
             if request.method == "PUT":
                 obj = await request.json()
                 obj.setdefault("kind", kind)
+                if kind in self.STATUS_SUBRESOURCE_KINDS:
+                    cur = self.store.get(kind, ns, name)
+                    if subresource == "status":
+                        # status PUT: only .status lands
+                        merged = dict(cur)
+                        merged["status"] = obj.get("status")
+                        merged["metadata"] = obj.get("metadata", cur["metadata"])
+                        return web.json_response(self.store.update(merged))
+                    # main PUT: .status is stripped (kube semantics)
+                    obj["status"] = cur.get("status")
                 return web.json_response(self.store.update(obj))
             if request.method == "DELETE":
                 body: Dict[str, Any] = {}

@@ -1,0 +1,62 @@
+"""Worker for the 2-process multi-host serving e2e (test_multihost_e2e.py).
+
+Usage: gang_worker.py <process_id> <num_processes> <coordinator_port>
+
+Both processes build the SAME EngineService config (as a real gang would:
+identical ISC options); process 0 leads and drives generations + a
+sleep/wake cycle, the follower replays broadcast frames. The leader prints
+result lines the test asserts on.
+"""
+
+import sys
+import time
+
+
+def main() -> None:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    args = parse_engine_options(
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --tensor-parallel-size 2 --decode-chunk 4 "
+        f"--num-processes {n} --process-id {pid} "
+        f"--coordinator-address 127.0.0.1:{port}"
+    )
+    svc = EngineService(args)
+    print(f"READY {pid}", flush=True)
+
+    if pid == 0:
+        prompt = [5, 6, 7]
+        out1 = svc.submit(prompt, 6, 0.0).result(timeout=120)
+        print("OUT1", ",".join(map(str, out1.out_tokens)), flush=True)
+        # a second batched round exercises chunk reupload edges
+        f1 = svc.submit([1, 2], 5, 0.0)
+        f2 = svc.submit([3, 4], 5, 0.0)
+        r1, r2 = f1.result(timeout=120), f2.result(timeout=120)
+        print("OUT2", ",".join(map(str, r1.out_tokens + r2.out_tokens)), flush=True)
+
+        info = svc.sleep(1)
+        assert info["level"] == 1, info
+        print("SLEPT", flush=True)
+        svc.wake_up()
+        out3 = svc.submit(prompt, 1, 0.0).result(timeout=120)
+        # continuity across a gang-wide sleep/wake: same greedy first token
+        print("OUT3", out3.out_tokens[0], out1.out_tokens[0], flush=True)
+        svc.shutdown()
+        print("DONE 0", flush=True)
+    else:
+        # follower: stay alive until the leader's SHUTDOWN frame stops the
+        # loop thread
+        while svc._thread.is_alive():
+            if svc.failure:
+                print(f"FOLLOWER FAILED: {svc.failure}", flush=True)
+                sys.exit(1)
+            time.sleep(0.2)
+        print("DONE 1", flush=True)
+
+
+if __name__ == "__main__":
+    main()

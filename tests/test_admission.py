@@ -134,3 +134,32 @@ def test_review_shape():
         }
     )
     assert out2["allowed"] is True
+
+
+def test_multihost_accelerator_validation():
+    from llm_d_fast_model_actuation_tpu.admission import validate_isc
+
+    def isc(acc):
+        return {
+            "kind": "InferenceServerConfig",
+            "metadata": {"name": "x", "namespace": "ns"},
+            "spec": {
+                "modelServerConfig": {"port": 8000, "accelerator": acc},
+                "launcherConfigName": "lc1",
+            },
+        }
+
+    # two 2x4 hosts tiling 4x4: chips is per host, topology global
+    assert validate_isc(isc({"chips": 8, "topology": "4x4", "hosts": 2})) == []
+    # hosts without a global topology is rejected
+    errs = validate_isc(isc({"chips": 8, "hosts": 2}))
+    assert any("requires accelerator.topology" in e for e in errs)
+    # chip arithmetic includes hosts
+    errs = validate_isc(isc({"chips": 8, "topology": "2x4", "hosts": 2}))
+    assert any("chips x hosts" in e for e in errs)
+    # single-host semantics unchanged
+    assert validate_isc(isc({"chips": 8, "topology": "2x4"})) == []
+    errs = validate_isc(isc({"chips": 4, "topology": "2x4"}))
+    assert any("chips x hosts" in e for e in errs)
+    errs = validate_isc(isc({"chips": 2, "hosts": 0}))
+    assert any("hosts must be >= 1" in e for e in errs)

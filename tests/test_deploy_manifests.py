@@ -89,6 +89,10 @@ def test_hpa_integration_manifests():
     rules = yaml.safe_load(open(os.path.join(root, "prometheus-adapter-rules.yaml")))
     series = [r["seriesQuery"].split("{")[0] for r in rules["rules"]]
     import llm_d_fast_model_actuation_tpu.controller.metrics  # noqa: F401
+    # the engine's queue-depth gauge registers at engine.server import; the
+    # full suite imports it incidentally, but this test must not depend on
+    # test order
+    import llm_d_fast_model_actuation_tpu.engine.server  # noqa: F401
     from prometheus_client import REGISTRY
 
     registered = set()

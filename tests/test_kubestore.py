@@ -224,3 +224,62 @@ def test_cross_namespace_writes_use_callers_namespace(apiserver):
             await ks.stop()
 
     _run(scenario())
+
+
+def test_status_subresource_split():
+    """CRD kinds with a status subresource: a main-resource PUT must not
+    change .status (the apiserver strips it), and mutate() must route
+    status changes through the /status path so they actually land."""
+    import asyncio
+
+    from fake_apiserver import FakeApiServer
+    from llm_d_fast_model_actuation_tpu.controller.kubestore import KubeStore
+
+    srv = FakeApiServer()
+    srv.start()
+
+    async def body():
+        ks = KubeStore(f"http://127.0.0.1:{srv.port}", "ns1", kinds=None)
+        await ks.start()
+        try:
+            ks.create(
+                {
+                    "kind": "InferenceServerConfig",
+                    "metadata": {"name": "i1", "namespace": "ns1"},
+                    "spec": {"launcherConfigName": "lc1"},
+                }
+            )
+
+            # status-only mutate lands (routed via /status)
+            def set_status(o):
+                o.setdefault("status", {})["gangErrors"] = ["boom"]
+                return o
+
+            ks.mutate("InferenceServerConfig", "ns1", "i1", set_status)
+            got = srv.store.get("InferenceServerConfig", "ns1", "i1")
+            assert (got.get("status") or {}).get("gangErrors") == ["boom"]
+
+            # spec+status mutate: both land, via split writes
+            def both(o):
+                o["spec"]["launcherConfigName"] = "lc2"
+                o.setdefault("status", {})["gangErrors"] = []
+                return o
+
+            ks.mutate("InferenceServerConfig", "ns1", "i1", both)
+            got = srv.store.get("InferenceServerConfig", "ns1", "i1")
+            assert got["spec"]["launcherConfigName"] == "lc2"
+            assert got["status"]["gangErrors"] == []
+
+            # a raw main-resource update CANNOT change status (stripped)
+            cur = ks.get("InferenceServerConfig", "ns1", "i1")
+            cur["status"] = {"gangErrors": ["smuggled"]}
+            ks.update(cur)
+            got = srv.store.get("InferenceServerConfig", "ns1", "i1")
+            assert got["status"]["gangErrors"] == []
+        finally:
+            await ks.stop()
+
+    try:
+        asyncio.run(body())
+    finally:
+        srv.stop()

@@ -386,3 +386,65 @@ def test_gang_env_changes_instance_identity():
     # single-host IDs are unchanged by the new parameter (wake fast path
     # across controller versions)
     assert instance_id_for(esc, chips, extra_env=None) == base
+
+
+def test_gang_never_spans_physical_slices():
+    """Hosts of different physical slices share origin coordinates but no
+    ICI: candidates from two slices must not be paired; a gang forms only
+    once one slice can field every origin."""
+    cm = ChipMap()
+    for node, origin, sid in [
+        ("a1", (0, 0), "sliceA"),
+        ("b2", (2, 0), "sliceB"),
+        ("a2", (2, 0), "sliceA"),
+    ]:
+        cm.set_host(node, HostTopology.make("2x4", node=node))
+        cm.set_origin(node, origin)
+        cm.set_slice_id(node, sid)
+    store = InMemoryStore()
+    store.create(
+        {
+            "kind": "ConfigMap",
+            "metadata": {"name": C.CHIP_MAP_CONFIGMAP, "namespace": NS},
+            "data": cm.dump(),
+        }
+    )
+    store.create(_isc())
+    # one member in slice A (origin 0,0) and one in slice B (origin 2,0):
+    # origins would tile 4x4, but the slices are disjoint
+    store.create(
+        _requester("req-a1", "a1", chips=[c.chip_id for c in cm.host("a1").chips])
+    )
+    store.create(
+        _requester("req-b2", "b2", chips=[c.chip_id for c in cm.host("b2").chips])
+    )
+
+    async def body():
+        coord = SliceGangCoordinator(store, NS)
+        await coord.start()
+        try:
+            await asyncio.sleep(0.4)
+            for n in ("req-a1", "req-b2"):
+                ann = store.get("Pod", NS, n)["metadata"].get("annotations") or {}
+                assert GANG_ANNOTATION not in ann, "gang spanned two slices"
+
+            # slice A's second host arrives -> gang forms WITHIN slice A
+            store.create(
+                _requester(
+                    "req-a2", "a2",
+                    chips=[c.chip_id for c in cm.host("a2").chips],
+                )
+            )
+            await _settle(
+                coord,
+                lambda: gang_env_of(store.get("Pod", NS, "req-a2")) is not None,
+            )
+            assert gang_env_of(store.get("Pod", NS, "req-a1")) is not None
+            ann_b = store.get("Pod", NS, "req-b2")["metadata"].get(
+                "annotations"
+            ) or {}
+            assert GANG_ANNOTATION not in ann_b
+        finally:
+            await coord.stop()
+
+    asyncio.run(body())
