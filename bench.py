@@ -95,23 +95,46 @@ def main() -> None:
         rng.integers(1, model.vocab_size, prompt_len).tolist()
         for _ in range(cfg.max_batch)
     ]
-    reqs = []
-    for p in prompts:
-        eng.add_request(p, max_new_tokens=decode_steps)
-    while eng._waiting:
-        reqs.extend(eng.step())
-    live = [r for r in eng._slots if r is not None]
-    emitted_at_t0 = sum(len(r.out_tokens) for r in live) + sum(
-        len(r.out_tokens) for r in reqs
-    )
-    t0 = time.monotonic()
-    while eng.has_work():
-        reqs.extend(eng.step())
-    decode_s = time.monotonic() - t0
-    total_emitted = sum(len(r.out_tokens) for r in reqs)
-    decode_tok_s = (
-        (total_emitted - emitted_at_t0) / decode_s if decode_s > 0 else 0.0
-    )
+
+    def measure_decode(engine) -> float:
+        """Enqueue the batch, drain admission+prefill, then time the pure
+        steady-state decode (tokens emitted after every prompt is in)."""
+        reqs = []
+        for p in prompts:
+            engine.add_request(p, max_new_tokens=decode_steps)
+        while engine._waiting:
+            reqs.extend(engine.step())
+        emitted_at_t0 = sum(
+            len(r.out_tokens) for r in engine._slots if r is not None
+        ) + sum(len(r.out_tokens) for r in reqs)
+        t0 = time.monotonic()
+        while engine.has_work():
+            reqs.extend(engine.step())
+        decode_s = time.monotonic() - t0
+        emitted = sum(len(r.out_tokens) for r in reqs) - emitted_at_t0
+        return emitted / decode_s if decode_s > 0 else 0.0
+
+    decode_tok_s = measure_decode(eng)
+
+    # --- W8A16 decode: the served quantized config (models/quant.py) --------
+    # Decode is weight-read-bound; int8 halves the bytes. Quantize the
+    # already-loaded params (runtime quantization, same as serving) and
+    # measure the same steady-state decode.
+    decode_tok_s_int8 = 0.0
+    if on_tpu:
+        import dataclasses
+
+        from llm_d_fast_model_actuation_tpu.models.registry import maybe_quantize
+
+        qmodel = dataclasses.replace(model, quantization="int8")
+        qcfg = dataclasses.replace(cfg, model=qmodel)
+        qparams = maybe_quantize(qmodel, params)
+        qeng = InferenceEngine(qcfg, params=qparams, seed=0)
+        decode_tok_s_int8 = measure_decode(qeng)
+        # release the quantized engine's HBM before the actuation cycle
+        for x in jax.tree.leaves({"p": qeng.params, "kv": qeng.pool.as_tuple()}):
+            x.delete()
+        del qeng, qparams
 
     # --- the actuation cycle: plain (in-HBM-holder) sleep/wake ---------------
     mgr = attach_sleep(eng)
@@ -168,6 +191,7 @@ def main() -> None:
                 wake_reacquire_s + ttft_after_reacquire, 4
             ),
             "decode_tok_s": round(decode_tok_s, 1),
+            "decode_tok_s_int8": round(decode_tok_s_int8, 1),
             "checkpoint_load_s": round(ckpt_load_s, 2),
             "checkpoint_load_gibps": round(
                 param_gib / ckpt_load_s if ckpt_load_s > 0 else 0.0, 2

@@ -121,6 +121,13 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "grouped XLA elsewhere)",
     )
     p.add_argument(
+        "--quantization",
+        default="",
+        choices=["", "int8"],
+        help="weight-only quantization (int8 = W8A16 per-output-channel; "
+        "halves decode's HBM weight reads)",
+    )
+    p.add_argument(
         "--decode-chunk",
         type=int,
         default=8,
@@ -231,6 +238,12 @@ class EngineService:
 
             jax.distributed.initialize(**dist)
         model_cfg = MODEL_CONFIGS[args.model]()
+        if args.quantization and model_cfg.quantization != args.quantization:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(
+                model_cfg, quantization=args.quantization
+            )
         mesh = None
         if args.tensor_parallel_size > 1:
             from ..parallel.mesh import MeshPlan, make_mesh

@@ -98,8 +98,16 @@ def load_params(
 ) -> Dict[str, Any]:
     """Restore params from `directory`, directly into their serving
     placement (sharded over `mesh` when given, committed to the default
-    device otherwise)."""
+    device otherwise). Checkpoints are always the bf16 form: a quantized
+    serving config restores bf16 and quantizes on the way in (runtime
+    quantization, models/quant.py)."""
     import orbax.checkpoint as ocp
+
+    serve_cfg = cfg
+    if getattr(cfg, "quantization", ""):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, quantization="")
 
     validate_config(directory, cfg)
     directory = os.path.abspath(directory)
@@ -138,7 +146,18 @@ def load_params(
             abstract,
         )
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.join(directory, PARAMS_DIR), target)
+        params = ckptr.restore(os.path.join(directory, PARAMS_DIR), target)
+    if serve_cfg is not cfg:
+        from .registry import logical_axes_for, maybe_quantize
+
+        params = maybe_quantize(serve_cfg, params)
+        if mesh is not None:
+            # re-pin: the eager quantize ops don't all preserve the serving
+            # sharding (scale reductions in particular)
+            from ..parallel.mesh import shard_pytree
+
+            params = shard_pytree(params, mesh, logical_axes_for(serve_cfg))
+    return params
 
 
 def main(argv=None) -> int:

@@ -29,6 +29,7 @@ from ..ops.attention import (
 )
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope, rope_table
+from .quant import qmat
 
 
 @dataclass(frozen=True)
@@ -48,6 +49,9 @@ class LlamaConfig:
     #: Attention implementation ("reference" | "pallas"); per-model so two
     #: engines in one process can't clobber each other's choice.
     attention_impl: str = "reference"
+    #: Weight-only quantization: "" (bf16) or "int8" (W8A16 per-output-
+    #: channel, models/quant.py) — halves decode's weight-read bytes.
+    quantization: str = ""
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -166,9 +170,9 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def _mlp(x, gate, up, down):
-    g = x @ gate
-    u = x @ up
-    return (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u) @ down
+    g = qmat(x, gate)
+    u = qmat(x, up)
+    return qmat((jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u), down)
 
 
 def _ffn(cfg: "LlamaConfig", lp, x):
@@ -183,9 +187,9 @@ def _ffn(cfg: "LlamaConfig", lp, x):
 def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
     """x: [b, s, h] -> q [b,s,heads,hd], k/v [b,s,kvh,hd], roped."""
     b, s, _ = x.shape
-    q = (x @ lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
-    k = (x @ lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = (x @ lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q = qmat(x, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = qmat(x, lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = qmat(x, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cos_tab, sin_tab)
     k = apply_rope(k, positions, cos_tab, sin_tab)
     return q, k, v
@@ -245,7 +249,7 @@ def prefill(
         kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
         vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
         attn = causal_prefill_attention(q, k, v, seq_lens, impl=cfg.attention_impl)
-        x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
+        x = x + qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _ffn(cfg, lp, h)
         return x, (kp, vp)
@@ -255,7 +259,7 @@ def prefill(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)
 
 
@@ -307,7 +311,7 @@ def decode_step(
         attn = paged_decode_attention_inline(
             q, kp, vp, k, v, page_table, positions, impl=cfg.attention_impl
         )
-        x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
+        x = x + qmat(attn.reshape(b, cfg.q_dim), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _ffn(cfg, lp, h)
         return x, (k, v)
@@ -331,7 +335,7 @@ def decode_step(
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)
 
 
@@ -371,7 +375,7 @@ def _decode_step_scatter_first(
         attn = paged_decode_attention(
             q, kp, vp, page_table, seq_lens, impl=cfg.attention_impl
         )
-        x = x + attn.reshape(b, cfg.q_dim) @ lp["wo"]
+        x = x + qmat(attn.reshape(b, cfg.q_dim), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         x = x + _ffn(cfg, lp, h)
         return x, (kp, vp)
@@ -381,5 +385,5 @@ def _decode_step_scatter_first(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head).astype(jnp.float32)
+    logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)

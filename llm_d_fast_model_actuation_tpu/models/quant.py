@@ -1,0 +1,105 @@
+"""Weight-only int8 quantization (W8A16) for the serving path.
+
+Decode on TPU is HBM-bandwidth-bound: every step reads every weight byte
+(SURVEY.md §6; the reference's engine, vLLM, ships the same technique for
+the same reason). Symmetric per-output-channel int8 halves the weight
+bytes — near-2x on the decode roofline — while activations stay bf16 and
+matmuls run on the MXU: XLA fuses the int8->bf16 upconvert into the
+matmul's operand read, so HBM traffic is the int8 bytes.
+
+Representation: a quantized weight is the dict ``{"q": int8[..., out],
+"s": f32[out-broadcastable]}`` with ``W ≈ q * s``. Since the scale is
+per OUTPUT channel, ``x @ W == (x @ q) * s`` — the matmul result is
+rescaled, not the weight, so no dequantized copy ever materializes.
+
+Quantized and plain weights coexist: every matmul in the model forward
+goes through `qmat`, which dispatches on the leaf shape. Norms and the
+embedding table stay bf16 (the embedding is a gather, not a matmul; its
+tied-head use stays bf16 too).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+#: weight stacks quantized in a llama-family layer pytree + top level
+LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def qmat(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """``x @ w`` for a plain or quantized weight."""
+    if is_quantized(w):
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_weight(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8: scale over all axes but the last.
+
+    Handles both single (in, out) and layer-stacked (L, in, out) weights —
+    the scale keeps a broadcastable shape so `lax.scan` slicing a layer
+    slices the scale with it.
+    """
+    # reduce ONLY the fan-in axis: leading stack axes (the scan's layer
+    # axis) keep their own scales, so slicing a layer slices its scale
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=w.ndim - 2, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a llama-family param pytree in place of the bf16 stacks.
+    Embedding, norms, and MoE expert stacks stay bf16 (experts are routed
+    through raw einsums in moe_ffn; quantizing them is a follow-up).
+
+    MoE trees reuse the dense names for their 4-D expert stacks
+    ([L, E, in, out]); only the 3-D dense stacks are quantized — rank is
+    the discriminator."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in LAYER_WEIGHTS:
+        w = layers.get(name)
+        if w is not None and not is_quantized(w) and w.ndim == 3:
+            layers[name] = quantize_weight(w)
+    out["layers"] = layers
+    head = params.get("lm_head")
+    if head is not None and not is_quantized(head):
+        out["lm_head"] = quantize_weight(head)
+    return out
+
+
+def quantized_axes(axes: Dict[str, Any]) -> Dict[str, Any]:
+    """Logical-axis pytree matching `quantize_params`' structure: q keeps
+    the original weight's axes; the broadcast scale shards only its output
+    axis (other dims are size-1)."""
+    out = dict(axes)
+    layers = dict(axes["layers"])
+    for name in LAYER_WEIGHTS:
+        ax = layers.get(name)
+        # rank-3 only, mirroring quantize_params (MoE expert stacks are
+        # 4-D and stay bf16)
+        if ax is not None and len(ax) == 3:
+            layers[name] = {
+                "q": ax,
+                # (L, 1, out): layer axis + dummy + output axis
+                "s": (ax[0], None, ax[-1]),
+            }
+    out["layers"] = layers
+    if "lm_head" in axes:
+        ax = axes["lm_head"]
+        out["lm_head"] = {"q": ax, "s": (None, ax[-1])}
+    return out
+
+
+
+
+

@@ -17,11 +17,32 @@ from . import llama, moe
 
 def init_params_for(key: jax.Array, cfg: llama.LlamaConfig) -> Dict[str, Any]:
     if isinstance(cfg, moe.MoeConfig):
-        return moe.init_params(key, cfg)
-    return llama.init_params(key, cfg)
+        params = moe.init_params(key, cfg)
+    else:
+        params = llama.init_params(key, cfg)
+    return maybe_quantize(cfg, params)
 
 
 def logical_axes_for(cfg: llama.LlamaConfig) -> Dict[str, Any]:
     if isinstance(cfg, moe.MoeConfig):
-        return moe.param_logical_axes(cfg)
-    return llama.param_logical_axes(cfg)
+        axes = moe.param_logical_axes(cfg)
+    else:
+        axes = llama.param_logical_axes(cfg)
+    if getattr(cfg, "quantization", "") == "int8":
+        from .quant import quantized_axes
+
+        axes = quantized_axes(axes)
+    return axes
+
+
+def maybe_quantize(cfg: llama.LlamaConfig, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Apply the config's weight quantization (runtime quantization: bf16
+    checkpoints stay bf16 on disk; HBM holds the int8 form)."""
+    q = getattr(cfg, "quantization", "")
+    if not q:
+        return params
+    if q != "int8":
+        raise ValueError(f"unknown quantization {q!r}")
+    from .quant import quantize_params
+
+    return quantize_params(params)
