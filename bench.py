@@ -131,9 +131,16 @@ def main() -> None:
         qparams = maybe_quantize(qmodel, params)
         qeng = InferenceEngine(qcfg, params=qparams, seed=0)
         decode_tok_s_int8 = measure_decode(qeng)
-        # release the quantized engine's HBM before the actuation cycle
+        # Release the quantized engine's HBM before the actuation cycle —
+        # but only buffers it does NOT share with the live engine:
+        # quantize_params reuses the bf16 embed/norm arrays, and deleting
+        # those would kill the engine the rest of the bench measures.
+        keep = {
+            id(x) for x in jax.tree.leaves(params) + jax.tree.leaves(eng.params)
+        }
         for x in jax.tree.leaves({"p": qeng.params, "kv": qeng.pool.as_tuple()}):
-            x.delete()
+            if id(x) not in keep:
+                x.delete()
         del qeng, qparams
 
     # --- the actuation cycle: plain (in-HBM-holder) sleep/wake ---------------

@@ -59,6 +59,11 @@ class EngineConfig:
     attention_impl: str = "auto"
     #: Max decode steps fused into one compiled program dispatch.
     decode_chunk: int = 8
+    #: Automatic prefix caching (engine/prefix_cache.py): page-aligned KV
+    #: reuse across requests sharing a prompt prefix. Outputs are
+    #: identical with it on or off; on is the serving default (the
+    #: reference's engine ships the same as vLLM APC).
+    prefix_caching: bool = True
 
     @property
     def seq_len(self) -> int:
@@ -85,6 +90,10 @@ class Request:
     pages: List[int] = field(default_factory=list)
     pos: int = 0  # tokens in cache
     slot: int = -1
+    #: prompt tokens served from the prefix cache (0 = full prefill)
+    cached_tokens: int = 0
+    #: how many of `pages` are shared prefix pages (for registration)
+    shared_pages: int = 0
     done: bool = False
     error: Optional[str] = None
     submit_time: float = field(default_factory=time.monotonic)
@@ -144,6 +153,12 @@ class InferenceEngine:
                 jax.device_put(self.pool.as_tuple(), jax.devices()[0])
             )
         self.allocator = PageAllocator(cfg.num_pages)
+        if cfg.prefix_caching:
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache: Optional[Any] = PrefixCache(cfg.page_size)
+        else:
+            self.prefix_cache = None
         b, p = cfg.max_batch, cfg.pages_per_seq
         # Host mirrors of the device scheduler state (source of truth between
         # chunks; re-uploaded only after an admission/retire/prefill edge).
@@ -169,20 +184,37 @@ class InferenceEngine:
         model_cfg = m
         self._model_cfg = m
 
+        def _sample_last(logits, lens, temp, raw_key):
+            """Shared sampling tail of both prefill programs: take the last
+            valid logit, split the key, sample — one definition so the
+            cache-hit path can never diverge from the cold one."""
+            last = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            key = jax.random.wrap_key_data(raw_key)
+            key, sub = jax.random.split(key)
+            return sample(last, sub, temp), jax.random.key_data(key)
+
         def _prefill(params, tokens, seq_lens, cache, page_table, temp, raw_key):
             logits, cache = llama.prefill(
                 params, model_cfg, tokens, seq_lens, cache, page_table
             )
-            last = jnp.take_along_axis(
-                logits, (seq_lens - 1)[:, None, None], axis=1
-            )[:, 0]
-            key = jax.random.wrap_key_data(raw_key)
-            key, sub = jax.random.split(key)
-            tok = sample(last, sub, temp)
-            return tok, cache, jax.random.key_data(key)
+            tok, raw_key = _sample_last(logits, seq_lens, temp, raw_key)
+            return tok, cache, raw_key
 
         # cache (arg 3) donated: prefill updates pages in place.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
+
+        def _suffix_prefill(
+            params, tokens, start, suffix_lens, cache, page_table, temp, raw_key
+        ):
+            logits, cache = llama.prefill_continue(
+                params, model_cfg, tokens, start, suffix_lens, cache, page_table
+            )
+            tok, raw_key = _sample_last(logits, suffix_lens, temp, raw_key)
+            return tok, cache, raw_key
+
+        self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(4,))
         self._chunk_fns: Dict[int, Any] = {}
 
     # -- compiled decode chunk ----------------------------------------------
@@ -304,10 +336,27 @@ class InferenceEngine:
             return False
         total = len(req.prompt) + req.max_new_tokens
         need = PageAllocator.pages_needed(total, self.cfg.page_size)
+        shared: List[int] = []
+        if self.prefix_cache is not None:
+            shared, req.cached_tokens = self.prefix_cache.match(req.prompt)
+            # hold the shared pages BEFORE allocating: eviction inside the
+            # allocation path must not reclaim what we just matched
+            self.prefix_cache.acquire(shared)
         try:
-            req.pages = self.allocator.alloc(need)
+            own = self._alloc_pages(need - len(shared))
         except OutOfPages:
+            if self.prefix_cache is not None and shared:
+                self.allocator.free(self.prefix_cache.release(shared))
+            req.cached_tokens = 0
             return False
+        req.pages = shared + own
+        req.shared_pages = len(shared)
+        if self.prefix_cache is not None:
+            # the sequence's own reference for its non-shared pages (the
+            # shared ones were acquired above); hit stats only now that
+            # admission actually succeeded
+            self.prefix_cache.acquire(own)
+            self.prefix_cache.commit(req.prompt, len(shared))
         req.slot = slot
         self._slots[slot] = req
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
@@ -315,6 +364,19 @@ class InferenceEngine:
         self._page_table[slot] = row
         self._dirty = True
         return True
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Allocate, evicting LRU cache-only prefix pages under pressure."""
+        try:
+            return self.allocator.alloc(n)
+        except OutOfPages:
+            if self.prefix_cache is None:
+                raise
+            evicted = self.prefix_cache.evict(n - self.allocator.available)
+            if not evicted:
+                raise
+            self.allocator.free(evicted)
+            return self.allocator.alloc(n)
 
     def _prefill_bucket(self, n: int) -> int:
         b = 16
@@ -324,24 +386,50 @@ class InferenceEngine:
 
     def _run_prefill(self, req: Request) -> None:
         n = len(req.prompt)
-        bucket = self._prefill_bucket(n)
-        tokens = np.zeros((1, bucket), dtype=np.int32)
-        tokens[0, :n] = req.prompt
-        seq_lens = np.array([n], dtype=np.int32)
         table = self._page_table[req.slot : req.slot + 1]
         temp = np.asarray([req.temperature], dtype=np.float32)
-        if self.lockstep is not None:
-            self.lockstep.prefill(req, bucket)
-        tok, cache, self._raw_key = self._prefill_fn(
-            self.params,
-            tokens,
-            seq_lens,
-            self.pool.as_tuple(),
-            table,
-            temp,
-            self._raw_key,
-        )
+        if req.cached_tokens > 0:
+            # prefix-cache hit: prefill only the suffix; the shared pages
+            # already hold the prefix KV (engine/prefix_cache.py)
+            k = req.cached_tokens
+            suffix = req.prompt[k:]
+            bucket = self._prefill_bucket(len(suffix))
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, : len(suffix)] = suffix
+            start = np.array([k], dtype=np.int32)
+            suffix_lens = np.array([len(suffix)], dtype=np.int32)
+            if self.lockstep is not None:
+                self.lockstep.prefill_suffix(req, bucket, k)
+            tok, cache, self._raw_key = self._suffix_prefill_fn(
+                self.params,
+                tokens,
+                start,
+                suffix_lens,
+                self.pool.as_tuple(),
+                table,
+                temp,
+                self._raw_key,
+            )
+        else:
+            bucket = self._prefill_bucket(n)
+            tokens = np.zeros((1, bucket), dtype=np.int32)
+            tokens[0, :n] = req.prompt
+            seq_lens = np.array([n], dtype=np.int32)
+            if self.lockstep is not None:
+                self.lockstep.prefill(req, bucket)
+            tok, cache, self._raw_key = self._prefill_fn(
+                self.params,
+                tokens,
+                seq_lens,
+                self.pool.as_tuple(),
+                table,
+                temp,
+                self._raw_key,
+            )
         self.pool.replace(cache)
+        if self.prefix_cache is not None:
+            # the full prompt pages now hold prompt KV: make them reusable
+            self.prefix_cache.register(req.prompt, req.pages, req.shared_pages)
         first = int(np.asarray(tok)[0])
         req.pos = n
         self._emit(req, first)
@@ -364,7 +452,10 @@ class InferenceEngine:
             req.on_token(req, token)
 
     def _retire(self, req: Request) -> None:
-        self.allocator.free(req.pages)
+        if self.prefix_cache is not None:
+            self.allocator.free(self.prefix_cache.release(req.pages))
+        else:
+            self.allocator.free(req.pages)
         self._slots[req.slot] = None
         self._page_table[req.slot] = 0
         self._positions[req.slot] = 0
@@ -466,8 +557,9 @@ class InferenceEngine:
 
     def abort_all(self, reason: str) -> List[Request]:
         """Fail every waiting and in-flight request and reset the scheduler
-        (slots, page tables, allocator). Used when continuity of generation
-        cannot be preserved — e.g. a level-2 sleep discarded the KV cache."""
+        (slots, page tables, allocator, prefix cache). Used when continuity
+        of generation cannot be preserved — e.g. a level-2 sleep discarded
+        the KV cache, which also invalidates every cached prefix page."""
         aborted = list(self._waiting)
         self._waiting.clear()
         for req in list(self._slots):
@@ -477,6 +569,10 @@ class InferenceEngine:
         for req in aborted:
             req.done = True
             req.error = reason
+        if self.prefix_cache is not None:
+            # the KV content backing the index is gone: matching a stale
+            # chain would silently attend over garbage pages
+            self.allocator.free(self.prefix_cache.clear())
         return aborted
 
     # -- convenience --------------------------------------------------------

@@ -45,6 +45,7 @@ KIND_CHUNK = 2
 KIND_SLEEP = 3
 KIND_WAKE = 4
 KIND_SHUTDOWN = 5
+KIND_PREFILL_SUFFIX = 6  #: prefix-cache hit: replay the continue program
 
 
 def _frame_template(cfg) -> Dict[str, np.ndarray]:
@@ -56,6 +57,8 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         #: prefill slot | sleep release flag
         "arg2": np.zeros((), np.int32),
         "seq_len": np.zeros((), np.int32),
+        #: suffix prefill: absolute position of the first suffix token
+        "start": np.zeros((), np.int32),
         "temp": np.zeros((), np.float32),
         "tokens": np.zeros((cfg.seq_len,), np.int32),
         #: chunk: rebuild device scheduler state from the mirrors below
@@ -112,6 +115,20 @@ class LockstepLeader:
             tokens=tokens,
         )
 
+    def prefill_suffix(self, req: Any, bucket: int, start: int) -> None:
+        suffix = req.prompt[start:]
+        tokens = np.zeros((self.engine.cfg.seq_len,), np.int32)
+        tokens[: len(suffix)] = suffix
+        self._send(
+            kind=KIND_PREFILL_SUFFIX,
+            arg=bucket,
+            arg2=req.slot,
+            seq_len=len(suffix),
+            start=start,
+            temp=req.temperature,
+            tokens=tokens,
+        )
+
     def chunk(self, T: int, reupload: bool) -> None:
         self._send(kind=KIND_CHUNK, arg=T, reupload=int(reupload))
 
@@ -141,6 +158,8 @@ def follower_loop(engine: Any, sleeper: Optional[Any] = None) -> None:
             return
         if kind == KIND_PREFILL:
             _replay_prefill(engine, f)
+        elif kind == KIND_PREFILL_SUFFIX:
+            _replay_prefill_suffix(engine, f)
         elif kind == KIND_CHUNK:
             _replay_chunk(engine, f)
         elif kind == KIND_SLEEP and sleeper is not None:
@@ -178,6 +197,30 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
     )
     engine.pool.replace(cache)
     # no host sync: the leader alone consumes tokens
+
+
+def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
+    bucket = int(f["arg"])
+    slot = int(f["arg2"])
+    n = int(f["seq_len"])
+    _sync_mirrors(engine, f)
+    tokens = np.zeros((1, bucket), np.int32)
+    tokens[0, :] = f["tokens"][:bucket]
+    start = np.array([int(f["start"])], np.int32)
+    suffix_lens = np.array([n], np.int32)
+    table = engine._page_table[slot : slot + 1]
+    temp = np.asarray([float(f["temp"])], np.float32)
+    _tok, cache, engine._raw_key = engine._suffix_prefill_fn(
+        engine.params,
+        tokens,
+        start,
+        suffix_lens,
+        engine.pool.as_tuple(),
+        table,
+        temp,
+        engine._raw_key,
+    )
+    engine.pool.replace(cache)
 
 
 def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:

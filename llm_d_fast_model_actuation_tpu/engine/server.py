@@ -38,7 +38,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
-from prometheus_client import Gauge
+from prometheus_client import Counter, Gauge, Histogram
 
 from ..models import llama
 from ..models.moe import MoeConfig
@@ -53,6 +53,42 @@ logger = logging.getLogger(__name__)
 ENGINE_QUEUE_DEPTH = Gauge(
     "fma_engine_queue_depth",
     "Requests waiting or in flight in this engine",
+    ["model"],
+)
+
+# Serving observability (the vLLM-equivalent engine metrics an operator
+# expects on the engine's /metrics; the reference serves vLLM's):
+ENGINE_TTFT = Histogram(
+    "fma_engine_time_to_first_token_seconds",
+    "Submit to first emitted token",
+    ["model"],
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30),
+)
+ENGINE_E2E_LATENCY = Histogram(
+    "fma_engine_request_seconds",
+    "Submit to request completion",
+    ["model"],
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120),
+)
+ENGINE_PROMPT_TOKENS = Counter(
+    "fma_engine_prompt_tokens_total", "Prompt tokens processed", ["model"]
+)
+ENGINE_GENERATED_TOKENS = Counter(
+    "fma_engine_generation_tokens_total", "Tokens generated", ["model"]
+)
+ENGINE_ABORTS = Counter(
+    "fma_engine_aborted_requests_total",
+    "Requests aborted (client disconnect or engine state loss)",
+    ["model"],
+)
+ENGINE_KV_USAGE = Gauge(
+    "fma_engine_kv_cache_usage_ratio",
+    "Fraction of KV pages in use",
+    ["model"],
+)
+ENGINE_PREFIX_HIT_TOKENS = Gauge(
+    "fma_engine_prefix_cache_hit_tokens",
+    "Prompt tokens served from the prefix cache instead of prefill",
     ["model"],
 )
 
@@ -126,6 +162,13 @@ def make_arg_parser() -> argparse.ArgumentParser:
         choices=["", "int8"],
         help="weight-only quantization (int8 = W8A16 per-output-channel; "
         "halves decode's HBM weight reads)",
+    )
+    p.add_argument(
+        "--prefix-caching",
+        default="on",
+        choices=["on", "off"],
+        help="automatic prefix caching: page-aligned KV reuse across "
+        "requests sharing a prompt prefix",
     )
     p.add_argument(
         "--decode-chunk",
@@ -267,6 +310,7 @@ class EngineService:
                 eos_token_id=args.eos_token_id,
                 attention_impl=args.attention_impl,
                 decode_chunk=args.decode_chunk,
+                prefix_caching=args.prefix_caching == "on",
             ),
             params=params,
             mesh=mesh,
@@ -339,7 +383,8 @@ class EngineService:
                     break
             seq_id = self._fut_seq.pop(id(fut), None)
             if seq_id is not None:
-                self.engine.abort(seq_id, reason="client disconnected")
+                if self.engine.abort(seq_id, reason="client disconnected"):
+                    ENGINE_ABORTS.labels(model=self.args.model).inc()
                 self._futures.pop(seq_id, None)
             if not fut.done():
                 fut.cancel()
@@ -370,6 +415,8 @@ class EngineService:
                                     self._fut_seq.pop(id(fut), None)
                                     if not fut.done():
                                         fut.set_result(req)
+                                self._observe_finished(req)
+                            self._observe_kv_usage()
                             continue
             except Exception as e:  # device/runtime failure: fail loudly
                 logger.exception("engine loop failed")
@@ -378,6 +425,24 @@ class EngineService:
                 return
             self._new_work.wait(timeout=0.05)
             self._new_work.clear()
+
+    def _observe_finished(self, req) -> None:
+        m = self.args.model
+        now = time.monotonic()
+        if req.first_token_time is not None:
+            ENGINE_TTFT.labels(model=m).observe(
+                req.first_token_time - req.submit_time
+            )
+        ENGINE_E2E_LATENCY.labels(model=m).observe(now - req.submit_time)
+        ENGINE_PROMPT_TOKENS.labels(model=m).inc(len(req.prompt))
+        ENGINE_GENERATED_TOKENS.labels(model=m).inc(len(req.out_tokens))
+
+    def _observe_kv_usage(self) -> None:
+        alloc = self.engine.allocator
+        total = max(1, alloc.num_pages - 1)
+        ENGINE_KV_USAGE.labels(model=self.args.model).set(
+            (total - alloc.available) / total
+        )
 
     def _run_follower(self) -> None:
         """Gang follower: replay the leader's compiled calls until it
@@ -481,6 +546,7 @@ class EngineService:
                 # KV state is gone: abort anything mid-generation before the
                 # fresh state arrives, then rebuild params+pool in place.
                 aborted = self.engine.abort_all("level-2 sleep discarded state")
+                ENGINE_ABORTS.labels(model=self.args.model).inc(len(aborted))
                 exc = RuntimeError("aborted by level-2 sleep (KV discarded)")
                 for req in aborted:
                     fut = self._futures.pop(req.seq_id, None)
@@ -641,6 +707,10 @@ def build_app(service: EngineService) -> web.Application:
         ENGINE_QUEUE_DEPTH.labels(model=service.args.model).set(
             service.queue_depth()
         )
+        if service.engine.prefix_cache is not None:
+            ENGINE_PREFIX_HIT_TOKENS.labels(model=service.args.model).set(
+                service.engine.prefix_cache.hit_tokens
+            )
         return web.Response(
             body=generate_latest(),
             content_type="text/plain",

@@ -263,6 +263,58 @@ def prefill(
     return logits, (new_k, new_v)
 
 
+def prefill_continue(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [b, s] int32 suffix tokens, right-padded
+    start: jnp.ndarray,  # [b] int32 — absolute position of tokens[:, 0]
+    suffix_lens: jnp.ndarray,  # [b] int32 — valid suffix length per row
+    cache: Tuple[jnp.ndarray, jnp.ndarray],
+    page_table: jnp.ndarray,  # [b, pages_per_seq] int32
+):
+    """Prefill a prompt SUFFIX against a cache whose first `start` tokens
+    are already present (the prefix-caching hit path,
+    engine/prefix_cache.py). Scatters only the suffix's KV; attention runs
+    over the paged cache so suffix queries see the shared prefix.
+
+    Returns (logits [b, s, vocab], new_cache); the caller samples at
+    suffix_lens-1.
+    """
+    from ..ops.attention import paged_suffix_attention
+
+    b, s = tokens.shape
+    k_pages, v_pages = cache
+    page_size = k_pages.shape[2]
+    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+
+    positions = start[:, None] + jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, s)
+    )
+    valid = jnp.arange(s, dtype=jnp.int32)[None, :] < suffix_lens[:, None]
+
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def layer(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
+        kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
+        vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
+        attn = paged_suffix_attention(q, kp, vp, page_table, start)
+        x = x + qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        x = x + _ffn(cfg, lp, h)
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = qmat(x, head).astype(jnp.float32)
+    return logits, (new_k, new_v)
+
+
 def decode_step(
     params: Dict[str, Any],
     cfg: LlamaConfig,

@@ -191,3 +191,47 @@ def paged_decode_attention(
     probs = probs / probs.sum(axis=-1, keepdims=True)
     out = jnp.einsum("bhk,bkhd->bhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def paged_suffix_attention(
+    q: jnp.ndarray,  # [batch, s, heads, head_dim] — suffix queries
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    page_table: jnp.ndarray,  # [batch, pages_per_seq] int32
+    start: jnp.ndarray,  # [batch] int32 — absolute position of query 0
+) -> jnp.ndarray:
+    """Causal attention for a prompt SUFFIX over the paged cache.
+
+    The prefix-caching continue path (engine/prefix_cache.py): the cached
+    prefix's KV already lives in shared pages, the suffix's KV has just
+    been scattered in, and query i at absolute position start+i attends
+    to every cache slot <= its own position. Padding queries (past the
+    real suffix) produce garbage the caller ignores — same convention as
+    the right-padded full prefill. GQA by head grouping (no repeated
+    K/V), XLA gather over the table — ctx is static so the whole thing is
+    one fused region.
+    """
+    b, s, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+    pages_per_seq = page_table.shape[1]
+    page_size = k_pages.shape[1]
+    ctx = pages_per_seq * page_size
+
+    k = k_pages[page_table].reshape(b, ctx, kvh, d)
+    v = v_pages[page_table].reshape(b, ctx, kvh, d)
+    qg = (q.astype(jnp.float32) * (d**-0.5)).astype(q.dtype).reshape(
+        b, s, kvh, g, d
+    )
+    logits = jnp.einsum(
+        "bsngd,bknd->bsngk", qg, k, preferred_element_type=jnp.float32
+    )
+    qpos = start[:, None] + jnp.arange(s)[None, :]  # [b, s] absolute
+    mask = jnp.arange(ctx)[None, None, :] <= qpos[:, :, None]  # [b, s, ctx]
+    logits = jnp.where(mask[:, :, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bsngk,bknd->bsngd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, s, h, d).astype(q.dtype)

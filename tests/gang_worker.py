@@ -38,6 +38,17 @@ def main() -> None:
         r1, r2 = f1.result(timeout=120), f2.result(timeout=120)
         print("OUT2", ",".join(map(str, r1.out_tokens + r2.out_tokens)), flush=True)
 
+        # prefix-cache hit across the gang: the repeat's suffix prefill is
+        # replayed by the follower via the PREFILL_SUFFIX frame
+        long_prompt = list(range(1, 12))  # > one page (page-size 8)
+        a = svc.submit(long_prompt, 3, 0.0).result(timeout=120)
+        b = svc.submit(long_prompt, 3, 0.0).result(timeout=120)
+        assert svc.engine.prefix_cache.hits >= 1, "repeat must hit the cache"
+        print(
+            "PREFIX", ",".join(map(str, a.out_tokens)),
+            ",".join(map(str, b.out_tokens)), flush=True,
+        )
+
         info = svc.sleep(1)
         assert info["level"] == 1, info
         print("SLEPT", flush=True)

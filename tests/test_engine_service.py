@@ -287,3 +287,24 @@ def test_resolve_distributed_flags_and_env(monkeypatch):
     args = parse_engine_options("--model tiny --num-processes 2")
     with pytest.raises(ValueError):
         resolve_distributed(args)
+
+
+def test_engine_serving_metrics_are_exercised(service):
+    from prometheus_client import REGISTRY
+
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 4}
+        )
+        assert r.status == 200
+
+    run_async(_client(service, scenario))
+
+    def val(name, **labels):
+        return REGISTRY.get_sample_value(name, {"model": "tiny", **labels})
+
+    assert val("fma_engine_prompt_tokens_total") >= 3
+    assert val("fma_engine_generation_tokens_total") >= 4
+    assert val("fma_engine_time_to_first_token_seconds_count") >= 1
+    assert val("fma_engine_request_seconds_count") >= 1
+    assert val("fma_engine_kv_cache_usage_ratio") is not None

@@ -62,4 +62,7 @@ def test_two_process_gang_serves_and_sleeps():
     assert first_after_wake == first_before, (
         "generation changed across gang-wide sleep/wake"
     )
+    # prefix-cache hit replayed by the follower: identical greedy repeat
+    pa, pb = lines["PREFIX"].split()
+    assert pa == pb, "cache-hit generation diverged from the cold one"
     assert "SLEPT" in out and "DONE 1" in fout

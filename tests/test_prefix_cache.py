@@ -1,0 +1,204 @@
+"""Automatic prefix caching: the data structure, the suffix-prefill path,
+and correctness of shared-page serving (engine/prefix_cache.py)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
+from llm_d_fast_model_actuation_tpu.engine.prefix_cache import PrefixCache
+from llm_d_fast_model_actuation_tpu.models import llama
+
+PS = 8  # page size used throughout
+
+
+def make_engine(prefix_caching=True, num_pages=32, max_batch=2):
+    return InferenceEngine(
+        EngineConfig(
+            model=llama.LlamaConfig.tiny(),
+            max_batch=max_batch,
+            page_size=PS,
+            num_pages=num_pages,
+            max_seq_len=64,
+            prefix_caching=prefix_caching,
+        ),
+        seed=0,
+    )
+
+
+# ------------------------------------------------------------ the structure
+
+
+def test_match_register_release_lifecycle():
+    pc = PrefixCache(page_size=4)
+    prompt = list(range(11))  # 2 full pages + 3 tail tokens
+
+    assert pc.match(prompt) == ([], 0)
+    # a sequence with pages [10, 11, 12]: acquire + register
+    pc.acquire([10, 11, 12])
+    pc.register(prompt, [10, 11, 12], shared_count=0)
+    assert pc.resident_pages() == 2  # only the 2 FULL prompt pages
+
+    pages, k = pc.match(prompt)
+    assert pages == [10, 11] and k == 8
+
+    # retire the owning sequence: registered pages stay resident
+    freed = pc.release([10, 11, 12])
+    assert freed == [12]  # tail page had no cache reference
+    assert pc.match(prompt)[0] == [10, 11]
+
+    # eviction unwinds from the chain tail (leaf first)
+    assert pc.evict(1) == [11]
+    assert pc.match(prompt) == ([10], 4)
+    assert pc.evict(5) == [10]
+    assert pc.match(prompt) == ([], 0)
+
+
+def test_match_never_consumes_whole_prompt():
+    pc = PrefixCache(page_size=4)
+    prompt = list(range(8))  # exactly 2 pages
+    pc.acquire([1, 2])
+    pc.register(prompt, [1, 2], shared_count=0)
+    pc.release([1, 2])
+    # both pages cached, but a page-aligned prompt must keep its last
+    # page's worth to prefill (the sampling query)
+    pages, k = pc.match(prompt)
+    assert pages == [1] and k == 4
+
+
+def test_shared_pages_not_evictable_while_referenced():
+    pc = PrefixCache(page_size=4)
+    prompt = list(range(9))
+    pc.acquire([5, 6, 7])
+    pc.register(prompt, [5, 6, 7], shared_count=0)
+    # sequence still holds its pages: nothing evictable
+    assert pc.evict(3) == []
+    pc.release([5, 6, 7])
+    assert sorted(pc.evict(3)) == [5, 6]
+
+
+# ------------------------------------------------------- engine integration
+
+
+def test_prefix_hit_matches_cold_generation():
+    """The correctness contract: a cache-hit generation is greedy-identical
+    to the cold one (suffix prefill over shared pages computes the same
+    logits as a full prefill)."""
+    shared_prefix = list(range(1, 1 + 2 * PS))  # two full pages
+    p1 = shared_prefix + [41, 42, 43]
+    p2 = shared_prefix + [51, 52]  # different tail, same prefix
+
+    cold = make_engine(prefix_caching=False)
+    out1_cold = cold.generate([p1], max_new_tokens=5)[0]
+    out2_cold = cold.generate([p2], max_new_tokens=5)[0]
+
+    warm = make_engine(prefix_caching=True)
+    out1 = warm.generate([p1], max_new_tokens=5)[0]
+    assert warm.prefix_cache.hits == 0
+    out2 = warm.generate([p2], max_new_tokens=5)[0]
+    assert warm.prefix_cache.hits == 1
+    assert warm.prefix_cache.hit_tokens == 2 * PS
+
+    assert out1 == out1_cold
+    assert out2 == out2_cold
+
+    # and an exact repeat also hits (and stays identical)
+    out1b = warm.generate([p1], max_new_tokens=5)[0]
+    assert out1b == out1_cold
+    assert warm.prefix_cache.hits == 2
+
+
+def test_concurrent_sequences_share_pages_safely():
+    shared_prefix = list(range(1, 1 + 2 * PS))
+    eng = make_engine(max_batch=2)
+    # seed the cache
+    base = eng.generate([shared_prefix + [99]], max_new_tokens=2)[0]
+    assert base
+    # two concurrent requests with the same prefix: both hit, pages shared
+    eng.add_request(shared_prefix + [41], max_new_tokens=4)
+    eng.add_request(shared_prefix + [51], max_new_tokens=4)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    assert len(done) == 2 and all(len(r.out_tokens) == 4 for r in done)
+    assert eng.prefix_cache.hits == 2
+    # cold-vs-warm equality for one of them
+    cold = make_engine(prefix_caching=False)
+    assert (
+        cold.generate([shared_prefix + [41]], max_new_tokens=4)[0]
+        == [r for r in done if r.prompt[-1] == 41][0].out_tokens
+    )
+
+
+def test_eviction_under_page_pressure():
+    """When the pool runs dry, LRU cache-resident pages are reclaimed and
+    admission proceeds."""
+    eng = make_engine(num_pages=10, max_batch=1)  # 9 usable pages
+    # fill the cache with a 3-page prompt's pages
+    first_prompt = list(range(1, 1 + 3 * PS + 2))
+    eng.generate([first_prompt], max_new_tokens=2)
+    assert eng.prefix_cache.resident_pages() == 3
+    # an unrelated prompt needing 7 pages: 9 - 3 resident = 6 free, so at
+    # least one of the first prompt's cached pages must be reclaimed
+    long_prompt = list(range(100, 100 + 6 * PS))
+    out = eng.generate([long_prompt], max_new_tokens=PS)[0]
+    assert len(out) == PS
+    _, k = eng.prefix_cache.match(first_prompt)
+    assert k < 3 * PS, "eviction should have broken the first chain's tail"
+
+
+def test_engine_flag_off_disables_cache():
+    eng = make_engine(prefix_caching=False)
+    assert eng.prefix_cache is None
+    p = list(range(1, 1 + 2 * PS + 1))
+    a = eng.generate([p], max_new_tokens=3)[0]
+    b = eng.generate([p], max_new_tokens=3)[0]
+    assert a == b
+
+
+def test_level2_wake_invalidates_cache_via_service():
+    """A level-2 sleep discards KV content; after wake the same prompt must
+    NOT hit the (now-stale) prefix chains — it cold-prefills and still
+    produces the original greedy output."""
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64"
+        )
+    )
+    try:
+        prompt = list(range(1, 1 + 2 * 8 + 1))
+        cold = svc.submit(prompt, 3, 0.0).result(timeout=120).out_tokens
+        assert svc.engine.prefix_cache.resident_pages() == 2
+
+        svc.sleep(2)
+        svc.wake_up()
+        assert svc.engine.prefix_cache.resident_pages() == 0
+
+        again = svc.submit(prompt, 3, 0.0).result(timeout=120).out_tokens
+        assert svc.engine.prefix_cache.hits == 0, "stale chain must not match"
+        assert again == cold
+    finally:
+        svc.shutdown()
+
+
+def test_abort_all_clears_cache_and_frees_pages():
+    eng = make_engine()
+    prompt = list(range(1, 1 + 2 * PS + 1))
+    eng.generate([prompt], max_new_tokens=2)
+    assert eng.prefix_cache.resident_pages() == 2
+    free_before = eng.allocator.available
+    eng.abort_all("kv discarded")
+    assert eng.prefix_cache.resident_pages() == 0
+    assert eng.allocator.available == free_before + 2
+    assert eng.prefix_cache.match(prompt) == ([], 0)
+    # post-reset generation is a clean cold run
+    out = eng.generate([prompt], max_new_tokens=2)[0]
+    assert len(out) == 2
