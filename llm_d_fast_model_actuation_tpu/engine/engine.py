@@ -87,6 +87,12 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     out_tokens: List[int] = field(default_factory=list)
+    out_logprobs: List[float] = field(default_factory=list)
+    #: nucleus sampling threshold; >= 1.0 = full distribution
+    top_p: float = 1.0
+    #: stop sequences (token tuples); on match the request finishes and
+    #: the matched sequence is stripped from the output (OpenAI semantics)
+    stop_seqs: tuple = ()
     pages: List[int] = field(default_factory=list)
     pos: int = 0  # tokens in cache
     slot: int = -1
@@ -95,6 +101,8 @@ class Request:
     #: how many of `pages` are shared prefix pages (for registration)
     shared_pages: int = 0
     done: bool = False
+    #: why the request finished: "length" | "stop" (eos or stop sequence)
+    finish_reason: str = ""
     error: Optional[str] = None
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
@@ -166,6 +174,7 @@ class InferenceEngine:
         self._positions = np.zeros((b,), dtype=np.int32)
         self._last_tokens = np.zeros((b,), dtype=np.int32)
         self._temps = np.zeros((b,), dtype=np.float32)
+        self._topps = np.ones((b,), dtype=np.float32)
         self._budgets = np.zeros((b,), dtype=np.int32)
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
@@ -184,7 +193,7 @@ class InferenceEngine:
         model_cfg = m
         self._model_cfg = m
 
-        def _sample_last(logits, lens, temp, raw_key):
+        def _sample_last(logits, lens, temp, topp, raw_key):
             """Shared sampling tail of both prefill programs: take the last
             valid logit, split the key, sample — one definition so the
             cache-hit path can never diverge from the cold one."""
@@ -193,26 +202,32 @@ class InferenceEngine:
             )[:, 0]
             key = jax.random.wrap_key_data(raw_key)
             key, sub = jax.random.split(key)
-            return sample(last, sub, temp), jax.random.key_data(key)
+            tok, lp = sample(last, sub, temp, top_p=topp)
+            return tok, lp, jax.random.key_data(key)
 
-        def _prefill(params, tokens, seq_lens, cache, page_table, temp, raw_key):
+        def _prefill(
+            params, tokens, seq_lens, cache, page_table, temp, topp, raw_key
+        ):
             logits, cache = llama.prefill(
                 params, model_cfg, tokens, seq_lens, cache, page_table
             )
-            tok, raw_key = _sample_last(logits, seq_lens, temp, raw_key)
-            return tok, cache, raw_key
+            tok, lp, raw_key = _sample_last(logits, seq_lens, temp, topp, raw_key)
+            return tok, lp, cache, raw_key
 
         # cache (arg 3) donated: prefill updates pages in place.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
 
         def _suffix_prefill(
-            params, tokens, start, suffix_lens, cache, page_table, temp, raw_key
+            params, tokens, start, suffix_lens, cache, page_table, temp, topp,
+            raw_key,
         ):
             logits, cache = llama.prefill_continue(
                 params, model_cfg, tokens, start, suffix_lens, cache, page_table
             )
-            tok, raw_key = _sample_last(logits, suffix_lens, temp, raw_key)
-            return tok, cache, raw_key
+            tok, lp, raw_key = _sample_last(
+                logits, suffix_lens, temp, topp, raw_key
+            )
+            return tok, lp, cache, raw_key
 
         self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(4,))
         self._chunk_fns: Dict[int, Any] = {}
@@ -223,7 +238,9 @@ class InferenceEngine:
         model_cfg = self._model_cfg
         eos = self.cfg.eos_token_id
 
-        def chunk(params, lt, pos, budget, cache, page_table, temps, raw_key):
+        def chunk(
+            params, lt, pos, budget, cache, page_table, temps, topps, raw_key
+        ):
             key = jax.random.wrap_key_data(raw_key)
 
             def body(carry, _):
@@ -233,22 +250,22 @@ class InferenceEngine:
                     params, model_cfg, lt, pos, cache, page_table, active
                 )
                 key, sub = jax.random.split(key)
-                nxt = sample(logits, sub, temps)
+                nxt, lp = sample(logits, sub, temps, top_p=topps)
                 nxt = jnp.where(active, nxt, lt)
                 a32 = active.astype(jnp.int32)
                 pos = pos + a32
                 budget = budget - a32
                 if eos >= 0:
                     budget = jnp.where(active & (nxt == eos), 0, budget)
-                return (nxt, pos, budget, cache, key), nxt
+                return (nxt, pos, budget, cache, key), (nxt, lp)
 
-            (lt, pos, budget, cache, key), toks = jax.lax.scan(
+            (lt, pos, budget, cache, key), (toks, lps) = jax.lax.scan(
                 body, (lt, pos, budget, cache, key), None, length=T
             )
-            return toks, lt, pos, budget, cache, jax.random.key_data(key)
+            return toks, lps, lt, pos, budget, cache, jax.random.key_data(key)
 
         # donate scheduler state + cache + key data (all replaced each call)
-        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 7))
+        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 8))
 
     def _chunk_fn(self, T: int):
         fn = self._chunk_fns.get(T)
@@ -266,6 +283,7 @@ class InferenceEngine:
             "budget": jax.device_put(self._budgets),
             "pt": jax.device_put(self._page_table),
             "temps": jax.device_put(self._temps),
+            "topp": jax.device_put(self._topps),
         }
         if isinstance(self._raw_key, np.ndarray):
             self._raw_key = jax.device_put(self._raw_key)
@@ -298,6 +316,8 @@ class InferenceEngine:
         prompt: Seq[int],
         max_new_tokens: int = 16,
         temperature: float = 0.0,
+        top_p: float = 1.0,
+        stop_seqs: Seq[Seq[int]] = (),
         on_token: Optional[Callable[[Request, int], None]] = None,
     ) -> int:
         if not prompt:
@@ -318,6 +338,8 @@ class InferenceEngine:
             prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             temperature=temperature,
+            top_p=float(top_p),
+            stop_seqs=tuple(tuple(int(t) for t in s) for s in stop_seqs),
             on_token=on_token,
         )
         self._next_seq_id += 1
@@ -334,11 +356,22 @@ class InferenceEngine:
         slot = self._free_slot()
         if slot is None:
             return False
+        # a blocked request re-attempts every engine step: skip the whole
+        # match+alloc dance until allocator or cache state actually moved
+        state = (
+            self.allocator.available,
+            self.prefix_cache.resident_pages() if self.prefix_cache else 0,
+        )
+        if getattr(req, "_blocked_state", None) == state:
+            return False
         total = len(req.prompt) + req.max_new_tokens
         need = PageAllocator.pages_needed(total, self.cfg.page_size)
         shared: List[int] = []
+        hashes: List[str] = []
         if self.prefix_cache is not None:
-            shared, req.cached_tokens = self.prefix_cache.match(req.prompt)
+            shared, req.cached_tokens, hashes = self.prefix_cache.match(
+                req.prompt
+            )
             # hold the shared pages BEFORE allocating: eviction inside the
             # allocation path must not reclaim what we just matched
             self.prefix_cache.acquire(shared)
@@ -348,15 +381,20 @@ class InferenceEngine:
             if self.prefix_cache is not None and shared:
                 self.allocator.free(self.prefix_cache.release(shared))
             req.cached_tokens = 0
+            req._blocked_state = (
+                self.allocator.available,
+                self.prefix_cache.resident_pages() if self.prefix_cache else 0,
+            )
             return False
         req.pages = shared + own
         req.shared_pages = len(shared)
+        req._prefix_hashes = hashes
         if self.prefix_cache is not None:
             # the sequence's own reference for its non-shared pages (the
             # shared ones were acquired above); hit stats only now that
             # admission actually succeeded
             self.prefix_cache.acquire(own)
-            self.prefix_cache.commit(req.prompt, len(shared))
+            self.prefix_cache.commit(hashes)
         req.slot = slot
         self._slots[slot] = req
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
@@ -388,6 +426,7 @@ class InferenceEngine:
         n = len(req.prompt)
         table = self._page_table[req.slot : req.slot + 1]
         temp = np.asarray([req.temperature], dtype=np.float32)
+        topp = np.asarray([req.top_p], dtype=np.float32)
         if req.cached_tokens > 0:
             # prefix-cache hit: prefill only the suffix; the shared pages
             # already hold the prefix KV (engine/prefix_cache.py)
@@ -400,7 +439,7 @@ class InferenceEngine:
             suffix_lens = np.array([len(suffix)], dtype=np.int32)
             if self.lockstep is not None:
                 self.lockstep.prefill_suffix(req, bucket, k)
-            tok, cache, self._raw_key = self._suffix_prefill_fn(
+            tok, lp, cache, self._raw_key = self._suffix_prefill_fn(
                 self.params,
                 tokens,
                 start,
@@ -408,6 +447,7 @@ class InferenceEngine:
                 self.pool.as_tuple(),
                 table,
                 temp,
+                topp,
                 self._raw_key,
             )
         else:
@@ -417,38 +457,63 @@ class InferenceEngine:
             seq_lens = np.array([n], dtype=np.int32)
             if self.lockstep is not None:
                 self.lockstep.prefill(req, bucket)
-            tok, cache, self._raw_key = self._prefill_fn(
+            tok, lp, cache, self._raw_key = self._prefill_fn(
                 self.params,
                 tokens,
                 seq_lens,
                 self.pool.as_tuple(),
                 table,
                 temp,
+                topp,
                 self._raw_key,
             )
         self.pool.replace(cache)
         if self.prefix_cache is not None:
             # the full prompt pages now hold prompt KV: make them reusable
-            self.prefix_cache.register(req.prompt, req.pages, req.shared_pages)
+            self.prefix_cache.register(
+                req.prompt,
+                req.pages,
+                req.shared_pages,
+                known_hashes=getattr(req, "_prefix_hashes", ()),
+            )
         first = int(np.asarray(tok)[0])
         req.pos = n
-        self._emit(req, first)
+        self._emit(req, first, float(np.asarray(lp)[0]))
         self._positions[req.slot] = req.pos  # position of the token to place
         self._last_tokens[req.slot] = first
         self._temps[req.slot] = req.temperature
+        self._topps[req.slot] = req.top_p
         self._budgets[req.slot] = req.max_new_tokens - len(req.out_tokens)
         self._dirty = True
 
-    def _emit(self, req: Request, token: int) -> None:
+    def _emit(self, req: Request, token: int, logprob: float = 0.0) -> None:
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         req.out_tokens.append(token)
-        if (
-            len(req.out_tokens) >= req.max_new_tokens
-            or token == self.cfg.eos_token_id
-        ):
-            req.done = True
-        if req.on_token is not None:
+        req.out_logprobs.append(logprob)
+        stop_matched = False
+        for seq in req.stop_seqs:
+            if len(req.out_tokens) >= len(seq) and tuple(
+                req.out_tokens[-len(seq):]
+            ) == seq:
+                # OpenAI semantics: finish on the stop sequence and strip it
+                del req.out_tokens[-len(seq):]
+                del req.out_logprobs[-len(seq):]
+                req.done = True
+                req.finish_reason = "stop"
+                stop_matched = True
+                break
+        if not req.done:
+            if token == self.cfg.eos_token_id:
+                req.done = True
+                req.finish_reason = "stop"
+            elif len(req.out_tokens) >= req.max_new_tokens:
+                req.done = True
+                req.finish_reason = "length"
+        # the matched stop token is stripped from the output, so it must
+        # not be streamed either (earlier tokens of a multi-token stop were
+        # already streamed — the standard streaming caveat)
+        if req.on_token is not None and not stop_matched:
             req.on_token(req, token)
 
     def _retire(self, req: Request) -> None:
@@ -460,6 +525,8 @@ class InferenceEngine:
         self._page_table[req.slot] = 0
         self._positions[req.slot] = 0
         self._last_tokens[req.slot] = 0
+        self._temps[req.slot] = 0.0
+        self._topps[req.slot] = 1.0
         self._budgets[req.slot] = 0
         req.slot = -1
         self._dirty = True
@@ -502,29 +569,33 @@ class InferenceEngine:
             if reupload:
                 self._upload_sched()
             d = self._dev
-            toks_dev, lt, pos, budget, cache, self._raw_key = self._chunk_fn(T)(
-                self.params,
-                d["lt"],
-                d["pos"],
-                d["budget"],
-                self.pool.as_tuple(),
-                d["pt"],
-                d["temps"],
-                self._raw_key,
+            toks_dev, lps_dev, lt, pos, budget, cache, self._raw_key = (
+                self._chunk_fn(T)(
+                    self.params,
+                    d["lt"],
+                    d["pos"],
+                    d["budget"],
+                    self.pool.as_tuple(),
+                    d["pt"],
+                    d["temps"],
+                    d["topp"],
+                    self._raw_key,
+                )
             )
             self.pool.replace(cache)
             self._dev = {
                 "lt": lt, "pos": pos, "budget": budget,
-                "pt": d["pt"], "temps": d["temps"],
+                "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
             }
             toks = np.asarray(toks_dev)  # ONE host sync per chunk
+            lps = np.asarray(lps_dev)
             for t in range(T):
                 for slot, req in list(running.items()):
                     tok = int(toks[t, slot])
                     req.pos += 1
                     self._positions[slot] = req.pos
                     self._last_tokens[slot] = tok
-                    self._emit(req, tok)
+                    self._emit(req, tok, float(lps[t, slot]))
                     # keep the budget mirror exact: a dirty re-upload with a
                     # stale budget would un-freeze finished slots on device
                     self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)

@@ -60,6 +60,7 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         #: suffix prefill: absolute position of the first suffix token
         "start": np.zeros((), np.int32),
         "temp": np.zeros((), np.float32),
+        "top_p": np.ones((), np.float32),
         "tokens": np.zeros((cfg.seq_len,), np.int32),
         #: chunk: rebuild device scheduler state from the mirrors below
         "reupload": np.zeros((), np.int32),
@@ -67,6 +68,7 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         "pos": np.zeros((b,), np.int32),
         "budget": np.zeros((b,), np.int32),
         "temps": np.zeros((b,), np.float32),
+        "topps": np.ones((b,), np.float32),
         "page_table": np.zeros((b, p), np.int32),
     }
 
@@ -92,6 +94,7 @@ class LockstepLeader:
         f["pos"] = e._positions.copy()
         f["budget"] = e._budgets.copy()
         f["temps"] = e._temps.copy()
+        f["topps"] = e._topps.copy()
         f["page_table"] = e._page_table.copy()
 
     def _send(self, **fields: Any) -> None:
@@ -112,6 +115,7 @@ class LockstepLeader:
             arg2=req.slot,
             seq_len=len(req.prompt),
             temp=req.temperature,
+            top_p=req.top_p,
             tokens=tokens,
         )
 
@@ -126,6 +130,7 @@ class LockstepLeader:
             seq_len=len(suffix),
             start=start,
             temp=req.temperature,
+            top_p=req.top_p,
             tokens=tokens,
         )
 
@@ -173,6 +178,7 @@ def _sync_mirrors(engine: Any, f: Dict[str, np.ndarray]) -> None:
     engine._positions[:] = f["pos"]
     engine._budgets[:] = f["budget"]
     engine._temps[:] = f["temps"]
+    engine._topps[:] = f["topps"]
     engine._page_table[:] = f["page_table"]
 
 
@@ -186,13 +192,15 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
     seq_lens = np.array([n], np.int32)
     table = engine._page_table[slot : slot + 1]
     temp = np.asarray([float(f["temp"])], np.float32)
-    _tok, cache, engine._raw_key = engine._prefill_fn(
+    topp = np.asarray([float(f["top_p"])], np.float32)
+    _tok, _lp, cache, engine._raw_key = engine._prefill_fn(
         engine.params,
         tokens,
         seq_lens,
         engine.pool.as_tuple(),
         table,
         temp,
+        topp,
         engine._raw_key,
     )
     engine.pool.replace(cache)
@@ -210,7 +218,8 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
     suffix_lens = np.array([n], np.int32)
     table = engine._page_table[slot : slot + 1]
     temp = np.asarray([float(f["temp"])], np.float32)
-    _tok, cache, engine._raw_key = engine._suffix_prefill_fn(
+    topp = np.asarray([float(f["top_p"])], np.float32)
+    _tok, _lp, cache, engine._raw_key = engine._suffix_prefill_fn(
         engine.params,
         tokens,
         start,
@@ -218,6 +227,7 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
         engine.pool.as_tuple(),
         table,
         temp,
+        topp,
         engine._raw_key,
     )
     engine.pool.replace(cache)
@@ -229,7 +239,7 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         _sync_mirrors(engine, f)
         engine._upload_sched()
     d = engine._dev
-    _toks, lt, pos, budget, cache, engine._raw_key = engine._chunk_fn(T)(
+    _toks, _lps, lt, pos, budget, cache, engine._raw_key = engine._chunk_fn(T)(
         engine.params,
         d["lt"],
         d["pos"],
@@ -237,10 +247,11 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         engine.pool.as_tuple(),
         d["pt"],
         d["temps"],
+        d["topp"],
         engine._raw_key,
     )
     engine.pool.replace(cache)
     engine._dev = {
         "lt": lt, "pos": pos, "budget": budget,
-        "pt": d["pt"], "temps": d["temps"],
+        "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
     }

@@ -62,19 +62,24 @@ class PrefixCache:
 
     # -- matching ------------------------------------------------------------
 
-    def match(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+    def match(
+        self, prompt: Sequence[int]
+    ) -> Tuple[List[int], int, List[str]]:
         """Longest cached page chain for `prompt`. PURE: no stats, no LRU
         bumps — a matched request can still fail admission (OutOfPages)
         and retry every engine step; only `commit` (called once admission
         succeeded) records the hit.
 
-        Returns (shared_page_ids, cached_token_count). Never matches the
-        whole prompt — at least one token must remain to prefill (the
-        query that produces the first sampled logits).
+        Returns (shared_page_ids, cached_token_count, chain_hashes); the
+        hashes feed `commit`/`register` so the chain is hashed once, not
+        three times. Never matches the whole prompt — at least one token
+        must remain to prefill (the query that produces the first sampled
+        logits).
         """
         ps = self.page_size
         full_pages = (len(prompt) - 1) // ps  # leave >= 1 token to prefill
         pages: List[int] = []
+        hashes: List[str] = []
         parent = ""
         for i in range(full_pages):
             h = _chunk_hash(parent, prompt[i * ps : (i + 1) * ps])
@@ -82,27 +87,24 @@ class PrefixCache:
             if e is None:
                 break
             pages.append(e.page_id)
+            hashes.append(h)
             parent = h
-        return pages, len(pages) * ps
+        return pages, len(pages) * ps, hashes
 
-    def commit(self, prompt: Sequence[int], n_pages: int) -> None:
+    def commit(self, hashes: Sequence[str]) -> None:
         """Record an admitted hit: stats + LRU recency for the matched
-        chain's first `n_pages` entries."""
+        chain entries (`hashes` from the `match` that admitted)."""
         self.lookups += 1
-        if n_pages <= 0:
+        if not hashes:
             return
         self.hits += 1
-        self.hit_tokens += n_pages * self.page_size
-        ps = self.page_size
-        parent = ""
+        self.hit_tokens += len(hashes) * self.page_size
         self._clock += 1
-        for i in range(n_pages):
-            h = _chunk_hash(parent, prompt[i * ps : (i + 1) * ps])
+        for h in hashes:
             e = self._by_hash.get(h)
             if e is None:
                 break
             e.last_used = self._clock
-            parent = h
 
     def acquire(self, page_ids: Sequence[int]) -> None:
         """A sequence starts referencing shared pages."""
@@ -116,20 +118,25 @@ class PrefixCache:
         prompt: Sequence[int],
         page_ids: Sequence[int],
         shared_count: int,
+        known_hashes: Sequence[str] = (),
     ) -> None:
         """Insert this sequence's FULL prompt pages into the index.
 
         `page_ids` is the sequence's page-table order (shared prefix pages
-        first); the first `shared_count` pages are already cached. Pages
-        receiving generated tokens later (anything past the last full
-        prompt page) are never registered.
+        first); the first `shared_count` pages are already cached (their
+        chain hashes may be passed via `known_hashes` to skip re-hashing).
+        Pages receiving generated tokens later (anything past the last
+        full prompt page) are never registered.
         """
         ps = self.page_size
         full_pages = len(prompt) // ps
         parent = ""
         self._clock += 1
         for i in range(full_pages):
-            h = _chunk_hash(parent, prompt[i * ps : (i + 1) * ps])
+            if i < len(known_hashes):
+                h = known_hashes[i]
+            else:
+                h = _chunk_hash(parent, prompt[i * ps : (i + 1) * ps])
             e = self._by_hash.get(h)
             if e is None:
                 if i < shared_count:
@@ -172,22 +179,44 @@ class PrefixCache:
 
     def evict(self, want_pages: int) -> List[int]:
         """Drop up to `want_pages` LRU leaf entries whose pages are only
-        cache-referenced; returns the page ids now free for reuse."""
+        cache-referenced; returns the page ids now free for reuse.
+
+        One scan builds the initial leaf heap; parents that become leaves
+        as their children go are pushed lazily, so an m-page eviction over
+        an n-entry index is O(n + m log n), not O(n*m)."""
+        import heapq
+
         freed: List[int] = []
-        while len(freed) < want_pages:
-            candidates = [
-                e
-                for e in self._by_hash.values()
-                if e.children == 0 and self._refs.get(e.page_id, 0) == 1
-            ]
-            if not candidates:
-                break
-            victim = min(candidates, key=lambda e: e.last_used)
-            del self._by_hash[victim.chain_hash]
-            if victim.parent_hash and victim.parent_hash in self._by_hash:
-                self._by_hash[victim.parent_hash].children -= 1
-            del self._refs[victim.page_id]
-            freed.append(victim.page_id)
+        heap = [
+            (e.last_used, e.chain_hash)
+            for e in self._by_hash.values()
+            if e.children == 0 and self._refs.get(e.page_id, 0) == 1
+        ]
+        heapq.heapify(heap)
+        while heap and len(freed) < want_pages:
+            _, h = heapq.heappop(heap)
+            e = self._by_hash.get(h)
+            # stale heap entries: re-check eligibility at pop time
+            if (
+                e is None
+                or e.children != 0
+                or self._refs.get(e.page_id, 0) != 1
+            ):
+                continue
+            del self._by_hash[h]
+            del self._refs[e.page_id]
+            freed.append(e.page_id)
+            if e.parent_hash:
+                parent = self._by_hash.get(e.parent_hash)
+                if parent is not None:
+                    parent.children -= 1
+                    if (
+                        parent.children == 0
+                        and self._refs.get(parent.page_id, 0) == 1
+                    ):
+                        heapq.heappush(
+                            heap, (parent.last_used, parent.chain_hash)
+                        )
         return freed
 
     def clear(self) -> List[int]:

@@ -1,24 +1,52 @@
 """Token sampling, in-jit (no host round-trip per step).
 
-Greedy when temperature == 0 (selected with `lax.cond`-free arithmetic so the
-same compiled fn serves both; temperature is a traced scalar)."""
+Greedy when temperature == 0 (selected with `lax.cond`-free arithmetic so
+the same compiled fn serves both; temperature is a traced scalar).
+Per-request nucleus (top-p) sampling runs over the top-`candidates`
+logits — the standard serving approximation (p mass outside the top 64
+is negligible for real models) — selected per row by `top_p < 1`, again
+branch-free. The sampled token's logprob (full-vocab normalized) is
+returned alongside, so the API can serve OpenAI `logprobs` for free.
+"""
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
+#: nucleus sampling truncates to this many candidates before the cumsum
+TOP_P_CANDIDATES = 64
+
 
 def sample(
     logits: jnp.ndarray,  # [b, vocab] fp32
     key: jax.Array,
     temperature: jnp.ndarray,  # [b] fp32; 0 = greedy
+    top_p: "jnp.ndarray | None" = None,  # [b] fp32; >= 1 = full distribution
     top_k: int = 0,  # static; 0 = no truncation
-) -> jnp.ndarray:
+):
+    """Returns (token [b] int32, logprob [b] fp32 of the chosen token)."""
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    norm = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
     greedy = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(temperature, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, logits / t, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    key_full, key_nuc = jax.random.split(key)
+    sampled = jax.random.categorical(key_full, logits / t, axis=-1)
+    if top_p is not None:
+        c = min(TOP_P_CANDIDATES, logits.shape[-1])
+        vals, idx = jax.lax.top_k(logits, c)  # [b, c] descending
+        # nucleus membership over the TEMPERED distribution (OpenAI/vLLM
+        # order: temperature first, then top-p truncation)
+        probs = jax.nn.softmax(vals / t, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose PRECEDING mass is < p (the first is always kept)
+        keep = (csum - probs) < top_p[:, None]
+        masked = jnp.where(keep, vals, -jnp.inf)
+        choice = jax.random.categorical(key_nuc, masked / t, axis=-1)
+        nucleus = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+        sampled = jnp.where(top_p < 1.0, nucleus, sampled)
+    tok = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    lp = jnp.take_along_axis(norm, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return tok, lp

@@ -12,7 +12,8 @@ contract is engine-agnostic and matches what the dual-pods controller speaks
 
 Inference:
   POST /v1/completions       {"prompt": str | [int], "max_tokens",
-                              "temperature", "stream": bool}
+                              "temperature", "top_p", "stop",
+                              "logprobs", "stream"}
   POST /v1/chat/completions  {"messages": [{role, content}...], ...}
   GET  /v1/models
 
@@ -396,12 +397,14 @@ class EngineService:
                     self._drain_aborts()
                     if not self.sleeper.is_sleeping:
                         while self._pending:
-                            prompt, max_tokens, temperature, fut, on_token = (
-                                self._pending.pop(0)
-                            )
+                            (
+                                prompt, max_tokens, temperature, fut,
+                                on_token, top_p, stop_seqs,
+                            ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
                                     prompt, max_tokens, temperature,
+                                    top_p=top_p, stop_seqs=stop_seqs,
                                     on_token=on_token,
                                 )
                                 self._futures[seq_id] = fut
@@ -456,7 +459,8 @@ class EngineService:
             self.failure = f"{type(e).__name__}: {e}"
 
     def _fail_all(self, exc: Exception) -> None:
-        for _, _, _, fut, _ in self._pending:
+        for entry in self._pending:
+            fut = entry[3]
             if not fut.done():
                 fut.set_exception(exc)
         self._pending.clear()
@@ -480,6 +484,8 @@ class EngineService:
         max_tokens: int,
         temperature: float,
         on_token: Optional[Any] = None,
+        top_p: float = 1.0,
+        stop_seqs: Any = (),
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -496,7 +502,9 @@ class EngineService:
         if self.failure is not None:
             fut.set_exception(RuntimeError(self.failure))
             return fut
-        self._pending.append((prompt, max_tokens, temperature, fut, on_token))
+        self._pending.append(
+            (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs)
+        )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
         return fut
@@ -652,6 +660,10 @@ def _detok(tokens: List[int]) -> str:
 
 
 def _finish_reason(service: "EngineService", req: Any) -> str:
+    # the engine records why it finished (eos/stop-sequence vs budget);
+    # fall back to the legacy eos check for requests that predate it
+    if getattr(req, "finish_reason", ""):
+        return req.finish_reason
     eos = service.engine.cfg.eos_token_id
     return (
         "stop" if req.out_tokens and req.out_tokens[-1] == eos else "length"
@@ -716,12 +728,40 @@ def build_app(service: EngineService) -> web.Application:
             content_type="text/plain",
         )
 
+    def _parse_stop(stop: Any) -> tuple:
+        """OpenAI `stop`: a string, a list of strings, or token-id lists.
+        Malformed values must surface as ValueError (-> HTTP 400)."""
+        if stop is None:
+            return ()
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list):
+            raise ValueError("stop must be a string or a list")
+        seqs = []
+        for s in stop:
+            if isinstance(s, str):
+                seqs.append(tuple(t % vocab for t in s.encode("utf-8")))
+            elif isinstance(s, int):
+                seqs.append((s % vocab,))
+            elif isinstance(s, list):
+                try:
+                    seqs.append(tuple(int(t) % vocab for t in s))
+                except (TypeError, ValueError) as e:
+                    raise ValueError(f"invalid stop token list {s!r}") from e
+            else:
+                raise ValueError(f"invalid stop entry {s!r}")
+        return tuple(s for s in seqs if s)
+
     def _parse_generation(body: Dict[str, Any], tokens: List[int]):
         tokens = [t % vocab for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
         max_tokens = int(body.get("max_tokens", 16))
         temperature = float(body.get("temperature", 0.0))
+        top_p = float(body.get("top_p", 1.0))
+        if not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        stop_seqs = _parse_stop(body.get("stop"))
         # pre-validate everything add_request would reject, so streaming
         # requests fail with a 400 instead of an SSE error after headers
         # are out
@@ -741,13 +781,15 @@ def build_app(service: EngineService) -> web.Application:
                 f"request needs {need} pages but the pool only has "
                 f"{cfg.num_pages - 1}"
             )
-        return tokens, max_tokens, temperature
+        return tokens, max_tokens, temperature, top_p, stop_seqs
 
     async def _stream_sse(
         request: web.Request,
         tokens: List[int],
         max_tokens: int,
         temperature: float,
+        top_p: float,
+        stop_seqs: tuple,
         make_chunk,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
@@ -760,7 +802,10 @@ def build_app(service: EngineService) -> web.Application:
         def on_token(req, tok: int) -> None:
             loop.call_soon_threadsafe(q.put_nowait, (tok, req.done))
 
-        fut = service.submit(tokens, max_tokens, temperature, on_token=on_token)
+        fut = service.submit(
+            tokens, max_tokens, temperature, on_token=on_token,
+            top_p=top_p, stop_seqs=stop_seqs,
+        )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
             headers={
@@ -828,8 +873,8 @@ def build_app(service: EngineService) -> web.Application:
         except Exception:
             raise web.HTTPBadRequest(text="invalid JSON body")
         try:
-            tokens, max_tokens, temperature = _parse_generation(
-                body, _tokenize(body.get("prompt"))
+            tokens, max_tokens, temperature, top_p, stop_seqs = (
+                _parse_generation(body, _tokenize(body.get("prompt")))
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -845,29 +890,37 @@ def build_app(service: EngineService) -> web.Application:
                 }
 
             return await _stream_sse(
-                request, tokens, max_tokens, temperature, chunk
+                request, tokens, max_tokens, temperature, top_p, stop_seqs,
+                chunk,
             )
 
         req = await _await_generation(
-            service.submit(tokens, max_tokens, temperature)
+            service.submit(
+                tokens, max_tokens, temperature,
+                top_p=top_p, stop_seqs=stop_seqs,
+            )
         )
         ttft = (
             (req.first_token_time - req.submit_time)
             if req.first_token_time
             else None
         )
+        choice = {
+            "index": 0,
+            "token_ids": req.out_tokens,
+            "text": _detok(req.out_tokens),
+            "finish_reason": _finish_reason(service, req),
+        }
+        if body.get("logprobs"):
+            choice["logprobs"] = {
+                "tokens": req.out_tokens,
+                "token_logprobs": req.out_logprobs,
+            }
         return web.json_response(
             {
                 "object": "text_completion",
                 "model": service.args.model,
-                "choices": [
-                    {
-                        "index": 0,
-                        "token_ids": req.out_tokens,
-                        "text": _detok(req.out_tokens),
-                        "finish_reason": _finish_reason(service, req),
-                    }
-                ],
+                "choices": [choice],
                 "usage": {
                     "prompt_tokens": len(tokens),
                     "completion_tokens": len(req.out_tokens),
@@ -882,8 +935,8 @@ def build_app(service: EngineService) -> web.Application:
         except Exception:
             raise web.HTTPBadRequest(text="invalid JSON body")
         try:
-            tokens, max_tokens, temperature = _parse_generation(
-                body, _chat_prompt(body.get("messages"))
+            tokens, max_tokens, temperature, top_p, stop_seqs = (
+                _parse_generation(body, _chat_prompt(body.get("messages")))
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -900,11 +953,15 @@ def build_app(service: EngineService) -> web.Application:
                 }
 
             return await _stream_sse(
-                request, tokens, max_tokens, temperature, chunk
+                request, tokens, max_tokens, temperature, top_p, stop_seqs,
+                chunk,
             )
 
         req = await _await_generation(
-            service.submit(tokens, max_tokens, temperature)
+            service.submit(
+                tokens, max_tokens, temperature,
+                top_p=top_p, stop_seqs=stop_seqs,
+            )
         )
         return web.json_response(
             {

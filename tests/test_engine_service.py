@@ -308,3 +308,95 @@ def test_engine_serving_metrics_are_exercised(service):
     assert val("fma_engine_time_to_first_token_seconds_count") >= 1
     assert val("fma_engine_request_seconds_count") >= 1
     assert val("fma_engine_kv_cache_usage_ratio") is not None
+
+
+def test_sampling_top_p_stop_and_logprobs():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_d_fast_model_actuation_tpu.engine.sampling import sample
+
+    logits = jnp.log(
+        jnp.asarray([[0.6, 0.3, 0.05, 0.03, 0.02]], dtype=jnp.float32)
+    )
+    # greedy: temperature 0 picks argmax and reports its true logprob
+    tok, lp = sample(
+        logits, jax.random.key(0), jnp.zeros((1,)), top_p=jnp.ones((1,))
+    )
+    assert int(tok[0]) == 0
+    assert np.isclose(float(lp[0]), float(jnp.log(0.6)), atol=1e-5)
+
+    # top_p=0.5: only token 0 survives nucleus truncation, at any temp
+    for seed in range(5):
+        tok, _ = sample(
+            logits,
+            jax.random.key(seed),
+            jnp.ones((1,)),
+            top_p=jnp.asarray([0.5]),
+        )
+        assert int(tok[0]) == 0
+    # top_p=0.95 at high temp can pick beyond token 0
+    seen = {
+        int(
+            sample(
+                logits,
+                jax.random.key(s),
+                jnp.full((1,), 5.0),
+                top_p=jnp.asarray([0.95]),
+            )[0][0]
+        )
+        for s in range(30)
+    }
+    assert len(seen) > 1
+
+
+def test_stop_sequences_and_logprobs_over_http(service):
+    async def scenario(client):
+        # learn what the model emits greedily
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 6, "logprobs": True},
+        )
+        body = await r.json()
+        toks = body["choices"][0]["token_ids"]
+        lps = body["choices"][0]["logprobs"]["token_logprobs"]
+        assert len(lps) == len(toks) == 6
+        assert all(lp <= 0.0 for lp in lps)
+
+        # stop on the first emitted token: it is stripped (OpenAI
+        # semantics) so the output is empty with finish_reason length/stop
+        r = await client.post(
+            "/v1/completions",
+            json={
+                "prompt": [1, 2, 3],
+                "max_tokens": 6,
+                "stop": [[toks[0]]],
+            },
+        )
+        body = await r.json()
+        assert body["choices"][0]["token_ids"] == []
+
+        # a stop sequence that never occurs leaves the output untouched
+        absent = (toks[0] + 1) % 256 or 1
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 6, "stop": [[absent]]},
+        )
+        body = await r.json()
+        assert body["choices"][0]["token_ids"] == toks
+
+        # top_p validation
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 2, "top_p": 1.5},
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 2, "top_p": 0.9,
+                  "temperature": 0.8},
+        )
+        assert r.status == 200
+
+    run_async(_client(service, scenario))

@@ -35,14 +35,14 @@ def test_match_register_release_lifecycle():
     pc = PrefixCache(page_size=4)
     prompt = list(range(11))  # 2 full pages + 3 tail tokens
 
-    assert pc.match(prompt) == ([], 0)
+    assert pc.match(prompt)[:2] == ([], 0)
     # a sequence with pages [10, 11, 12]: acquire + register
     pc.acquire([10, 11, 12])
     pc.register(prompt, [10, 11, 12], shared_count=0)
     assert pc.resident_pages() == 2  # only the 2 FULL prompt pages
 
-    pages, k = pc.match(prompt)
-    assert pages == [10, 11] and k == 8
+    pages, k, hashes = pc.match(prompt)
+    assert pages == [10, 11] and k == 8 and len(hashes) == 2
 
     # retire the owning sequence: registered pages stay resident
     freed = pc.release([10, 11, 12])
@@ -51,9 +51,9 @@ def test_match_register_release_lifecycle():
 
     # eviction unwinds from the chain tail (leaf first)
     assert pc.evict(1) == [11]
-    assert pc.match(prompt) == ([10], 4)
+    assert pc.match(prompt)[:2] == ([10], 4)
     assert pc.evict(5) == [10]
-    assert pc.match(prompt) == ([], 0)
+    assert pc.match(prompt)[:2] == ([], 0)
 
 
 def test_match_never_consumes_whole_prompt():
@@ -64,7 +64,7 @@ def test_match_never_consumes_whole_prompt():
     pc.release([1, 2])
     # both pages cached, but a page-aligned prompt must keep its last
     # page's worth to prefill (the sampling query)
-    pages, k = pc.match(prompt)
+    pages, k, _ = pc.match(prompt)
     assert pages == [1] and k == 4
 
 
@@ -145,7 +145,7 @@ def test_eviction_under_page_pressure():
     long_prompt = list(range(100, 100 + 6 * PS))
     out = eng.generate([long_prompt], max_new_tokens=PS)[0]
     assert len(out) == PS
-    _, k = eng.prefix_cache.match(first_prompt)
+    _, k, _ = eng.prefix_cache.match(first_prompt)
     assert k < 3 * PS, "eviction should have broken the first chain's tail"
 
 
@@ -198,7 +198,7 @@ def test_abort_all_clears_cache_and_frees_pages():
     eng.abort_all("kv discarded")
     assert eng.prefix_cache.resident_pages() == 0
     assert eng.allocator.available == free_before + 2
-    assert eng.prefix_cache.match(prompt) == ([], 0)
+    assert eng.prefix_cache.match(prompt)[:2] == ([], 0)
     # post-reset generation is a clean cold run
     out = eng.generate([prompt], max_new_tokens=2)[0]
     assert len(out) == 2
