@@ -99,6 +99,8 @@ MODEL_CONFIGS = {
     "llama3-70b": llama.LlamaConfig.llama3_70b,
     "tiny-moe": MoeConfig.tiny_moe,
     "mixtral-8x7b": MoeConfig.mixtral_8x7b,
+    "tiny-gemma": llama.LlamaConfig.tiny_gemma,
+    "gemma3-4b": llama.LlamaConfig.gemma3_4b,
     "qwen2-7b": lambda: llama.LlamaConfig(
         vocab_size=152064,
         hidden_size=3584,
@@ -756,9 +758,14 @@ def build_app(service: EngineService) -> web.Application:
         tokens = [t % vocab for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
-        max_tokens = int(body.get("max_tokens", 16))
-        temperature = float(body.get("temperature", 0.0))
-        top_p = float(body.get("top_p", 1.0))
+        try:
+            max_tokens = int(body.get("max_tokens") or 16)
+            temperature = float(body.get("temperature") or 0.0)
+            top_p = float(
+                1.0 if body.get("top_p") is None else body.get("top_p")
+            )
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"invalid generation parameter: {e}")
         if not (0.0 < top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         stop_seqs = _parse_stop(body.get("stop"))

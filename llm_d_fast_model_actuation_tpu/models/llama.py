@@ -52,6 +52,54 @@ class LlamaConfig:
     #: Weight-only quantization: "" (bf16) or "int8" (W8A16 per-output-
     #: channel, models/quant.py) — halves decode's weight-read bytes.
     quantization: str = ""
+    # -- Gemma-family knobs (llama-neutral defaults; one shared forward) --
+    #: MLP gate activation: "silu" (llama/mixtral) or "gelu" (gemma GeGLU)
+    hidden_activation: str = "silu"
+    #: RMSNorm weight offset: 0.0 (llama) or 1.0 (gemma's (1+w) convention)
+    norm_offset: float = 0.0
+    #: sandwich norms: normalize attention/FFN outputs before the residual
+    post_norms: bool = False
+    #: scale embeddings by sqrt(hidden_size) (gemma)
+    embed_scale: bool = False
+    #: per-head RMSNorm on q and k before RoPE (gemma-3 style)
+    qk_norm: bool = False
+
+    @classmethod
+    def tiny_gemma(cls, vocab: int = 256) -> "LlamaConfig":
+        """Gemma-3-style tiny config: GeGLU, (1+w) norms, sandwich norms,
+        scaled embeddings, QK-norm, tied embeddings."""
+        base = cls.tiny(vocab)
+        import dataclasses
+
+        return dataclasses.replace(
+            base,
+            hidden_activation="gelu",
+            norm_offset=1.0,
+            post_norms=True,
+            embed_scale=True,
+            qk_norm=True,
+            tie_embeddings=True,
+        )
+
+    @classmethod
+    def gemma3_4b(cls) -> "LlamaConfig":
+        return cls(
+            vocab_size=262144,
+            hidden_size=2560,
+            num_layers=34,
+            num_heads=8,
+            num_kv_heads=4,
+            head_dim=256,
+            intermediate_size=10240,
+            rope_theta=1e6,
+            max_seq_len=32768,
+            tie_embeddings=True,
+            hidden_activation="gelu",
+            norm_offset=1.0,
+            post_norms=True,
+            embed_scale=True,
+            qk_norm=True,
+        )
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -98,6 +146,10 @@ class LlamaConfig:
             + self.q_dim * self.hidden_size
             + 3 * self.hidden_size * self.intermediate_size
         )
+        if self.post_norms:
+            per_layer += 2 * self.hidden_size
+        if self.qk_norm:
+            per_layer += 2 * self.head_dim
         head = 0 if self.tie_embeddings else self.hidden_size * self.vocab_size
         return (
             self.vocab_size * self.hidden_size
@@ -114,7 +166,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
     h, L = cfg.hidden_size, cfg.num_layers
 
     def norm_init(shape):
-        return jnp.ones(shape, dtype=cfg.dtype)
+        # Gemma's (1+w) convention stores zero-centered weights: identity
+        # norm is w=0 there, w=1 for the plain convention
+        fill = 0.0 if cfg.norm_offset else 1.0
+        return jnp.full(shape, fill, dtype=cfg.dtype)
 
     def dense_init(key, shape, fan_in):
         return (
@@ -133,6 +188,12 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
         "w_up": dense_init(ks[5], (L, h, cfg.intermediate_size), h),
         "w_down": dense_init(ks[6], (L, cfg.intermediate_size, h), cfg.intermediate_size),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = norm_init((L, h))
+        layers["post_ffn_norm"] = norm_init((L, h))
+    if cfg.qk_norm:
+        layers["q_norm"] = norm_init((L, cfg.head_dim))
+        layers["k_norm"] = norm_init((L, cfg.head_dim))
     params = {
         "embed": dense_init(k_embed, (cfg.vocab_size, h), h),
         "layers": layers,
@@ -156,6 +217,12 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
         "w_up": ("layers", "embed", "mlp"),
         "w_down": ("layers", "mlp", "embed"),
     }
+    if cfg.post_norms:
+        layers["post_attn_norm"] = ("layers", "embed")
+        layers["post_ffn_norm"] = ("layers", "embed")
+    if cfg.qk_norm:
+        layers["q_norm"] = ("layers", None)
+        layers["k_norm"] = ("layers", None)
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -169,10 +236,32 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
 # -- forward -----------------------------------------------------------------
 
 
-def _mlp(x, gate, up, down):
+def _norm(cfg: "LlamaConfig", x, w):
+    return rms_norm(x, w, cfg.rms_eps, offset=cfg.norm_offset)
+
+
+def _post(cfg: "LlamaConfig", lp, name: str, y):
+    """Sandwich (post) norm on a block output, when the family has them."""
+    if cfg.post_norms:
+        return _norm(cfg, y, lp[name])
+    return y
+
+
+def _embed_tokens(cfg: "LlamaConfig", params, tokens):
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, cfg.dtype)
+    return x
+
+
+def _mlp(cfg, x, gate, up, down):
     g = qmat(x, gate)
     u = qmat(x, up)
-    return qmat((jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype) * u), down)
+    if cfg.hidden_activation == "gelu":
+        a = jax.nn.gelu(g.astype(jnp.float32), approximate=True)
+    else:
+        a = jax.nn.silu(g.astype(jnp.float32))
+    return qmat((a.astype(x.dtype) * u), down)
 
 
 def _ffn(cfg: "LlamaConfig", lp, x):
@@ -181,7 +270,7 @@ def _ffn(cfg: "LlamaConfig", lp, x):
         from .moe import moe_ffn
 
         return moe_ffn(cfg, lp, x)
-    return _mlp(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return _mlp(cfg, x, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
 def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
@@ -190,6 +279,10 @@ def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
     q = qmat(x, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
     k = qmat(x, lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     v = qmat(x, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        # per-head RMSNorm before RoPE (gemma-3 convention)
+        q = rms_norm(q, lp["q_norm"], cfg.rms_eps, offset=cfg.norm_offset)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_eps, offset=cfg.norm_offset)
     q = apply_rope(q, positions, cos_tab, sin_tab)
     k = apply_rope(k, positions, cos_tab, sin_tab)
     return q, k, v
@@ -240,24 +333,24 @@ def prefill(
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     valid = positions < seq_lens[:, None]
 
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_tokens(cfg, params, tokens)
 
     def layer(x, scanned):
         lp, kp, vp = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        h = _norm(cfg, x, lp["attn_norm"])
         q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
         kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
         vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
         attn = causal_prefill_attention(q, k, v, seq_lens, impl=cfg.attention_impl)
-        x = x + qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _ffn(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_attn_norm", qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"]))
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages)
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)
@@ -292,24 +385,24 @@ def prefill_continue(
     )
     valid = jnp.arange(s, dtype=jnp.int32)[None, :] < suffix_lens[:, None]
 
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed_tokens(cfg, params, tokens)
 
     def layer(x, scanned):
         lp, kp, vp = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        h = _norm(cfg, x, lp["attn_norm"])
         q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
         kp = _scatter_prefill(kp, k, page_table, positions, valid, page_size)
         vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
         attn = paged_suffix_attention(q, kp, vp, page_table, start)
-        x = x + qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _ffn(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_attn_norm", qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"]))
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages)
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)
@@ -351,11 +444,11 @@ def decode_step(
     num_pages = k_pages.shape[1]
     cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
 
-    x = params["embed"][tokens].astype(cfg.dtype)  # [b, h]
+    x = _embed_tokens(cfg, params, tokens)  # [b, h]
 
     def layer(x, scanned):
         lp, kp, vp = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        h = _norm(cfg, x, lp["attn_norm"])
         q, k, v = _project_qkv(
             cfg, lp, h[:, None, :], positions[:, None], cos_tab, sin_tab
         )
@@ -363,9 +456,9 @@ def decode_step(
         attn = paged_decode_attention_inline(
             q, kp, vp, k, v, page_table, positions, impl=cfg.attention_impl
         )
-        x = x + qmat(attn.reshape(b, cfg.q_dim), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _ffn(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_attn_norm", qmat(attn.reshape(b, cfg.q_dim), lp["wo"]))
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(
@@ -385,7 +478,7 @@ def decode_step(
     new_k = k_pages.at[li, pi, si].set(k_all.reshape(flat), mode="drop")
     new_v = v_pages.at[li, pi, si].set(v_all.reshape(flat), mode="drop")
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)
@@ -413,11 +506,11 @@ def _decode_step_scatter_first(
         # masking the table also keeps their (ignored) reads harmless.
         table = jnp.where(active[:, None], page_table, num_pages)
 
-    x = params["embed"][tokens].astype(cfg.dtype)  # [b, h]
+    x = _embed_tokens(cfg, params, tokens)  # [b, h]
 
     def layer(x, scanned):
         lp, kp, vp = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        h = _norm(cfg, x, lp["attn_norm"])
         q, k, v = _project_qkv(
             cfg, lp, h[:, None, :], positions[:, None], cos_tab, sin_tab
         )
@@ -427,15 +520,15 @@ def _decode_step_scatter_first(
         attn = paged_decode_attention(
             q, kp, vp, page_table, seq_lens, impl=cfg.attention_impl
         )
-        x = x + qmat(attn.reshape(b, cfg.q_dim), lp["wo"])
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _ffn(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_attn_norm", qmat(attn.reshape(b, cfg.q_dim), lp["wo"]))
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], k_pages, v_pages)
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = qmat(x, head).astype(jnp.float32)
     return logits, (new_k, new_v)

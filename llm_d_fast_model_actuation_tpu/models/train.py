@@ -41,26 +41,30 @@ def forward_train(
     b, s = tokens.shape
     cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = params["embed"][tokens].astype(cfg.dtype)
+    # the Gemma-family helpers keep training numerically identical to the
+    # serving forward ((1+w) norms, sandwich norms, scaled embeddings)
+    from .llama import _embed_tokens, _norm, _post
+
+    x = _embed_tokens(cfg, params, tokens)
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
         from ..ops.ring_attention import ring_prefill_attention
 
     def layer(x, lp):
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        h = _norm(cfg, x, lp["attn_norm"])
         q, k, v = _project_qkv(cfg, lp, h, positions, cos_tab, sin_tab)
         if use_ring:
             attn = ring_prefill_attention(q, k, v, seq_lens, mesh)
         else:
             attn = causal_prefill_attention(q, k, v, seq_lens)
-        x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"]
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
-        x = x + _ffn(cfg, lp, h)
+        x = x + _post(cfg, lp, "post_attn_norm", attn.reshape(b, s, cfg.q_dim) @ lp["wo"])
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, None
 
     body = jax.checkpoint(layer) if remat else layer
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    x = _norm(cfg, x, params["final_norm"])
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     return (x @ head).astype(jnp.float32)
 

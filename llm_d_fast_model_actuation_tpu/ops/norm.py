@@ -4,9 +4,16 @@ accumulation loses too much precision at hidden sizes >= 4k."""
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(
+    x: jnp.ndarray,
+    weight: jnp.ndarray,
+    eps: float = 1e-5,
+    offset: float = 0.0,
+) -> jnp.ndarray:
+    """`offset=1.0` gives the Gemma-family convention: weights are stored
+    zero-centered and applied as (1 + w)."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
-    return (y * weight.astype(jnp.float32)).astype(dtype)
+    return (y * (weight.astype(jnp.float32) + offset)).astype(dtype)
