@@ -64,6 +64,10 @@ class EngineConfig:
     #: identical with it on or off; on is the serving default (the
     #: reference's engine ships the same as vLLM APC).
     prefix_caching: bool = True
+    #: Chunked prefill: prompts longer than this prefill in segments of at
+    #: most this many tokens (bounds prefill activation memory and compile
+    #: buckets; later segments attend over the paged cache). 0 = off.
+    max_prefill_tokens: int = 0
 
     @property
     def seq_len(self) -> int:
@@ -422,35 +426,54 @@ class InferenceEngine:
             b *= 2
         return min(b, self.cfg.seq_len)
 
+    def _run_suffix_segment(
+        self, req: Request, start_pos: int, seg: List[int], temp, topp,
+        final: bool,
+    ):
+        """One prefill segment via the continue program: scatter the
+        segment's KV, attend over everything already in the pages. Used by
+        prefix-cache hits AND chunked prefill (a segment at start 0 works
+        too: its own KV is scattered before the paged attention).
+
+        Only the FINAL segment advances the RNG key: non-final segments'
+        in-program sample is discarded, so a chunked prefill consumes
+        exactly one key split — the same as an unchunked one — and
+        temperature>0 outputs are identical either way."""
+        table = self._page_table[req.slot : req.slot + 1]
+        bucket = self._prefill_bucket(len(seg))
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, : len(seg)] = seg
+        start = np.array([start_pos], dtype=np.int32)
+        seg_lens = np.array([len(seg)], dtype=np.int32)
+        if self.lockstep is not None:
+            self.lockstep.prefill_suffix(
+                req, bucket, start_pos, len(seg), advance_key=final
+            )
+        tok, lp, cache, new_key = self._suffix_prefill_fn(
+            self.params,
+            tokens,
+            start,
+            seg_lens,
+            self.pool.as_tuple(),
+            table,
+            temp,
+            topp,
+            self._raw_key,
+        )
+        if final:
+            self._raw_key = new_key
+        self.pool.replace(cache)
+        return tok, lp
+
     def _run_prefill(self, req: Request) -> None:
         n = len(req.prompt)
-        table = self._page_table[req.slot : req.slot + 1]
         temp = np.asarray([req.temperature], dtype=np.float32)
         topp = np.asarray([req.top_p], dtype=np.float32)
-        if req.cached_tokens > 0:
-            # prefix-cache hit: prefill only the suffix; the shared pages
-            # already hold the prefix KV (engine/prefix_cache.py)
-            k = req.cached_tokens
-            suffix = req.prompt[k:]
-            bucket = self._prefill_bucket(len(suffix))
-            tokens = np.zeros((1, bucket), dtype=np.int32)
-            tokens[0, : len(suffix)] = suffix
-            start = np.array([k], dtype=np.int32)
-            suffix_lens = np.array([len(suffix)], dtype=np.int32)
-            if self.lockstep is not None:
-                self.lockstep.prefill_suffix(req, bucket, k)
-            tok, lp, cache, self._raw_key = self._suffix_prefill_fn(
-                self.params,
-                tokens,
-                start,
-                suffix_lens,
-                self.pool.as_tuple(),
-                table,
-                temp,
-                topp,
-                self._raw_key,
-            )
-        else:
+        k = req.cached_tokens
+        limit = self.cfg.max_prefill_tokens or (n - k)
+        if k == 0 and n <= limit:
+            # single cold segment: the flash-style causal program
+            table = self._page_table[req.slot : req.slot + 1]
             bucket = self._prefill_bucket(n)
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, :n] = req.prompt
@@ -467,7 +490,18 @@ class InferenceEngine:
                 topp,
                 self._raw_key,
             )
-        self.pool.replace(cache)
+            self.pool.replace(cache)
+        else:
+            # prefix-cache hit and/or chunked prefill: run [k, n) through
+            # the continue program in segments of <= limit tokens; only the
+            # final segment's sample is consumed
+            pos = k
+            while pos < n:
+                seg = req.prompt[pos : min(n, pos + limit)]
+                tok, lp = self._run_suffix_segment(
+                    req, pos, seg, temp, topp, final=pos + len(seg) >= n
+                )
+                pos += len(seg)
         if self.prefix_cache is not None:
             # the full prompt pages now hold prompt KV: make them reusable
             self.prefix_cache.register(

@@ -64,6 +64,9 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         "tokens": np.zeros((cfg.seq_len,), np.int32),
         #: chunk: rebuild device scheduler state from the mirrors below
         "reupload": np.zeros((), np.int32),
+        #: suffix prefill: thread the returned RNG key (1) or discard it
+        #: (0 — non-final chunked-prefill segments)
+        "advance_key": np.ones((), np.int32),
         "lt": np.zeros((b,), np.int32),
         "pos": np.zeros((b,), np.int32),
         "budget": np.zeros((b,), np.int32),
@@ -119,19 +122,29 @@ class LockstepLeader:
             tokens=tokens,
         )
 
-    def prefill_suffix(self, req: Any, bucket: int, start: int) -> None:
-        suffix = req.prompt[start:]
+    def prefill_suffix(
+        self,
+        req: Any,
+        bucket: int,
+        start: int,
+        seg_len: int = -1,
+        advance_key: bool = True,
+    ) -> None:
+        if seg_len < 0:
+            seg_len = len(req.prompt) - start
+        seg = req.prompt[start : start + seg_len]
         tokens = np.zeros((self.engine.cfg.seq_len,), np.int32)
-        tokens[: len(suffix)] = suffix
+        tokens[: len(seg)] = seg
         self._send(
             kind=KIND_PREFILL_SUFFIX,
             arg=bucket,
             arg2=req.slot,
-            seq_len=len(suffix),
+            seq_len=len(seg),
             start=start,
             temp=req.temperature,
             top_p=req.top_p,
             tokens=tokens,
+            advance_key=int(advance_key),
         )
 
     def chunk(self, T: int, reupload: bool) -> None:
@@ -219,7 +232,7 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
     table = engine._page_table[slot : slot + 1]
     temp = np.asarray([float(f["temp"])], np.float32)
     topp = np.asarray([float(f["top_p"])], np.float32)
-    _tok, _lp, cache, engine._raw_key = engine._suffix_prefill_fn(
+    _tok, _lp, cache, new_key = engine._suffix_prefill_fn(
         engine.params,
         tokens,
         start,
@@ -230,6 +243,8 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
         topp,
         engine._raw_key,
     )
+    if int(f["advance_key"]):
+        engine._raw_key = new_key
     engine.pool.replace(cache)
 
 

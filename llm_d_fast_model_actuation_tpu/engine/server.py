@@ -180,6 +180,13 @@ def make_arg_parser() -> argparse.ArgumentParser:
         help="max decode steps fused into one compiled dispatch",
     )
     p.add_argument(
+        "--max-prefill-tokens",
+        type=int,
+        default=0,
+        help="chunked prefill: segment prompts longer than this (bounds "
+        "prefill memory and compile buckets); 0 = off",
+    )
+    p.add_argument(
         "--sleep-release-devices",
         default="auto",
         choices=["auto", "always", "never"],
@@ -237,6 +244,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--tensor-parallel-size must be >= 1")
     if args.decode_chunk < 1:
         raise ValueError("--decode-chunk must be >= 1")
+    if args.max_prefill_tokens < 0:
+        raise ValueError("--max-prefill-tokens must be >= 0")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -314,6 +323,7 @@ class EngineService:
                 attention_impl=args.attention_impl,
                 decode_chunk=args.decode_chunk,
                 prefix_caching=args.prefix_caching == "on",
+                max_prefill_tokens=args.max_prefill_tokens,
             ),
             params=params,
             mesh=mesh,
@@ -759,8 +769,10 @@ def build_app(service: EngineService) -> web.Application:
         if not tokens:
             raise ValueError("empty prompt")
         try:
-            max_tokens = int(body.get("max_tokens") or 16)
-            temperature = float(body.get("temperature") or 0.0)
+            mt = body.get("max_tokens")
+            max_tokens = 16 if mt is None else int(mt)
+            tv = body.get("temperature")
+            temperature = 0.0 if tv is None else float(tv)
             top_p = float(
                 1.0 if body.get("top_p") is None else body.get("top_p")
             )

@@ -45,6 +45,14 @@ _SHAPE_FIELDS = (
     "intermediate_size",
     "tie_embeddings",
     "num_experts",  # MoE family: expert count is a weight-layout fact
+    # Gemma-family knobs: they change the parameter SET (post/qk norms) or
+    # the stored-weight semantics ((1+w) zero-centered norms, GeGLU,
+    # scaled embeddings) — a mismatch must fail loudly, not serve garbage
+    "norm_offset",
+    "hidden_activation",
+    "embed_scale",
+    "post_norms",
+    "qk_norm",
 )
 
 

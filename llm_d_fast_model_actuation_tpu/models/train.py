@@ -20,8 +20,15 @@ import jax.numpy as jnp
 import optax
 
 from ..ops.attention import causal_prefill_attention
-from ..ops.norm import rms_norm
-from .llama import LlamaConfig, _ffn, _project_qkv, param_logical_axes  # noqa: F401
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    _embed_tokens,
+    _ffn,
+    _norm,
+    _post,
+    _project_qkv,
+    param_logical_axes,
+)
 from ..ops.rope import rope_table
 
 
@@ -43,8 +50,6 @@ def forward_train(
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     # the Gemma-family helpers keep training numerically identical to the
     # serving forward ((1+w) norms, sandwich norms, scaled embeddings)
-    from .llama import _embed_tokens, _norm, _post
-
     x = _embed_tokens(cfg, params, tokens)
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:

@@ -22,6 +22,7 @@ def main() -> None:
     args = parse_engine_options(
         "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
         "--max-model-len 64 --tensor-parallel-size 2 --decode-chunk 4 "
+        "--max-prefill-tokens 8 "
         f"--num-processes {n} --process-id {pid} "
         f"--coordinator-address 127.0.0.1:{port}"
     )

@@ -202,3 +202,58 @@ def test_abort_all_clears_cache_and_frees_pages():
     # post-reset generation is a clean cold run
     out = eng.generate([prompt], max_new_tokens=2)[0]
     assert len(out) == 2
+
+
+def test_chunked_prefill_matches_unchunked():
+    """Chunked prefill (max_prefill_tokens) segments a long prompt through
+    the continue program; generation is identical to the single-shot
+    prefill, with and without a prefix-cache hit."""
+    prompt = list(range(1, 1 + 3 * PS + 5))  # 29 tokens
+
+    def engine(chunk, caching=True):
+        return InferenceEngine(
+            EngineConfig(
+                model=llama.LlamaConfig.tiny(),
+                max_batch=2,
+                page_size=PS,
+                num_pages=32,
+                max_seq_len=64,
+                prefix_caching=caching,
+                max_prefill_tokens=chunk,
+            ),
+            seed=0,
+        )
+
+    base = engine(0, caching=False).generate([prompt], max_new_tokens=5)[0]
+    # pure chunked (no caching): segments of 8 from position 0
+    assert engine(8, caching=False).generate([prompt], max_new_tokens=5)[0] == base
+    # chunked + caching: cold run chunked, repeat hits the cache AND chunks
+    eng = engine(8)
+    assert eng.generate([prompt], max_new_tokens=5)[0] == base
+    assert eng.generate([prompt], max_new_tokens=5)[0] == base
+    assert eng.prefix_cache.hits == 1
+    # odd chunk size exercises uneven final segments
+    assert engine(7, caching=False).generate([prompt], max_new_tokens=5)[0] == base
+
+
+def test_chunked_prefill_identical_at_nonzero_temperature():
+    """Chunked prefill must consume exactly one RNG split like unchunked
+    prefill — sampled (temperature > 0) outputs are identical either way."""
+    prompt = list(range(1, 1 + 3 * PS + 5))
+
+    def gen(chunk):
+        eng = InferenceEngine(
+            EngineConfig(
+                model=llama.LlamaConfig.tiny(),
+                max_batch=2,
+                page_size=PS,
+                num_pages=32,
+                max_seq_len=64,
+                prefix_caching=False,
+                max_prefill_tokens=chunk,
+            ),
+            seed=7,
+        )
+        return eng.generate([prompt], max_new_tokens=6, temperature=0.9)[0]
+
+    assert gen(0) == gen(8) == gen(7)
