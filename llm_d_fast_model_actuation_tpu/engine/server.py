@@ -898,7 +898,21 @@ def build_app(service: EngineService) -> web.Application:
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
 
+        try:
+            nv = body.get("n")
+            n = 1 if nv is None else int(nv)
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="n must be an integer")
+        if not (1 <= n <= service.engine.cfg.max_batch):
+            raise web.HTTPBadRequest(
+                text=f"n must be in 1..{service.engine.cfg.max_batch}"
+            )
         if body.get("stream"):
+            if n != 1:
+                raise web.HTTPBadRequest(
+                    text="n > 1 is not supported with stream"
+                )
+
             def chunk(tok: int, index: int) -> Dict[str, Any]:
                 return {
                     "object": "text_completion",
@@ -913,36 +927,54 @@ def build_app(service: EngineService) -> web.Application:
                 chunk,
             )
 
-        req = await _await_generation(
+        # parallel sampling: n independent submissions; prefix caching makes
+        # the 2nd..nth prompt prefill nearly free (the OpenAI `n` param)
+        futs = [
             service.submit(
                 tokens, max_tokens, temperature,
                 top_p=top_p, stop_seqs=stop_seqs,
             )
-        )
+            for _ in range(n)
+        ]
+        try:
+            reqs = [await _await_generation(f) for f in futs]
+        except BaseException:
+            # one sample failed or the client went away: don't leak the
+            # siblings' decode cycles
+            for f in futs:
+                if not f.done():
+                    service.abort(f)
+            raise
+        req = reqs[0]
         ttft = (
             (req.first_token_time - req.submit_time)
             if req.first_token_time
             else None
         )
-        choice = {
-            "index": 0,
-            "token_ids": req.out_tokens,
-            "text": _detok(req.out_tokens),
-            "finish_reason": _finish_reason(service, req),
-        }
-        if body.get("logprobs"):
-            choice["logprobs"] = {
-                "tokens": req.out_tokens,
-                "token_logprobs": req.out_logprobs,
+        choices = []
+        for i, r in enumerate(reqs):
+            choice = {
+                "index": i,
+                "token_ids": r.out_tokens,
+                "text": _detok(r.out_tokens),
+                "finish_reason": _finish_reason(service, r),
             }
+            if body.get("logprobs"):
+                choice["logprobs"] = {
+                    "tokens": r.out_tokens,
+                    "token_logprobs": r.out_logprobs,
+                }
+            choices.append(choice)
         return web.json_response(
             {
                 "object": "text_completion",
                 "model": service.args.model,
-                "choices": [choice],
+                "choices": choices,
                 "usage": {
                     "prompt_tokens": len(tokens),
-                    "completion_tokens": len(req.out_tokens),
+                    "completion_tokens": sum(
+                        len(r.out_tokens) for r in reqs
+                    ),
                     "time_to_first_token_s": ttft,
                 },
             }

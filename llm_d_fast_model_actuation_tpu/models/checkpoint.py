@@ -85,10 +85,13 @@ def validate_config(directory: str, cfg: llama.LlamaConfig) -> None:
             saved = json.load(f)
     except OSError as e:
         raise FileNotFoundError(f"no checkpoint config at {path}") from e
+    # a key absent from an older checkpoint's config.json matches the
+    # engine's value (fields added over time must not invalidate existing
+    # checkpoints whose weight layout is unchanged)
     mismatches = {
         k: (saved.get(k), getattr(cfg, k, None))
         for k in _SHAPE_FIELDS
-        if saved.get(k) != getattr(cfg, k, None)
+        if saved.get(k, getattr(cfg, k, None)) != getattr(cfg, k, None)
     }
     if mismatches:
         raise ValueError(

@@ -400,3 +400,46 @@ def test_stop_sequences_and_logprobs_over_http(service):
         assert r.status == 200
 
     run_async(_client(service, scenario))
+
+
+def test_n_parallel_completions(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 3, "n": 2},
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert len(body["choices"]) == 2
+        assert [c["index"] for c in body["choices"]] == [0, 1]
+        # greedy: both samples identical; usage sums completions
+        assert body["choices"][0]["token_ids"] == body["choices"][1]["token_ids"]
+        assert body["usage"]["completion_tokens"] == 6
+
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1], "max_tokens": 2, "n": 99},
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1], "max_tokens": 2, "n": "x"},
+        )
+        assert r.status == 400
+
+    run_async(_client(service, scenario))
+
+
+def test_n_edge_cases(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1], "max_tokens": 2, "n": 0}
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1], "max_tokens": 2, "n": 2, "stream": True},
+        )
+        assert r.status == 400
+
+    run_async(_client(service, scenario))
