@@ -991,8 +991,22 @@ def build_app(service: EngineService) -> web.Application:
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        try:
+            nv = body.get("n")
+            n = 1 if nv is None else int(nv)
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="n must be an integer")
+        if not (1 <= n <= service.engine.cfg.max_batch):
+            raise web.HTTPBadRequest(
+                text=f"n must be in 1..{service.engine.cfg.max_batch}"
+            )
 
         if body.get("stream"):
+            if n != 1:
+                raise web.HTTPBadRequest(
+                    text="n > 1 is not supported with stream"
+                )
+
             def chunk(tok: int, index: int) -> Dict[str, Any]:
                 delta: Dict[str, Any] = {"content": _detok([tok])}
                 if index == 0:
@@ -1008,30 +1022,39 @@ def build_app(service: EngineService) -> web.Application:
                 chunk,
             )
 
-        req = await _await_generation(
+        futs = [
             service.submit(
                 tokens, max_tokens, temperature,
                 top_p=top_p, stop_seqs=stop_seqs,
             )
-        )
+            for _ in range(n)
+        ]
+        try:
+            reqs = [await _await_generation(f) for f in futs]
+        except BaseException:
+            for f in futs:
+                if not f.done():
+                    service.abort(f)
+            raise
         return web.json_response(
             {
                 "object": "chat.completion",
                 "model": service.args.model,
                 "choices": [
                     {
-                        "index": 0,
+                        "index": i,
                         "message": {
                             "role": "assistant",
-                            "content": _detok(req.out_tokens),
-                            "token_ids": req.out_tokens,
+                            "content": _detok(r.out_tokens),
+                            "token_ids": r.out_tokens,
                         },
-                        "finish_reason": _finish_reason(service, req),
+                        "finish_reason": _finish_reason(service, r),
                     }
+                    for i, r in enumerate(reqs)
                 ],
                 "usage": {
                     "prompt_tokens": len(tokens),
-                    "completion_tokens": len(req.out_tokens),
+                    "completion_tokens": sum(len(r.out_tokens) for r in reqs),
                 },
             }
         )

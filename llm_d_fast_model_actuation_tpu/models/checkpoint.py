@@ -85,13 +85,18 @@ def validate_config(directory: str, cfg: llama.LlamaConfig) -> None:
             saved = json.load(f)
     except OSError as e:
         raise FileNotFoundError(f"no checkpoint config at {path}") from e
-    # a key absent from an older checkpoint's config.json matches the
-    # engine's value (fields added over time must not invalidate existing
-    # checkpoints whose weight layout is unchanged)
+    # A key absent from an older checkpoint's config.json means the
+    # checkpoint predates the field: its weights carry the field's
+    # then-implicit DEFAULT semantics, so compare against the dataclass
+    # default — not the engine's value, which would accept any engine
+    # setting and silently serve weights under the wrong convention.
+    field_defaults = {
+        f.name: f.default for f in dataclasses.fields(type(cfg))
+    }
     mismatches = {
-        k: (saved.get(k), getattr(cfg, k, None))
+        k: (saved.get(k, field_defaults.get(k)), getattr(cfg, k, None))
         for k in _SHAPE_FIELDS
-        if saved.get(k, getattr(cfg, k, None)) != getattr(cfg, k, None)
+        if saved.get(k, field_defaults.get(k)) != getattr(cfg, k, None)
     }
     if mismatches:
         raise ValueError(

@@ -150,6 +150,19 @@ def param_logical_axes(cfg: MoeConfig) -> Dict[str, Any]:
     return axes
 
 
+def _qeinsum(spec: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Expert einsum for a plain or int8-quantized weight (models/quant.py):
+    the per-expert per-output-channel scale ([E, 1, out] after the layer
+    slice) rescales the einsum RESULT, so no dequantized expert stack ever
+    materializes — the same fusion argument as qmat."""
+    from .quant import is_quantized
+
+    if is_quantized(w):
+        out = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        return out * jnp.squeeze(w["s"], axis=-2).astype(out.dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def moe_ffn(cfg: MoeConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     """Top-k routed expert FFN, dense-compute sparse-weight.
 
@@ -163,10 +176,10 @@ def moe_ffn(cfg: MoeConfig, lp: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     onehot = jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.float32)
     weights = jnp.einsum("...k,...ke->...e", top_probs, onehot)
 
-    g = jnp.einsum("...h,ehf->...ef", x, lp["w_gate"])
-    u = jnp.einsum("...h,ehf->...ef", x, lp["w_up"])
+    g = _qeinsum("...h,ehf->...ef", x, lp["w_gate"])
+    u = _qeinsum("...h,ehf->...ef", x, lp["w_up"])
     act = (jax.nn.silu(g.astype(jnp.float32)).astype(x.dtype)) * u
-    y = jnp.einsum("...ef,efh->...eh", act, lp["w_down"])
+    y = _qeinsum("...ef,efh->...eh", act, lp["w_down"])
     # contraction over E: with experts ep-sharded this is the one psum
     out = jnp.einsum("...eh,...e->...h", y.astype(jnp.float32), weights)
     return out.astype(x.dtype)

@@ -58,17 +58,15 @@ def quantize_weight(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
 
 def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize a llama-family param pytree in place of the bf16 stacks.
-    Embedding, norms, and MoE expert stacks stay bf16 (experts are routed
-    through raw einsums in moe_ffn; quantizing them is a follow-up).
-
-    MoE trees reuse the dense names for their 4-D expert stacks
-    ([L, E, in, out]); only the 3-D dense stacks are quantized — rank is
-    the discriminator."""
+    Embedding and norms stay bf16. MoE trees reuse the dense names for
+    their 4-D expert stacks ([L, E, in, out]; moe_ffn's _qeinsum consumes
+    the quantized form); the router stays bf16 (its output feeds a
+    softmax — precision matters and it is tiny)."""
     out = dict(params)
     layers = dict(params["layers"])
     for name in LAYER_WEIGHTS:
         w = layers.get(name)
-        if w is not None and not is_quantized(w) and w.ndim == 3:
+        if w is not None and not is_quantized(w) and w.ndim in (3, 4):
             layers[name] = quantize_weight(w)
     out["layers"] = layers
     head = params.get("lm_head")
@@ -85,13 +83,12 @@ def quantized_axes(axes: Dict[str, Any]) -> Dict[str, Any]:
     layers = dict(axes["layers"])
     for name in LAYER_WEIGHTS:
         ax = layers.get(name)
-        # rank-3 only, mirroring quantize_params (MoE expert stacks are
-        # 4-D and stay bf16)
-        if ax is not None and len(ax) == 3:
+        if ax is not None and len(ax) in (3, 4):
+            # scale keeps every axis except fan-in (size-1 there):
+            # (L, 1, out) for dense stacks, (L, E, 1, out) for experts
             layers[name] = {
                 "q": ax,
-                # (L, 1, out): layer axis + dummy + output axis
-                "s": (ax[0], None, ax[-1]),
+                "s": ax[:-2] + (None, ax[-1]),
             }
     out["layers"] = layers
     if "lm_head" in axes:

@@ -443,3 +443,22 @@ def test_n_edge_cases(service):
         assert r.status == 400
 
     run_async(_client(service, scenario))
+
+
+def test_chat_n_parallel(service):
+    async def scenario(client):
+        msgs = [{"role": "user", "content": "hi"}]
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": msgs, "max_tokens": 3, "n": 2},
+        )
+        assert r.status == 200
+        body = await r.json()
+        assert len(body["choices"]) == 2
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": msgs, "max_tokens": 3, "n": 2, "stream": True},
+        )
+        assert r.status == 400
+
+    run_async(_client(service, scenario))

@@ -130,9 +130,10 @@ def test_quantized_axes_structure_matches_params():
     jax.tree.map(lambda *_: None, params, axes, is_leaf=lambda x: x is None)
 
 
-def test_moe_engine_with_int8_keeps_experts_bf16():
-    """MoE models quantize attention (3-D stacks) but keep the 4-D expert
-    stacks bf16 (moe_ffn consumes them with raw einsums)."""
+def test_moe_engine_int8_quantizes_experts():
+    """MoE int8: attention stacks AND the 4-D expert stacks quantize (the
+    router stays bf16); expert scales are per-expert per-output-channel
+    and slice with the layer scan."""
     from llm_d_fast_model_actuation_tpu.models.moe import MoeConfig
 
     cfg = dataclasses.replace(MoeConfig.tiny_moe(), quantization="int8")
@@ -141,9 +142,38 @@ def test_moe_engine_with_int8_keeps_experts_bf16():
         seed=0,
     )
     assert is_quantized(eng.params["layers"]["wq"])
-    assert not is_quantized(eng.params["layers"]["w_gate"])
+    wg = eng.params["layers"]["w_gate"]
+    assert is_quantized(wg)
+    L, E, _, f = wg["q"].shape
+    assert wg["s"].shape == (L, E, 1, f)
+    assert not is_quantized(eng.params["layers"]["router"])
     out = eng.generate([[1, 2, 3]], max_new_tokens=4)[0]
     assert len(out) == 4
     # axes structure still matches for sharding
     axes = logical_axes_for(cfg)
     jax.tree.map(lambda *_: None, eng.params, axes, is_leaf=lambda x: x is None)
+
+
+def test_moe_int8_sharded_over_ep(devices8):
+    """Quantized expert stacks shard over the ep axis (q and scale both)."""
+    from llm_d_fast_model_actuation_tpu.models.moe import MoeConfig
+    from llm_d_fast_model_actuation_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    cfg = dataclasses.replace(MoeConfig.tiny_moe(), quantization="int8")
+    mesh = make_mesh(MeshPlan(tp=2, ep=2), devices8[:4])
+    eng = InferenceEngine(
+        EngineConfig(model=cfg, max_batch=2, page_size=8, num_pages=32, max_seq_len=64),
+        mesh=mesh,
+        seed=0,
+    )
+    out = eng.generate([[4, 5, 6]], max_new_tokens=4)[0]
+    assert len(out) == 4
+    # deterministic across identical sharded engines (bit-exact greedy
+    # equality vs the UNsharded engine is not guaranteed: ep changes the
+    # bf16 reduction order)
+    eng2 = InferenceEngine(
+        EngineConfig(model=cfg, max_batch=2, page_size=8, num_pages=32, max_seq_len=64),
+        mesh=mesh,
+        seed=0,
+    )
+    assert eng2.generate([[4, 5, 6]], max_new_tokens=4)[0] == out
