@@ -886,6 +886,41 @@ def build_app(service: EngineService) -> web.Application:
             service.abort(fut)
             raise
 
+    def _parse_n(body: Dict[str, Any]) -> int:
+        try:
+            nv = body.get("n")
+            n = 1 if nv is None else int(nv)
+        except (TypeError, ValueError):
+            raise web.HTTPBadRequest(text="n must be an integer")
+        if not (1 <= n <= service.engine.cfg.max_batch):
+            raise web.HTTPBadRequest(
+                text=f"n must be in 1..{service.engine.cfg.max_batch}"
+            )
+        if body.get("stream") and n != 1:
+            raise web.HTTPBadRequest(text="n > 1 is not supported with stream")
+        return n
+
+    async def _gather_n(
+        n: int, tokens, max_tokens, temperature, top_p, stop_seqs
+    ):
+        """n parallel submissions; abort every sibling if any fails or the
+        client goes away (no orphan decode cycles). Prefix caching makes
+        the 2nd..nth prompt prefill nearly free (the OpenAI `n` param)."""
+        futs = [
+            service.submit(
+                tokens, max_tokens, temperature,
+                top_p=top_p, stop_seqs=stop_seqs,
+            )
+            for _ in range(n)
+        ]
+        try:
+            return [await _await_generation(f) for f in futs]
+        except BaseException:
+            for f in futs:
+                if not f.done():
+                    service.abort(f)
+            raise
+
     async def completions(request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -898,21 +933,8 @@ def build_app(service: EngineService) -> web.Application:
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
 
-        try:
-            nv = body.get("n")
-            n = 1 if nv is None else int(nv)
-        except (TypeError, ValueError):
-            raise web.HTTPBadRequest(text="n must be an integer")
-        if not (1 <= n <= service.engine.cfg.max_batch):
-            raise web.HTTPBadRequest(
-                text=f"n must be in 1..{service.engine.cfg.max_batch}"
-            )
+        n = _parse_n(body)
         if body.get("stream"):
-            if n != 1:
-                raise web.HTTPBadRequest(
-                    text="n > 1 is not supported with stream"
-                )
-
             def chunk(tok: int, index: int) -> Dict[str, Any]:
                 return {
                     "object": "text_completion",
@@ -927,24 +949,9 @@ def build_app(service: EngineService) -> web.Application:
                 chunk,
             )
 
-        # parallel sampling: n independent submissions; prefix caching makes
-        # the 2nd..nth prompt prefill nearly free (the OpenAI `n` param)
-        futs = [
-            service.submit(
-                tokens, max_tokens, temperature,
-                top_p=top_p, stop_seqs=stop_seqs,
-            )
-            for _ in range(n)
-        ]
-        try:
-            reqs = [await _await_generation(f) for f in futs]
-        except BaseException:
-            # one sample failed or the client went away: don't leak the
-            # siblings' decode cycles
-            for f in futs:
-                if not f.done():
-                    service.abort(f)
-            raise
+        reqs = await _gather_n(
+            n, tokens, max_tokens, temperature, top_p, stop_seqs
+        )
         req = reqs[0]
         ttft = (
             (req.first_token_time - req.submit_time)
@@ -991,22 +998,8 @@ def build_app(service: EngineService) -> web.Application:
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
-        try:
-            nv = body.get("n")
-            n = 1 if nv is None else int(nv)
-        except (TypeError, ValueError):
-            raise web.HTTPBadRequest(text="n must be an integer")
-        if not (1 <= n <= service.engine.cfg.max_batch):
-            raise web.HTTPBadRequest(
-                text=f"n must be in 1..{service.engine.cfg.max_batch}"
-            )
-
+        n = _parse_n(body)
         if body.get("stream"):
-            if n != 1:
-                raise web.HTTPBadRequest(
-                    text="n > 1 is not supported with stream"
-                )
-
             def chunk(tok: int, index: int) -> Dict[str, Any]:
                 delta: Dict[str, Any] = {"content": _detok([tok])}
                 if index == 0:
@@ -1022,20 +1015,9 @@ def build_app(service: EngineService) -> web.Application:
                 chunk,
             )
 
-        futs = [
-            service.submit(
-                tokens, max_tokens, temperature,
-                top_p=top_p, stop_seqs=stop_seqs,
-            )
-            for _ in range(n)
-        ]
-        try:
-            reqs = [await _await_generation(f) for f in futs]
-        except BaseException:
-            for f in futs:
-                if not f.done():
-                    service.abort(f)
-            raise
+        reqs = await _gather_n(
+            n, tokens, max_tokens, temperature, top_p, stop_seqs
+        )
         return web.json_response(
             {
                 "object": "chat.completion",

@@ -161,3 +161,91 @@ def test_chip_pinning_env_reaches_child(launcher):
     assert state["gpu_uuids"] == ["tpu-mock-0-1", "tpu-mock-1-1"]
     assert state["env_vars"]["TPU_VISIBLE_DEVICES"] == "1,3"
     requests.delete(launcher + "/v2/vllm/instances/pin-1", timeout=30)
+
+
+@pytest.mark.e2e
+def test_multihost_gang_through_launcher(launcher):
+    """The capstone multi-host path over the REAL launcher fork boundary:
+    two engine children forked by the launcher form one jax.distributed
+    gang (leader + follower), serve through the leader, and gang-sleep.
+
+    On TPU the two processes would sit on two hosts; here both fork from
+    one launcher with one CPU device each — the same process topology the
+    gang coordinator actuates (docs/dual-pods.md)."""
+    coord_port = free_port()
+    p0, p1 = free_port(), free_port()
+    gang_env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",  # one CPU device per child
+        "FMA_NUM_PROCESSES": "2",
+        "FMA_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
+        "FMA_GANG_ID": "ge2e01",
+    }
+    opts = (
+        "--model tiny --num-pages 32 --max-batch 2 --page-size 8 "
+        "--max-model-len 64 --tensor-parallel-size 2 --decode-chunk 4 "
+    )
+    for pid, eport, name in ((1, p1, "gang-f"), (0, p0, "gang-l")):
+        r = requests.put(
+            launcher + f"/v2/vllm/instances/{name}",
+            json={
+                "options": opts + f"--port {eport}",
+                "env_vars": {**gang_env, "FMA_PROCESS_ID": str(pid)},
+            },
+            timeout=30,
+        )
+        assert r.status_code == 201, r.text
+
+    leader = f"http://127.0.0.1:{p0}"
+    follower = f"http://127.0.0.1:{p1}"
+    # health implies the gang formed: jax.distributed.initialize blocks
+    # until both processes join
+    wait_http(leader + "/health", timeout=240)
+    wait_http(follower + "/health", timeout=240)
+
+    r = requests.post(
+        leader + "/v1/completions",
+        json={"prompt": [5, 6, 7], "max_tokens": 4},
+        timeout=180,
+    )
+    assert r.status_code == 200, r.text
+    out1 = r.json()["choices"][0]["token_ids"]
+    assert len(out1) == 4
+
+    # followers refuse to serve (requests go to the leader)
+    r = requests.post(
+        follower + "/v1/completions",
+        json={"prompt": [5, 6, 7], "max_tokens": 2},
+        timeout=60,
+    )
+    assert r.status_code >= 500
+
+    # gang-wide sleep through the LEADER's admin port; the follower's admin
+    # defers but its state follows the broadcast
+    r = requests.post(leader + "/sleep", params={"level": "1"}, timeout=120)
+    assert r.status_code == 200 and r.json()["is_sleeping"] is True
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if requests.get(follower + "/is_sleeping", timeout=5).json()["is_sleeping"]:
+            break
+        time.sleep(0.3)
+    assert requests.get(follower + "/is_sleeping", timeout=5).json()["is_sleeping"] is True
+    body = requests.post(follower + "/sleep", timeout=10).json()
+    assert body.get("deferred") is True
+
+    # wake + identical greedy generation across the gang cycle
+    r = requests.post(leader + "/wake_up", timeout=120)
+    assert r.status_code == 200 and r.json()["is_sleeping"] is False
+    r = requests.post(
+        leader + "/v1/completions",
+        json={"prompt": [5, 6, 7], "max_tokens": 4},
+        timeout=180,
+    )
+    assert r.json()["choices"][0]["token_ids"] == out1
+
+    for name in ("gang-l", "gang-f"):
+        requests.delete(launcher + f"/v2/vllm/instances/{name}", timeout=60)
+    assert (
+        requests.get(launcher + "/v2/vllm/instances").json()["total_instances"]
+        == 0
+    )
