@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +68,15 @@ class EngineConfig:
     #: most this many tokens (bounds prefill activation memory and compile
     #: buckets; later segments attend over the paged cache). 0 = off.
     max_prefill_tokens: int = 0
+    #: N-gram (prompt-lookup) speculative decoding: propose up to this many
+    #: tokens by matching the context's most recent n-gram and verify them
+    #: in ONE forward over the paged cache (vLLM's "ngram" speculative
+    #: decoding). Every emitted token is the verify forward's own greedy
+    #: argmax, so quality equals plain greedy decoding; bitwise equality
+    #: with the chunk program is NOT guaranteed at argmax ties (the two
+    #: programs reduce bf16 in different orders — the standard spec-decode
+    #: caveat). Engages for single-sequence greedy decoding only; 0 = off.
+    speculative_ngram: int = 0
 
     @property
     def seq_len(self) -> int:
@@ -234,6 +243,28 @@ class InferenceEngine:
             return tok, lp, cache, raw_key
 
         self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(4,))
+
+        def _verify(params, tokens, start, window_len, cache, page_table):
+            """Speculative verify: run the window [last_token, q1..q_{k-1}]
+            through the continue program and return the model's GREEDY next
+            token at every window position, with its logprob (the logprobs
+            API must not degrade under speculation)."""
+            logits, cache = llama.prefill_continue(
+                params, model_cfg, tokens, start, window_len, cache, page_table
+            )
+            norm = logits - jax.scipy.special.logsumexp(
+                logits, axis=-1, keepdims=True
+            )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lps = jnp.take_along_axis(norm, toks[..., None], axis=-1)[..., 0]
+            return toks, lps, cache
+
+        self._verify_fn = jax.jit(_verify, donate_argnums=(4,))
+        #: speculative decoding counters (observability)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._spec_miss_streak = 0
+        self._spec_cooldown = 0
         self._chunk_fns: Dict[int, Any] = {}
 
     # -- compiled decode chunk ----------------------------------------------
@@ -565,6 +596,116 @@ class InferenceEngine:
         req.slot = -1
         self._dirty = True
 
+    # -- speculative (n-gram / prompt-lookup) decoding -----------------------
+
+    def _spec_candidate(self) -> Optional[Request]:
+        """Speculation engages only where it is exact and simple: exactly
+        one greedy (temp=0, full top-p) sequence in flight, nothing
+        waiting, no gang lockstep."""
+        if self.cfg.speculative_ngram <= 0 or self.lockstep is not None:
+            return None
+        if self._waiting:
+            return None
+        active = [r for r in self._slots if r is not None and not r.done]
+        if len(active) != 1:
+            return None
+        r = active[0]
+        if r.temperature != 0.0 or r.top_p < 1.0 or r.on_token is not None:
+            return None
+        return r
+
+    def _propose_ngram(self, req: Request, k: int) -> List[int]:
+        """Prompt-lookup proposal: find the most recent PREVIOUS occurrence
+        of the context's trailing m-gram (m = 3, 2) and propose the tokens
+        that followed it."""
+        ctx = req.prompt + req.out_tokens
+        # bounded lookback: an unbounded backward scan is O(context) host
+        # work per decode step (vLLM caps its ngram lookup the same way)
+        lo = max(0, len(ctx) - 1024)
+        for m in (3, 2):
+            if len(ctx) <= m:
+                continue
+            tail = ctx[-m:]
+            for i in range(len(ctx) - m - 1, lo - 1, -1):
+                if ctx[i : i + m] == tail:
+                    props = ctx[i + m : i + m + k]
+                    if props:
+                        return props
+        return []
+
+    def _spec_round(self, req: Request) -> bool:
+        """One speculative verify round. Returns True if it ran (the caller
+        skips the normal chunk step), False to fall back.
+
+        Window [t0, q1..qk] runs through the verify program (the continue
+        program + argmax): o[i] is the model's greedy token after
+        window[:i+1]. Accept q_{i+1} while o[i] == q_{i+1}; the first
+        mismatch's o is the corrected token, and a fully-accepted window
+        yields o[k] as a bonus token — up to k+1 tokens per forward.
+        Rejected tokens' KV stays in pages beyond `positions` where the
+        attention mask never looks; it is overwritten as decoding reaches
+        those positions."""
+        k = min(
+            self.cfg.speculative_ngram,
+            req.max_new_tokens - len(req.out_tokens),
+            self.cfg.seq_len - req.pos - 1,
+        )
+        if k <= 0:
+            return False
+        if self._spec_cooldown > 0:
+            # acceptance-rate hysteresis: after a run of fully-rejected
+            # rounds, speculation costs a verify forward per single token
+            # (vs the fused chunk); back off to the chunk path for a while
+            self._spec_cooldown -= 1
+            return False
+        props = self._propose_ngram(req, k)
+        if not props:
+            return False
+        window = [int(self._last_tokens[req.slot])] + props
+        bucket = self._prefill_bucket(len(window))
+        tokens = np.zeros((1, bucket), dtype=np.int32)
+        tokens[0, : len(window)] = window
+        start = np.array([req.pos], dtype=np.int32)
+        window_len = np.array([len(window)], dtype=np.int32)
+        table = self._page_table[req.slot : req.slot + 1]
+        toks, lps_dev, cache = self._verify_fn(
+            self.params, tokens, start, window_len, self.pool.as_tuple(), table
+        )
+        self.pool.replace(cache)
+        o = np.asarray(toks)[0]
+        o_lp = np.asarray(lps_dev)[0]
+        self.spec_proposed += len(props)
+        accepted = 0
+        emitted: List[Tuple[int, float]] = []
+        for i, q in enumerate(props):
+            if int(o[i]) != q:
+                emitted.append((int(o[i]), float(o_lp[i])))  # corrected token
+                break
+            accepted += 1
+            emitted.append((q, float(o_lp[i])))
+        else:
+            emitted.append((int(o[len(props)]), float(o_lp[len(props)])))
+        self.spec_accepted += accepted
+        if accepted == 0:
+            self._spec_miss_streak += 1
+            if self._spec_miss_streak >= 4:
+                self._spec_cooldown = 32
+                self._spec_miss_streak = 0
+        else:
+            self._spec_miss_streak = 0
+        for t, lp in emitted:
+            req.pos += 1
+            self._positions[req.slot] = req.pos
+            self._last_tokens[req.slot] = t
+            self._budgets[req.slot] = max(
+                0, req.max_new_tokens - len(req.out_tokens) - 1
+            )
+            self._emit(req, t, lp)
+            if req.done:
+                break
+        self._dirty = True  # device scheduler state is stale
+        return True
+
     # -- the engine loop body ----------------------------------------------
 
     def step(self) -> List[Request]:
@@ -584,6 +725,13 @@ class InferenceEngine:
             if req.done:
                 self._retire(req)
                 finished.append(req)
+
+        spec_req = self._spec_candidate()
+        if spec_req is not None and self._spec_round(spec_req):
+            if spec_req.done:
+                self._retire(spec_req)
+                finished.append(spec_req)
+            return finished
 
         running = {
             r.slot: r for r in self._slots if r is not None and not r.done

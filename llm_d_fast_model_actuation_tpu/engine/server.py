@@ -92,6 +92,16 @@ ENGINE_PREFIX_HIT_TOKENS = Gauge(
     "Prompt tokens served from the prefix cache instead of prefill",
     ["model"],
 )
+ENGINE_SPEC_PROPOSED = Gauge(
+    "fma_engine_spec_proposed_tokens",
+    "Tokens proposed by n-gram speculative decoding",
+    ["model"],
+)
+ENGINE_SPEC_ACCEPTED = Gauge(
+    "fma_engine_spec_accepted_tokens",
+    "Proposed tokens accepted by the verify forward",
+    ["model"],
+)
 
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
@@ -187,6 +197,14 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "prefill memory and compile buckets); 0 = off",
     )
     p.add_argument(
+        "--speculative-ngram",
+        type=int,
+        default=0,
+        help="n-gram (prompt-lookup) speculative decoding: verify up to N "
+        "proposed tokens per forward on the single-sequence greedy path; "
+        "0 = off",
+    )
+    p.add_argument(
         "--sleep-release-devices",
         default="auto",
         choices=["auto", "always", "never"],
@@ -246,6 +264,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--decode-chunk must be >= 1")
     if args.max_prefill_tokens < 0:
         raise ValueError("--max-prefill-tokens must be >= 0")
+    if args.speculative_ngram < 0:
+        raise ValueError("--speculative-ngram must be >= 0")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -324,6 +344,7 @@ class EngineService:
                 decode_chunk=args.decode_chunk,
                 prefix_caching=args.prefix_caching == "on",
                 max_prefill_tokens=args.max_prefill_tokens,
+                speculative_ngram=args.speculative_ngram,
             ),
             params=params,
             mesh=mesh,
@@ -735,6 +756,12 @@ def build_app(service: EngineService) -> web.Application:
             ENGINE_PREFIX_HIT_TOKENS.labels(model=service.args.model).set(
                 service.engine.prefix_cache.hit_tokens
             )
+        ENGINE_SPEC_PROPOSED.labels(model=service.args.model).set(
+            service.engine.spec_proposed
+        )
+        ENGINE_SPEC_ACCEPTED.labels(model=service.args.model).set(
+            service.engine.spec_accepted
+        )
         return web.Response(
             body=generate_latest(),
             content_type="text/plain",

@@ -1,0 +1,151 @@
+"""N-gram (prompt-lookup) speculative decoding: greedy-exact outputs,
+acceptance on repetitive contexts, and clean fallback."""
+
+import dataclasses
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
+from llm_d_fast_model_actuation_tpu.models import llama
+
+
+def make_engine(spec=0, **kw):
+    return InferenceEngine(
+        EngineConfig(
+            model=llama.LlamaConfig.tiny(),
+            max_batch=2,
+            page_size=8,
+            num_pages=32,
+            max_seq_len=128,
+            speculative_ngram=spec,
+            **kw,
+        ),
+        seed=0,
+    )
+
+
+def test_speculative_deterministic_and_proposing():
+    """Spec decoding is deterministic (same engine config twice -> same
+    output) and the organic n-gram proposer fires on repetitive contexts.
+    Bitwise equality with the non-spec chunk program is NOT asserted: the
+    verify and chunk programs reduce bf16 in different orders, so argmax
+    ties — everywhere in a tiny random model — may resolve differently
+    (the standard spec-decode caveat; every emitted token is still the
+    verify forward's own greedy argmax)."""
+    prompt = [7, 8, 9, 7, 8, 9, 7, 8, 9, 5]
+    eng = make_engine(4)
+    out = eng.generate([prompt], max_new_tokens=20)[0]
+    assert len(out) == 20
+    assert eng.spec_proposed > 0, "repetitive context must trigger proposals"
+    eng2 = make_engine(4)
+    assert eng2.generate([prompt], max_new_tokens=20)[0] == out
+
+    # proposals may not fire on non-repetitive prompts; output completes
+    prompt2 = list(range(1, 14))
+    out2 = make_engine(4).generate([prompt2], max_new_tokens=10)[0]
+    assert len(out2) == 10
+
+
+def test_speculative_oracle_accepts_and_reduces_rounds():
+    """With an oracle proposer (feeds the true continuation), every
+    proposal is accepted and tokens-per-forward approaches k+1 — this
+    pins the verify/accept/bookkeeping machinery deterministically
+    (the n-gram proposer's hit-rate depends on the context)."""
+    prompt = [3, 3, 3, 3, 3, 3]
+    base = make_engine(0).generate([prompt], max_new_tokens=16)[0]
+
+    eng = make_engine(4)
+
+    def oracle(req, k):
+        done = len(req.out_tokens)
+        return base[done : done + k]
+
+    eng._propose_ngram = oracle
+    steps = 0
+    eng.add_request(prompt, max_new_tokens=16)
+    reqs = []
+    while eng.has_work():
+        reqs.extend(eng.step())
+        steps += 1
+    assert len(reqs[0].out_tokens) == 16
+    # the oracle feeds the chunk-greedy trajectory; acceptance can stop
+    # early only at an argmax tie, so nearly all proposals are accepted
+    assert eng.spec_accepted > 0
+    assert eng.spec_accepted >= eng.spec_proposed - 4
+    # up to k+1 tokens per verify round: far fewer rounds than tokens
+    assert steps <= 2 + -(-16 // 4)
+
+
+def test_speculative_adversarial_proposals_all_rejected():
+    """A proposer that is always wrong costs rounds but never corrupts
+    output: every round rejects and emits exactly the corrected token."""
+    prompt = [3, 3, 3, 3, 3, 3]
+    base = make_engine(0).generate([prompt], max_new_tokens=10)[0]
+
+    eng = make_engine(4)
+
+    def adversary(req, k):
+        done = len(req.out_tokens)
+        true_next = base[done] if done < len(base) else 0
+        return [(true_next + 1) % 256] * min(k, 3)
+
+    eng._propose_ngram = adversary
+    out = eng.generate([prompt], max_new_tokens=10)[0]
+    assert len(out) == 10
+    assert eng.spec_proposed > 0
+    # rejection rate is near-total (an accept needs the corrected token to
+    # tie with adversary's wrong token — argmax ties only)
+    assert eng.spec_accepted <= 2
+
+
+def test_speculative_disabled_for_batched_and_sampled():
+    eng = make_engine(4)
+    # two concurrent sequences: spec must not engage (batched path)
+    eng.add_request([7, 8, 9, 7, 8, 9], max_new_tokens=6)
+    eng.add_request([1, 2, 3, 1, 2, 3], max_new_tokens=6)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    assert len(done) == 2
+    assert eng.spec_proposed == 0
+
+    # sampled request: no speculation (rejection sampling not implemented)
+    eng2 = make_engine(4)
+    out = eng2.generate([[7, 8, 9, 7, 8, 9]], max_new_tokens=6, temperature=0.8)[0]
+    assert len(out) == 6
+    assert eng2.spec_proposed == 0
+
+
+def test_speculative_respects_budget_eos_and_stop():
+    # budget: exactly max_new_tokens even when a full window accepts
+    prompt = [3, 3, 3, 3, 3, 3]
+    base = make_engine(0).generate([prompt], max_new_tokens=5)[0]
+    eng = make_engine(6)
+    eng._propose_ngram = lambda req, k: base[
+        len(req.out_tokens) : len(req.out_tokens) + k
+    ]
+    out = eng.generate([prompt], max_new_tokens=5)[0]
+    assert len(out) == 5
+
+    # stop sequence inside an accepted run still truncates
+    base = make_engine(0).generate([[3, 3, 3, 3, 3, 3]], max_new_tokens=8)[0]
+    stop_tok = base[3]
+    eng3 = make_engine(4)
+    eng3.add_request(
+        [3, 3, 3, 3, 3, 3], max_new_tokens=8, stop_seqs=[(stop_tok,)]
+    )
+    done = []
+    while eng3.has_work():
+        done.extend(eng3.step())
+    ref = make_engine(0)
+    ref.add_request(
+        [3, 3, 3, 3, 3, 3], max_new_tokens=8, stop_seqs=[(stop_tok,)]
+    )
+    ref_done = []
+    while ref.has_work():
+        ref_done.extend(ref.step())
+    # both paths honor the stop semantics (strip + finish); the token
+    # streams can differ at argmax ties, so compare the CONTRACT: output
+    # never contains the stop token
+    assert stop_tok not in done[0].out_tokens
+    assert stop_tok not in ref_done[0].out_tokens
