@@ -610,7 +610,11 @@ class InferenceEngine:
         if len(active) != 1:
             return None
         r = active[0]
-        if r.temperature != 0.0 or r.top_p < 1.0 or r.on_token is not None:
+        # only temperature gates exactness: at temperature 0 sampling is
+        # the full-vocab argmax regardless of top_p, and streaming
+        # (on_token) already receives multi-token bursts from the chunk
+        # path, so both compose with speculation
+        if r.temperature != 0.0:
             return None
         return r
 
@@ -618,10 +622,16 @@ class InferenceEngine:
         """Prompt-lookup proposal: find the most recent PREVIOUS occurrence
         of the context's trailing m-gram (m = 3, 2) and propose the tokens
         that followed it."""
-        ctx = req.prompt + req.out_tokens
-        # bounded lookback: an unbounded backward scan is O(context) host
-        # work per decode step (vLLM caps its ngram lookup the same way)
-        lo = max(0, len(ctx) - 1024)
+        # bounded lookback: an unbounded backward scan (or a full-context
+        # concat) is O(context) host work per decode step — build only the
+        # trailing window (vLLM caps its ngram lookup the same way)
+        lookback = 1024 + k
+        out = req.out_tokens
+        if len(out) >= lookback:
+            ctx = out[-lookback:]
+        else:
+            ctx = req.prompt[-(lookback - len(out)):] + out
+        lo = 0
         for m in (3, 2):
             if len(ctx) <= m:
                 continue

@@ -756,12 +756,13 @@ def build_app(service: EngineService) -> web.Application:
             ENGINE_PREFIX_HIT_TOKENS.labels(model=service.args.model).set(
                 service.engine.prefix_cache.hit_tokens
             )
-        ENGINE_SPEC_PROPOSED.labels(model=service.args.model).set(
-            service.engine.spec_proposed
-        )
-        ENGINE_SPEC_ACCEPTED.labels(model=service.args.model).set(
-            service.engine.spec_accepted
-        )
+        if service.engine.cfg.speculative_ngram > 0:
+            ENGINE_SPEC_PROPOSED.labels(model=service.args.model).set(
+                service.engine.spec_proposed
+            )
+            ENGINE_SPEC_ACCEPTED.labels(model=service.args.model).set(
+                service.engine.spec_accepted
+            )
         return web.Response(
             body=generate_latest(),
             content_type="text/plain",

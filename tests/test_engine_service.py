@@ -462,3 +462,32 @@ def test_chat_n_parallel(service):
         assert r.status == 400
 
     run_async(_client(service, scenario))
+
+
+def test_metrics_endpoint_exports_engine_gauges():
+    # spec gauges export only when the feature is on (no dead series)
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64 --speculative-ngram 4"
+        )
+    )
+    try:
+        async def scenario(client):
+            await client.post(
+                "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 2}
+            )
+            r = await client.get("/metrics")
+            assert r.status == 200
+            text = await r.text()
+            for family in (
+                "fma_engine_queue_depth{",
+                "fma_engine_prefix_cache_hit_tokens{",
+                "fma_engine_spec_proposed_tokens{",
+                "fma_engine_spec_accepted_tokens{",
+            ):
+                assert family in text, f"{family} missing from /metrics"
+
+        run_async(_client(svc, scenario))
+    finally:
+        svc.shutdown()
