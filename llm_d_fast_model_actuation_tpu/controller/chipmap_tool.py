@@ -21,7 +21,7 @@ from __future__ import annotations
 import argparse
 import logging
 import subprocess
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from ..api import constants as C
 from ..parallel.topology import ChipMap, HostTopology
@@ -29,8 +29,9 @@ from .store import AlreadyExists
 
 logger = logging.getLogger(__name__)
 
-#: node -> HostTopology (None = probe failed; node is skipped this run)
-Prober = Callable[[str], Optional[HostTopology]]
+#: node -> HostTopology, or a single-node ChipMap when the probe carries
+#: multi-host identity (origin:/slice:); None = probe failed, skip node
+Prober = Callable[[str], Optional[Union[HostTopology, ChipMap]]]
 
 
 def tpu_nodes(store: Any, selector: Optional[Dict[str, str]] = None) -> List[Dict[str, Any]]:
@@ -82,9 +83,18 @@ def ensure_nodes_mapped(
         if host is None:
             logger.warning("could not index node %s", name)
             continue
-        single = ChipMap()
-        single.set_host(name, host)
-        value = single.dump()[name]
+        if isinstance(host, ChipMap):
+            # a ChipMap-returning prober carries multi-host identity too
+            # (origin:/slice: lines from the tpuinfo table)
+            value = host.dump().get(name)
+            host = host.host(name)
+            if value is None or host is None:
+                logger.warning("prober returned a map without node %s", name)
+                continue
+        else:
+            single = ChipMap()
+            single.set_host(name, host)
+            value = single.dump()[name]
 
         def apply(obj):
             obj.setdefault("data", {})[name] = value
@@ -103,7 +113,7 @@ def kubectl_tpuinfo_prober(
     the tpuinfo shim (`fma-tpuinfo --table`) and parse its log — the same
     choreography as ensure-nodes-mapped.sh's nvidia-smi pod."""
 
-    def probe(node: str) -> Optional[HostTopology]:
+    def probe(node: str) -> Optional[ChipMap]:
         pod = f"{node}-chip-map"
         manifest = f"""
 apiVersion: v1
@@ -139,7 +149,11 @@ spec:
                 capture_output=True,
             ).stdout.decode()
             cm = ChipMap.parse({node: logs})
-            return cm.host(node)
+            if cm.host(node) is None:
+                return None
+            # return the whole single-node map: origin:/slice: lines (the
+            # multi-host gang planner's input) survive the round-trip
+            return cm
         except (subprocess.CalledProcessError, ValueError, KeyError) as e:
             logger.warning("probe of %s failed: %s", node, e)
             return None

@@ -631,12 +631,11 @@ class InferenceEngine:
             ctx = out[-lookback:]
         else:
             ctx = req.prompt[-(lookback - len(out)):] + out
-        lo = 0
         for m in (3, 2):
             if len(ctx) <= m:
                 continue
             tail = ctx[-m:]
-            for i in range(len(ctx) - m - 1, lo - 1, -1):
+            for i in range(len(ctx) - m - 1, -1, -1):
                 if ctx[i : i + m] == tail:
                     props = ctx[i + m : i + m + k]
                     if props:

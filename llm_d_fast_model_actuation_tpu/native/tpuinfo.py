@@ -92,6 +92,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         topo = host_topology()
         if topo:
             print(f"topology: {topo}")
+        # Multi-host slice identity (parallel/multihost.py plans gangs from
+        # these): FMA_HOST_ORIGIN/FMA_SLICE_ID override; else derive the
+        # origin from the libtpu worker index (v5e multi-host slices tile
+        # hosts along the first axis) and the slice id from TPU_NAME.
+        origin = os.environ.get("FMA_HOST_ORIGIN", "")
+        if not origin and topo:
+            wid = os.environ.get("TPU_WORKER_ID", "")
+            if wid.isdigit() and int(wid) > 0:
+                dims = [int(d) for d in topo.split("x")]
+                o = [0] * len(dims)
+                o[0] = int(wid) * dims[0]
+                origin = ",".join(str(x) for x in o)
+        slice_id = os.environ.get(
+            "FMA_SLICE_ID", os.environ.get("TPU_NAME", "")
+        )
+        if origin:
+            print(f"origin: {origin}")
+        if slice_id:
+            print(f"slice: {slice_id}")
         for c in sorted(enumerate_chips(), key=lambda c: int(c["index"])):
             coords = ",".join(str(x) for x in (c.get("coords") or []))
             print(f"{c['index']} {c['chip_id']} {coords}".rstrip())

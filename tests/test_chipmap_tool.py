@@ -113,3 +113,69 @@ def test_tpuinfo_table_cli_output_parses():
     host = parsed.host("local")
     assert host is not None and len(host.chips) == 4
     assert host.by_id()["tpu-local-1-1"].coords == (1, 1)
+
+
+def test_prober_chipmap_carries_multihost_identity():
+    """A ChipMap-returning prober preserves origin:/slice: lines — the
+    multi-host gang planner's input survives the probe round-trip."""
+    from llm_d_fast_model_actuation_tpu.api import constants as C
+    from llm_d_fast_model_actuation_tpu.controller.chipmap_tool import (
+        ensure_nodes_mapped,
+    )
+    from llm_d_fast_model_actuation_tpu.controller.store import InMemoryStore
+    from llm_d_fast_model_actuation_tpu.parallel.topology import (
+        ChipMap,
+        HostTopology,
+    )
+
+    store = InMemoryStore()
+    store.create(
+        {
+            "kind": "Node",
+            "metadata": {"name": "mh1"},
+            "status": {"capacity": {"google.com/tpu": "8"}},
+        }
+    )
+
+    def prober(node):
+        cm = ChipMap()
+        cm.set_host(node, HostTopology.make("2x4", node=node))
+        cm.set_origin(node, (2, 0))
+        cm.set_slice_id(node, "sliceA")
+        return cm
+
+    added = ensure_nodes_mapped(store, "ns1", prober)
+    assert added == ["mh1"]
+    data = store.get("ConfigMap", "ns1", C.CHIP_MAP_CONFIGMAP)["data"]
+    parsed = ChipMap.parse(data)
+    assert parsed.origin("mh1") == (2, 0)
+    assert parsed.slice_id("mh1") == "sliceA"
+
+
+def test_tpuinfo_table_emits_multihost_identity(monkeypatch, capsys):
+    from llm_d_fast_model_actuation_tpu.native import tpuinfo
+
+    monkeypatch.setattr(
+        tpuinfo, "_query",
+        lambda: {
+            "topology": "2x4",
+            "chips": [
+                {"chip_id": "c0", "index": 0, "coords": [0, 0]},
+                {"chip_id": "c1", "index": 1, "coords": [0, 1]},
+            ],
+        },
+    )
+    monkeypatch.delenv("FMA_HOST_ORIGIN", raising=False)
+    monkeypatch.delenv("FMA_SLICE_ID", raising=False)
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    tpuinfo.main(["--table"])
+    out = capsys.readouterr().out
+    assert "topology: 2x4" in out
+    assert "origin: 2,0" in out  # worker 1 of 2x4 hosts -> x offset 2
+    assert "slice: my-slice" in out
+
+    # explicit override wins
+    monkeypatch.setenv("FMA_HOST_ORIGIN", "4,0")
+    tpuinfo.main(["--table"])
+    assert "origin: 4,0" in capsys.readouterr().out
