@@ -257,3 +257,86 @@ def test_chunked_prefill_identical_at_nonzero_temperature():
         return eng.generate([prompt], max_new_tokens=6, temperature=0.9)[0]
 
     assert gen(0) == gen(8) == gen(7)
+
+
+def test_fuzz_page_accounting_invariants():
+    """Randomized workload against the engine's page accounting: admission
+    (including rejection), concurrent in-flight sequences, retirement,
+    prefix sharing, and eviction under real pressure — after EVERY step
+    the allocator + cache must account for every page exactly once (no
+    leaks, no double-frees), and a final abort_all drains the pool."""
+    import random
+
+    rng = random.Random(1234)
+    # 7 usable pages vs 3-page requests + cacheable prefixes: eviction and
+    # OutOfPages-blocked admissions both occur (asserted below)
+    eng = make_engine(num_pages=8, max_batch=2)
+    usable = eng.cfg.num_pages - 1
+
+    def check_invariants():
+        free = eng.allocator.available
+        live_pages = set()
+        for r in eng._slots:
+            if r is not None:
+                live_pages.update(r.pages)
+        for r in eng._slots:
+            if r is None:
+                continue
+            for pid in r.pages:
+                if pid in eng.prefix_cache._refs:
+                    assert eng.prefix_cache._refs[pid] >= 1
+        cache_only = 0
+        for pid, refs in eng.prefix_cache._refs.items():
+            assert refs >= 1, f"page {pid} with nonpositive refcount"
+            if pid not in live_pages:
+                cache_only += 1
+        # every usable page is exactly one of: free, held by a live
+        # sequence, or resident only via the cache index
+        assert free + len(live_pages) + cache_only == usable, (
+            f"page accounting broke: free={free} live={len(live_pages)} "
+            f"cache_only={cache_only} usable={usable}"
+        )
+
+    prompts = [
+        list(range(1, 1 + 2 * PS)),          # cacheable shared prefix
+        list(range(1, 1 + 2 * PS)) + [77],   # same prefix, different tail
+        list(range(50, 50 + PS + 3)),        # one full page + tail
+        [5, 6, 7],                           # sub-page (never cached)
+        list(range(100, 100 + 4 * PS)),      # 4 full pages: forces eviction
+    ]
+    evictions = {"n": 0}
+    orig_evict = eng.prefix_cache.evict
+
+    def counting_evict(n):
+        out = orig_evict(n)
+        if out:
+            evictions["n"] += 1
+        return out
+
+    eng.prefix_cache.evict = counting_evict
+    rejections_seen = 0
+    for round_no in range(40):
+        # sometimes stack a second request so sequences overlap in flight
+        for _ in range(rng.randrange(1, 3)):
+            if rng.random() < 0.15:
+                # over-large request: admission must reject and leave the
+                # accounting untouched
+                import pytest as _pytest
+
+                with _pytest.raises(ValueError):
+                    eng.add_request(list(range(200)), max_new_tokens=4)
+                rejections_seen += 1
+                check_invariants()
+                continue
+            p = prompts[rng.randrange(len(prompts))]
+            eng.add_request(p, max_new_tokens=rng.randrange(1, 6))
+        while eng.has_work():
+            eng.step()
+            check_invariants()  # including mid-flight states
+
+    assert rejections_seen > 0, "fuzz never exercised admission rejection"
+    assert evictions["n"] > 0, "fuzz never exercised cache eviction"
+
+    eng.abort_all("fuzz teardown")
+    assert eng.allocator.available == usable, "pool must drain to empty"
+    assert eng.prefix_cache.resident_pages() == 0
