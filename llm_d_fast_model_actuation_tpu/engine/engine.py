@@ -103,6 +103,10 @@ class Request:
     out_logprobs: List[float] = field(default_factory=list)
     #: nucleus sampling threshold; >= 1.0 = full distribution
     top_p: float = 1.0
+    #: OpenAI repetition penalties (0 = off); applied to logits before
+    #: temperature/top-p over counts of prompt + generated tokens
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     #: stop sequences (token tuples); on match the request finishes and
     #: the matched sequence is stripped from the output (OpenAI semantics)
     stop_seqs: tuple = ()
@@ -188,6 +192,11 @@ class InferenceEngine:
         self._last_tokens = np.zeros((b,), dtype=np.int32)
         self._temps = np.zeros((b,), dtype=np.float32)
         self._topps = np.ones((b,), dtype=np.float32)
+        self._pres = np.zeros((b,), dtype=np.float32)
+        self._freqs = np.zeros((b,), dtype=np.float32)
+        #: per-slot token counts over prompt + generated (penalties input);
+        #: host-exact mirror of the device copy the chunk program maintains
+        self._token_counts = np.zeros((b, cfg.model.vocab_size), dtype=np.int32)
         self._budgets = np.zeros((b,), dtype=np.int32)
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
@@ -206,7 +215,7 @@ class InferenceEngine:
         model_cfg = m
         self._model_cfg = m
 
-        def _sample_last(logits, lens, temp, topp, raw_key):
+        def _sample_last(logits, lens, temp, topp, counts, pres, freq, raw_key):
             """Shared sampling tail of both prefill programs: take the last
             valid logit, split the key, sample — one definition so the
             cache-hit path can never diverge from the cold one."""
@@ -215,16 +224,22 @@ class InferenceEngine:
             )[:, 0]
             key = jax.random.wrap_key_data(raw_key)
             key, sub = jax.random.split(key)
-            tok, lp = sample(last, sub, temp, top_p=topp)
+            tok, lp = sample(
+                last, sub, temp, top_p=topp,
+                counts=counts, presence_penalty=pres, frequency_penalty=freq,
+            )
             return tok, lp, jax.random.key_data(key)
 
         def _prefill(
-            params, tokens, seq_lens, cache, page_table, temp, topp, raw_key
+            params, tokens, seq_lens, cache, page_table, temp, topp,
+            counts, pres, freq, raw_key,
         ):
             logits, cache = llama.prefill(
                 params, model_cfg, tokens, seq_lens, cache, page_table
             )
-            tok, lp, raw_key = _sample_last(logits, seq_lens, temp, topp, raw_key)
+            tok, lp, raw_key = _sample_last(
+                logits, seq_lens, temp, topp, counts, pres, freq, raw_key
+            )
             return tok, lp, cache, raw_key
 
         # cache (arg 3) donated: prefill updates pages in place.
@@ -232,13 +247,13 @@ class InferenceEngine:
 
         def _suffix_prefill(
             params, tokens, start, suffix_lens, cache, page_table, temp, topp,
-            raw_key,
+            counts, pres, freq, raw_key,
         ):
             logits, cache = llama.prefill_continue(
                 params, model_cfg, tokens, start, suffix_lens, cache, page_table
             )
             tok, lp, raw_key = _sample_last(
-                logits, suffix_lens, temp, topp, raw_key
+                logits, suffix_lens, temp, topp, counts, pres, freq, raw_key
             )
             return tok, lp, cache, raw_key
 
@@ -274,33 +289,43 @@ class InferenceEngine:
         eos = self.cfg.eos_token_id
 
         def chunk(
-            params, lt, pos, budget, cache, page_table, temps, topps, raw_key
+            params, lt, pos, budget, cache, page_table, temps, topps,
+            counts, pres, freq, raw_key,
         ):
             key = jax.random.wrap_key_data(raw_key)
 
             def body(carry, _):
-                lt, pos, budget, cache, key = carry
+                lt, pos, budget, cache, counts, key = carry
                 active = budget > 0
                 logits, cache = llama.decode_step(
                     params, model_cfg, lt, pos, cache, page_table, active
                 )
                 key, sub = jax.random.split(key)
-                nxt, lp = sample(logits, sub, temps, top_p=topps)
+                nxt, lp = sample(
+                    logits, sub, temps, top_p=topps,
+                    counts=counts, presence_penalty=pres,
+                    frequency_penalty=freq,
+                )
                 nxt = jnp.where(active, nxt, lt)
                 a32 = active.astype(jnp.int32)
+                # the emitted token joins the counts the NEXT step penalizes
+                counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(a32)
                 pos = pos + a32
                 budget = budget - a32
                 if eos >= 0:
                     budget = jnp.where(active & (nxt == eos), 0, budget)
-                return (nxt, pos, budget, cache, key), (nxt, lp)
+                return (nxt, pos, budget, cache, counts, key), (nxt, lp)
 
-            (lt, pos, budget, cache, key), (toks, lps) = jax.lax.scan(
-                body, (lt, pos, budget, cache, key), None, length=T
+            (lt, pos, budget, cache, counts, key), (toks, lps) = jax.lax.scan(
+                body, (lt, pos, budget, cache, counts, key), None, length=T
             )
-            return toks, lps, lt, pos, budget, cache, jax.random.key_data(key)
+            return (
+                toks, lps, lt, pos, budget, cache, counts,
+                jax.random.key_data(key),
+            )
 
-        # donate scheduler state + cache + key data (all replaced each call)
-        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 8))
+        # donate scheduler state + cache + counts + key data
+        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 8, 11))
 
     def _chunk_fn(self, T: int):
         fn = self._chunk_fns.get(T)
@@ -319,6 +344,9 @@ class InferenceEngine:
             "pt": jax.device_put(self._page_table),
             "temps": jax.device_put(self._temps),
             "topp": jax.device_put(self._topps),
+            "counts": jax.device_put(self._token_counts),
+            "pres": jax.device_put(self._pres),
+            "freq": jax.device_put(self._freqs),
         }
         if isinstance(self._raw_key, np.ndarray):
             self._raw_key = jax.device_put(self._raw_key)
@@ -353,10 +381,18 @@ class InferenceEngine:
         temperature: float = 0.0,
         top_p: float = 1.0,
         stop_seqs: Seq[Seq[int]] = (),
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
         on_token: Optional[Callable[[Request, int], None]] = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
+        if self.lockstep is not None and (presence_penalty or frequency_penalty):
+            # penalties need the token-count state, which is too large for
+            # the lockstep frame; followers run with zero penalties only
+            raise ValueError(
+                "repetition penalties are not supported for multi-host gangs"
+            )
         total = len(prompt) + max_new_tokens
         if total > self.cfg.seq_len:
             raise ValueError(
@@ -375,6 +411,8 @@ class InferenceEngine:
             temperature=temperature,
             top_p=float(top_p),
             stop_seqs=tuple(tuple(int(t) for t in s) for s in stop_seqs),
+            presence_penalty=float(presence_penalty),
+            frequency_penalty=float(frequency_penalty),
             on_token=on_token,
         )
         self._next_seq_id += 1
@@ -435,6 +473,11 @@ class InferenceEngine:
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
         row[: len(req.pages)] = req.pages
         self._page_table[slot] = row
+        # penalties count prompt tokens too (OpenAI "text so far")
+        self._token_counts[slot] = 0
+        np.add.at(self._token_counts[slot], req.prompt, 1)
+        self._pres[slot] = req.presence_penalty
+        self._freqs[slot] = req.frequency_penalty
         self._dirty = True
         return True
 
@@ -459,7 +502,7 @@ class InferenceEngine:
 
     def _run_suffix_segment(
         self, req: Request, start_pos: int, seg: List[int], temp, topp,
-        final: bool,
+        counts_row, pres, freq, final: bool,
     ):
         """One prefill segment via the continue program: scatter the
         segment's KV, attend over everything already in the pages. Used by
@@ -489,6 +532,9 @@ class InferenceEngine:
             table,
             temp,
             topp,
+            counts_row,
+            pres,
+            freq,
             self._raw_key,
         )
         if final:
@@ -500,6 +546,9 @@ class InferenceEngine:
         n = len(req.prompt)
         temp = np.asarray([req.temperature], dtype=np.float32)
         topp = np.asarray([req.top_p], dtype=np.float32)
+        counts_row = self._token_counts[req.slot : req.slot + 1]
+        pres = np.asarray([req.presence_penalty], dtype=np.float32)
+        freq = np.asarray([req.frequency_penalty], dtype=np.float32)
         k = req.cached_tokens
         limit = self.cfg.max_prefill_tokens or (n - k)
         if k == 0 and n <= limit:
@@ -519,6 +568,9 @@ class InferenceEngine:
                 table,
                 temp,
                 topp,
+                counts_row,
+                pres,
+                freq,
                 self._raw_key,
             )
             self.pool.replace(cache)
@@ -530,7 +582,8 @@ class InferenceEngine:
             while pos < n:
                 seg = req.prompt[pos : min(n, pos + limit)]
                 tok, lp = self._run_suffix_segment(
-                    req, pos, seg, temp, topp, final=pos + len(seg) >= n
+                    req, pos, seg, temp, topp, counts_row, pres, freq,
+                    final=pos + len(seg) >= n,
                 )
                 pos += len(seg)
         if self.prefix_cache is not None:
@@ -556,6 +609,10 @@ class InferenceEngine:
             req.first_token_time = time.monotonic()
         req.out_tokens.append(token)
         req.out_logprobs.append(logprob)
+        if req.slot >= 0:
+            # host counts mirror the device copy the chunk program updates
+            # (stop-stripped tokens stay counted on both sides)
+            self._token_counts[req.slot, token] += 1
         stop_matched = False
         for seq in req.stop_seqs:
             if len(req.out_tokens) >= len(seq) and tuple(
@@ -592,6 +649,9 @@ class InferenceEngine:
         self._last_tokens[req.slot] = 0
         self._temps[req.slot] = 0.0
         self._topps[req.slot] = 1.0
+        self._pres[req.slot] = 0.0
+        self._freqs[req.slot] = 0.0
+        self._token_counts[req.slot] = 0
         self._budgets[req.slot] = 0
         req.slot = -1
         self._dirty = True
@@ -610,11 +670,16 @@ class InferenceEngine:
         if len(active) != 1:
             return None
         r = active[0]
-        # only temperature gates exactness: at temperature 0 sampling is
-        # the full-vocab argmax regardless of top_p, and streaming
-        # (on_token) already receives multi-token bursts from the chunk
-        # path, so both compose with speculation
-        if r.temperature != 0.0:
+        # only transforms that shift the argmax gate exactness: at
+        # temperature 0 sampling is the full-vocab argmax regardless of
+        # top_p, and streaming (on_token) already receives multi-token
+        # bursts from the chunk path — but repetition penalties DO move
+        # the argmax, and the verify program doesn't apply them
+        if (
+            r.temperature != 0.0
+            or r.presence_penalty != 0.0
+            or r.frequency_penalty != 0.0
+        ):
             return None
         return r
 
@@ -760,23 +825,28 @@ class InferenceEngine:
             if reupload:
                 self._upload_sched()
             d = self._dev
-            toks_dev, lps_dev, lt, pos, budget, cache, self._raw_key = (
-                self._chunk_fn(T)(
-                    self.params,
-                    d["lt"],
-                    d["pos"],
-                    d["budget"],
-                    self.pool.as_tuple(),
-                    d["pt"],
-                    d["temps"],
-                    d["topp"],
-                    self._raw_key,
-                )
+            (
+                toks_dev, lps_dev, lt, pos, budget, cache, counts_dev,
+                self._raw_key,
+            ) = self._chunk_fn(T)(
+                self.params,
+                d["lt"],
+                d["pos"],
+                d["budget"],
+                self.pool.as_tuple(),
+                d["pt"],
+                d["temps"],
+                d["topp"],
+                d["counts"],
+                d["pres"],
+                d["freq"],
+                self._raw_key,
             )
             self.pool.replace(cache)
             self._dev = {
                 "lt": lt, "pos": pos, "budget": budget,
                 "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
+                "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
             }
             toks = np.asarray(toks_dev)  # ONE host sync per chunk
             lps = np.asarray(lps_dev)

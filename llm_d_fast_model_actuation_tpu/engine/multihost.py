@@ -72,6 +72,11 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         "budget": np.zeros((b,), np.int32),
         "temps": np.zeros((b,), np.float32),
         "topps": np.ones((b,), np.float32),
+        # penalties are rejected for gangs (engine.add_request): these stay
+        # zero, which makes the samplers count-independent, so the [b,vocab]
+        # count arrays themselves never need to cross the frame
+        "pres": np.zeros((b,), np.float32),
+        "freqs": np.zeros((b,), np.float32),
         "page_table": np.zeros((b, p), np.int32),
     }
 
@@ -98,6 +103,8 @@ class LockstepLeader:
         f["budget"] = e._budgets.copy()
         f["temps"] = e._temps.copy()
         f["topps"] = e._topps.copy()
+        f["pres"] = e._pres.copy()
+        f["freqs"] = e._freqs.copy()
         f["page_table"] = e._page_table.copy()
 
     def _send(self, **fields: Any) -> None:
@@ -192,6 +199,8 @@ def _sync_mirrors(engine: Any, f: Dict[str, np.ndarray]) -> None:
     engine._budgets[:] = f["budget"]
     engine._temps[:] = f["temps"]
     engine._topps[:] = f["topps"]
+    engine._pres[:] = f["pres"]
+    engine._freqs[:] = f["freqs"]
     engine._page_table[:] = f["page_table"]
 
 
@@ -206,6 +215,8 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
     table = engine._page_table[slot : slot + 1]
     temp = np.asarray([float(f["temp"])], np.float32)
     topp = np.asarray([float(f["top_p"])], np.float32)
+    counts_row = engine._token_counts[slot : slot + 1]
+    zero = np.zeros((1,), np.float32)
     _tok, _lp, cache, engine._raw_key = engine._prefill_fn(
         engine.params,
         tokens,
@@ -214,6 +225,9 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
         table,
         temp,
         topp,
+        counts_row,
+        zero,
+        zero,
         engine._raw_key,
     )
     engine.pool.replace(cache)
@@ -232,6 +246,8 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
     table = engine._page_table[slot : slot + 1]
     temp = np.asarray([float(f["temp"])], np.float32)
     topp = np.asarray([float(f["top_p"])], np.float32)
+    counts_row = engine._token_counts[slot : slot + 1]
+    zero = np.zeros((1,), np.float32)
     _tok, _lp, cache, new_key = engine._suffix_prefill_fn(
         engine.params,
         tokens,
@@ -241,6 +257,9 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
         table,
         temp,
         topp,
+        counts_row,
+        zero,
+        zero,
         engine._raw_key,
     )
     if int(f["advance_key"]):
@@ -254,7 +273,9 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         _sync_mirrors(engine, f)
         engine._upload_sched()
     d = engine._dev
-    _toks, _lps, lt, pos, budget, cache, engine._raw_key = engine._chunk_fn(T)(
+    (
+        _toks, _lps, lt, pos, budget, cache, counts_dev, engine._raw_key
+    ) = engine._chunk_fn(T)(
         engine.params,
         d["lt"],
         d["pos"],
@@ -263,10 +284,14 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         d["pt"],
         d["temps"],
         d["topp"],
+        d["counts"],
+        d["pres"],
+        d["freq"],
         engine._raw_key,
     )
     engine.pool.replace(cache)
     engine._dev = {
         "lt": lt, "pos": pos, "budget": budget,
         "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
+        "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
     }

@@ -24,8 +24,24 @@ def sample(
     temperature: jnp.ndarray,  # [b] fp32; 0 = greedy
     top_p: "jnp.ndarray | None" = None,  # [b] fp32; >= 1 = full distribution
     top_k: int = 0,  # static; 0 = no truncation
+    counts: "jnp.ndarray | None" = None,  # [b, vocab] int32 token counts
+    presence_penalty: "jnp.ndarray | None" = None,  # [b] fp32
+    frequency_penalty: "jnp.ndarray | None" = None,  # [b] fp32
 ):
-    """Returns (token [b] int32, logprob [b] fp32 of the chosen token)."""
+    """Returns (token [b] int32, logprob [b] fp32 of the chosen token).
+
+    OpenAI-order transform chain: repetition penalties (subtract
+    freq*count + pres*[count>0] from the logits) -> temperature ->
+    top-p truncation. Penalties shift greedy decoding too. The reported
+    logprob is of the PENALIZED distribution (what was sampled from)."""
+    if counts is not None:
+        cf = counts.astype(jnp.float32)
+        pen = jnp.zeros_like(logits)
+        if frequency_penalty is not None:
+            pen = pen + frequency_penalty[:, None] * cf
+        if presence_penalty is not None:
+            pen = pen + presence_penalty[:, None] * (cf > 0)
+        logits = logits - pen
     if top_k > 0 and top_k < logits.shape[-1]:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)

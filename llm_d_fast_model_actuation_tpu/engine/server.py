@@ -432,12 +432,14 @@ class EngineService:
                         while self._pending:
                             (
                                 prompt, max_tokens, temperature, fut,
-                                on_token, top_p, stop_seqs,
+                                on_token, top_p, stop_seqs, presence, freq,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
                                     prompt, max_tokens, temperature,
                                     top_p=top_p, stop_seqs=stop_seqs,
+                                    presence_penalty=presence,
+                                    frequency_penalty=freq,
                                     on_token=on_token,
                                 )
                                 self._futures[seq_id] = fut
@@ -519,6 +521,8 @@ class EngineService:
         on_token: Optional[Any] = None,
         top_p: float = 1.0,
         stop_seqs: Any = (),
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -536,7 +540,8 @@ class EngineService:
             fut.set_exception(RuntimeError(self.failure))
             return fut
         self._pending.append(
-            (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs)
+            (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
+             presence_penalty, frequency_penalty)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -808,6 +813,16 @@ def build_app(service: EngineService) -> web.Application:
             raise ValueError(f"invalid generation parameter: {e}")
         if not (0.0 < top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        try:
+            pp = body.get("presence_penalty")
+            presence = 0.0 if pp is None else float(pp)
+            fp = body.get("frequency_penalty")
+            frequency = 0.0 if fp is None else float(fp)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"invalid penalty: {e}")
+        for name, v in (("presence_penalty", presence), ("frequency_penalty", frequency)):
+            if not (-2.0 <= v <= 2.0):
+                raise ValueError(f"{name} must be in [-2, 2], got {v}")
         stop_seqs = _parse_stop(body.get("stop"))
         # pre-validate everything add_request would reject, so streaming
         # requests fail with a 400 instead of an SSE error after headers
@@ -828,7 +843,10 @@ def build_app(service: EngineService) -> web.Application:
                 f"request needs {need} pages but the pool only has "
                 f"{cfg.num_pages - 1}"
             )
-        return tokens, max_tokens, temperature, top_p, stop_seqs
+        return (
+            tokens, max_tokens, temperature, top_p, stop_seqs,
+            presence, frequency,
+        )
 
     async def _stream_sse(
         request: web.Request,
@@ -837,6 +855,8 @@ def build_app(service: EngineService) -> web.Application:
         temperature: float,
         top_p: float,
         stop_seqs: tuple,
+        presence: float,
+        frequency: float,
         make_chunk,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
@@ -852,6 +872,7 @@ def build_app(service: EngineService) -> web.Application:
         fut = service.submit(
             tokens, max_tokens, temperature, on_token=on_token,
             top_p=top_p, stop_seqs=stop_seqs,
+            presence_penalty=presence, frequency_penalty=frequency,
         )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
@@ -929,7 +950,8 @@ def build_app(service: EngineService) -> web.Application:
         return n
 
     async def _gather_n(
-        n: int, tokens, max_tokens, temperature, top_p, stop_seqs
+        n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
+        presence, frequency,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -938,6 +960,7 @@ def build_app(service: EngineService) -> web.Application:
             service.submit(
                 tokens, max_tokens, temperature,
                 top_p=top_p, stop_seqs=stop_seqs,
+                presence_penalty=presence, frequency_penalty=frequency,
             )
             for _ in range(n)
         ]
@@ -955,9 +978,10 @@ def build_app(service: EngineService) -> web.Application:
         except Exception:
             raise web.HTTPBadRequest(text="invalid JSON body")
         try:
-            tokens, max_tokens, temperature, top_p, stop_seqs = (
-                _parse_generation(body, _tokenize(body.get("prompt")))
-            )
+            (
+                tokens, max_tokens, temperature, top_p, stop_seqs,
+                presence, frequency,
+            ) = _parse_generation(body, _tokenize(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
 
@@ -974,11 +998,12 @@ def build_app(service: EngineService) -> web.Application:
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                chunk,
+                presence, frequency, chunk,
             )
 
         reqs = await _gather_n(
-            n, tokens, max_tokens, temperature, top_p, stop_seqs
+            n, tokens, max_tokens, temperature, top_p, stop_seqs,
+            presence, frequency,
         )
         req = reqs[0]
         ttft = (
@@ -1021,9 +1046,10 @@ def build_app(service: EngineService) -> web.Application:
         except Exception:
             raise web.HTTPBadRequest(text="invalid JSON body")
         try:
-            tokens, max_tokens, temperature, top_p, stop_seqs = (
-                _parse_generation(body, _chat_prompt(body.get("messages")))
-            )
+            (
+                tokens, max_tokens, temperature, top_p, stop_seqs,
+                presence, frequency,
+            ) = _parse_generation(body, _chat_prompt(body.get("messages")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         n = _parse_n(body)
@@ -1040,11 +1066,12 @@ def build_app(service: EngineService) -> web.Application:
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                chunk,
+                presence, frequency, chunk,
             )
 
         reqs = await _gather_n(
-            n, tokens, max_tokens, temperature, top_p, stop_seqs
+            n, tokens, max_tokens, temperature, top_p, stop_seqs,
+            presence, frequency,
         )
         return web.json_response(
             {
