@@ -125,7 +125,27 @@ class Request:
     first_token_time: Optional[float] = None
     #: Streaming hook: called as on_token(req, token) for every emitted
     #: token, on the engine thread. Keep it cheap (enqueue, don't compute).
+    #: Tokens that could be the start of a stop sequence are held back
+    #: until disambiguated, so streamed output never contains stripped
+    #: stop-sequence content (OpenAI semantics).
     on_token: Optional[Callable[["Request", int], None]] = None
+    #: tokens already delivered to on_token (stop-prefix holdback cursor)
+    streamed: int = 0
+
+
+def _stop_holdback(out: List[int], stop_seqs) -> int:
+    """Length of the longest suffix of `out` that is a PROPER prefix of
+    any stop sequence — tokens that must not be streamed yet because the
+    next tokens may complete a stop match (and the whole match is then
+    stripped from the output)."""
+    best = 0
+    for seq in stop_seqs:
+        m = min(len(seq) - 1, len(out))
+        for k in range(m, best, -1):
+            if tuple(out[-k:]) == tuple(seq[:k]):
+                best = k
+                break
+    return best
 
 
 class EngineAsleep(RuntimeError):
@@ -430,10 +450,12 @@ class InferenceEngine:
         if slot is None:
             return False
         # a blocked request re-attempts every engine step: skip the whole
-        # match+alloc dance until allocator or cache state actually moved
+        # match+alloc dance until allocator or cache state actually moved.
+        # Keyed on mutation counters, not sizes: an evict+register of equal
+        # sizes changes what is matchable without moving either count.
         state = (
-            self.allocator.available,
-            self.prefix_cache.resident_pages() if self.prefix_cache else 0,
+            self.allocator.version,
+            self.prefix_cache.version if self.prefix_cache else 0,
         )
         if getattr(req, "_blocked_state", None) == state:
             return False
@@ -455,8 +477,8 @@ class InferenceEngine:
                 self.allocator.free(self.prefix_cache.release(shared))
             req.cached_tokens = 0
             req._blocked_state = (
-                self.allocator.available,
-                self.prefix_cache.resident_pages() if self.prefix_cache else 0,
+                self.allocator.version,
+                self.prefix_cache.version if self.prefix_cache else 0,
             )
             return False
         req.pages = shared + own
@@ -613,7 +635,6 @@ class InferenceEngine:
             # host counts mirror the device copy the chunk program updates
             # (stop-stripped tokens stay counted on both sides)
             self._token_counts[req.slot, token] += 1
-        stop_matched = False
         for seq in req.stop_seqs:
             if len(req.out_tokens) >= len(seq) and tuple(
                 req.out_tokens[-len(seq):]
@@ -623,7 +644,6 @@ class InferenceEngine:
                 del req.out_logprobs[-len(seq):]
                 req.done = True
                 req.finish_reason = "stop"
-                stop_matched = True
                 break
         if not req.done:
             if token == self.cfg.eos_token_id:
@@ -632,11 +652,35 @@ class InferenceEngine:
             elif len(req.out_tokens) >= req.max_new_tokens:
                 req.done = True
                 req.finish_reason = "length"
-        # the matched stop token is stripped from the output, so it must
-        # not be streamed either (earlier tokens of a multi-token stop were
-        # already streamed — the standard streaming caveat)
-        if req.on_token is not None and not stop_matched:
-            req.on_token(req, token)
+        self._stream(req)
+
+    def _stream(self, req: Request) -> None:
+        """Deliver newly-safe tokens to the streaming hook.
+
+        Tokens forming a suffix of the output that is a proper prefix of a
+        stop sequence are held back — they may yet be stripped. On finish,
+        everything that survived stripping is flushed; consumers see
+        `req.done` only on the final delivered token (the SSE writer keys
+        its terminator on it)."""
+        if req.on_token is None:
+            return
+        if req.done:
+            tail = req.out_tokens[req.streamed:]
+        else:
+            hold = _stop_holdback(req.out_tokens, req.stop_seqs)
+            tail = req.out_tokens[req.streamed : len(req.out_tokens) - hold]
+        if not tail:
+            return
+        # advance the cursor per delivered token: an on_token exception
+        # mid-flush must leave the rest re-flushable on the next emit
+        was_done = req.done
+        try:
+            for i, t in enumerate(tail):
+                req.done = was_done and i == len(tail) - 1
+                req.on_token(req, t)
+                req.streamed += 1
+        finally:
+            req.done = was_done
 
     def _retire(self, req: Request) -> None:
         if self.prefix_cache is not None:

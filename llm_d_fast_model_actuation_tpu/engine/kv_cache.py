@@ -78,6 +78,9 @@ class PageAllocator:
 
     num_pages: int
     _free: List[int] = field(default_factory=list)
+    #: monotonic mutation counter: bumps whenever the free list changes, so
+    #: blocked-admission memos can key on "did anything move" exactly
+    version: int = 0
 
     def __post_init__(self) -> None:
         if not self._free:
@@ -91,13 +94,19 @@ class PageAllocator:
         if n > len(self._free):
             raise OutOfPages(f"need {n} pages, have {len(self._free)}")
         out = [self._free.pop() for _ in range(n)]
+        if out:
+            self.version += 1
         return out
 
     def free(self, pages: List[int]) -> None:
+        returned = False
         for p in pages:
             if p == 0:
                 continue
             self._free.append(p)
+            returned = True
+        if returned:
+            self.version += 1
 
     @staticmethod
     def pages_needed(num_tokens: int, page_size: int) -> int:

@@ -55,6 +55,10 @@ class PrefixCache:
     _refs: Dict[int, int] = field(default_factory=dict)
     _by_hash: Dict[str, _Entry] = field(default_factory=dict)
     _clock: int = 0
+    #: monotonic mutation counter: bumps on register/evict (contents
+    #: changed), so blocked-admission memos can't be fooled by refcount
+    #: churn that returns sizes to their prior values
+    version: int = 0
     #: tokens served from cache instead of prefill (observability)
     hit_tokens: int = 0
     lookups: int = 0
@@ -132,6 +136,7 @@ class PrefixCache:
         full_pages = len(prompt) // ps
         parent = ""
         self._clock += 1
+        inserted = False
         for i in range(full_pages):
             if i < len(known_hashes):
                 h = known_hashes[i]
@@ -154,9 +159,12 @@ class PrefixCache:
                 self._refs[page_ids[i]] = self._refs.get(page_ids[i], 0) + 1
                 if parent:
                     self._by_hash[parent].children += 1
+                inserted = True
             else:
                 e.last_used = self._clock
             parent = h
+        if inserted:
+            self.version += 1
 
     # -- release / eviction --------------------------------------------------
 
@@ -217,6 +225,8 @@ class PrefixCache:
                         heapq.heappush(
                             heap, (parent.last_used, parent.chain_hash)
                         )
+        if freed:
+            self.version += 1
         return freed
 
     def clear(self) -> List[int]:
@@ -232,6 +242,7 @@ class PrefixCache:
             else:  # a live holder remains (defensive; callers retire first)
                 self._refs[e.page_id] = n - 1
         self._by_hash.clear()
+        self.version += 1
         return freed
 
     # -- introspection -------------------------------------------------------

@@ -33,7 +33,10 @@ def sample(
     OpenAI-order transform chain: repetition penalties (subtract
     freq*count + pres*[count>0] from the logits) -> temperature ->
     top-p truncation. Penalties shift greedy decoding too. The reported
-    logprob is of the PENALIZED distribution (what was sampled from)."""
+    logprob is OpenAI-style "raw": normalized over the penalized (and
+    top-k-truncated) logits BEFORE temperature scaling and top-p
+    truncation — for temperature != 1 or top_p < 1 it is not the exact
+    distribution the token was drawn from."""
     if counts is not None:
         cf = counts.astype(jnp.float32)
         pen = jnp.zeros_like(logits)

@@ -811,6 +811,8 @@ def build_app(service: EngineService) -> web.Application:
             )
         except (TypeError, ValueError) as e:
             raise ValueError(f"invalid generation parameter: {e}")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         if not (0.0 < top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         try:

@@ -216,3 +216,90 @@ def test_service_abort_frees_slot():
         assert len(out.out_tokens) == 4
     finally:
         svc.shutdown()
+
+
+# ---------------------------------------------------- streaming stop holdback
+
+
+def test_allocator_version_counts_mutations():
+    a = PageAllocator(8)
+    v0 = a.version
+    pages = a.alloc(2)
+    assert a.version > v0
+    v1 = a.version
+    a.free([])  # no-op: nothing moved
+    assert a.version == v1
+    a.free(pages)
+    assert a.version > v1
+
+
+def _stream_req(engine, stop_seqs, max_new_tokens=16):
+    from llm_d_fast_model_actuation_tpu.engine.engine import Request
+
+    seen = []
+    req = Request(
+        seq_id=0,
+        prompt=[1],
+        max_new_tokens=max_new_tokens,
+        stop_seqs=tuple(tuple(s) for s in stop_seqs),
+        on_token=lambda r, t: seen.append((t, r.done)),
+    )
+    return req, seen
+
+
+def test_stream_holds_back_stop_prefix_until_disambiguated(engine):
+    """A token that could start a multi-token stop sequence is not streamed
+    until the next token rules the match out — then both flush."""
+    req, seen = _stream_req(engine, [(5, 6)])
+    engine._emit(req, 1)
+    assert seen == [(1, False)]
+    engine._emit(req, 5)  # possible start of (5, 6): held back
+    assert seen == [(1, False)]
+    engine._emit(req, 7)  # disambiguated: 5 then 7 both stream
+    assert [t for t, _ in seen] == [1, 5, 7] == req.out_tokens
+    assert not req.done
+
+
+def test_stream_never_emits_stripped_stop_content(engine):
+    req, seen = _stream_req(engine, [(5, 6)])
+    for t in (1, 5, 6):
+        engine._emit(req, t)
+    assert req.done and req.finish_reason == "stop"
+    assert req.out_tokens == [1]
+    # the held-back 5 and the matching 6 were stripped, never streamed
+    assert seen == [(1, False)]
+
+
+def test_stream_flushes_survivors_on_other_stop_match(engine):
+    """A held-back prefix of stop A that survives because stop B matched
+    instead is flushed, carrying the done flag on the final token only."""
+    req, seen = _stream_req(engine, [(5, 6), (7,)])
+    engine._emit(req, 5)  # held: possible start of (5, 6)
+    assert seen == []
+    engine._emit(req, 7)  # stop (7,) matches; 5 survives into the output
+    assert req.done and req.out_tokens == [5]
+    assert seen == [(5, True)]
+
+
+def test_stream_holdback_overlapping_prefix(engine):
+    req, seen = _stream_req(engine, [(5, 5, 6)])
+    for t in (5, 5, 5, 6):
+        engine._emit(req, t)
+    assert req.done and req.finish_reason == "stop"
+    assert req.out_tokens == [5]
+    assert [t for t, _ in seen] == [5]
+
+
+def test_stream_flushes_held_tokens_on_eos_and_length(engine):
+    eos = engine.cfg.eos_token_id
+    req, seen = _stream_req(engine, [(5, 6)])
+    for t in (1, 5, eos):
+        engine._emit(req, t)
+    assert req.done and req.out_tokens == [1, 5, eos]
+    assert seen == [(1, False), (5, False), (eos, True)]
+
+    req, seen = _stream_req(engine, [(5, 6)], max_new_tokens=2)
+    engine._emit(req, 1)
+    engine._emit(req, 5)  # budget exhausted: held 5 flushes with done
+    assert req.done and req.finish_reason == "length"
+    assert seen == [(1, False), (5, True)]

@@ -491,3 +491,25 @@ def test_metrics_endpoint_exports_engine_gauges():
         run_async(_client(svc, scenario))
     finally:
         svc.shutdown()
+
+
+def test_max_tokens_validation(service):
+    async def scenario(client):
+        for bad in (0, -3):
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": bad},
+            )
+            assert r.status == 400, await r.text()
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 0, "stream": True},
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 1}
+        )
+        body = await r.json()
+        assert r.status == 200 and len(body["choices"][0]["token_ids"]) == 1
+
+    run_async(_client(service, scenario))

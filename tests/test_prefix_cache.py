@@ -340,3 +340,32 @@ def test_fuzz_page_accounting_invariants():
     eng.abort_all("fuzz teardown")
     assert eng.allocator.available == usable, "pool must drain to empty"
     assert eng.prefix_cache.resident_pages() == 0
+
+
+def test_version_bumps_only_on_content_mutation():
+    """The mutation counter feeds blocked-admission memos: refcount churn
+    that leaves sizes unchanged must not look like 'nothing happened'."""
+    pc = PrefixCache(page_size=4)
+    prompt = list(range(8))
+    v0 = pc.version
+    pc.match(prompt)
+    pc.acquire([10, 11])
+    assert pc.version == v0  # lookups/refcounts are not content changes
+    pc.register(prompt, [10, 11], 0)
+    assert pc.version > v0
+    v1 = pc.version
+    pc.release([10, 11])  # index refs remain; nothing freed
+    assert pc.version == v1
+    freed = pc.evict(2)
+    assert len(freed) == 2 and pc.version > v1
+    v2 = pc.version
+    # re-registering already-present content inserts nothing: no bump
+    pc.acquire([20, 21])
+    pc.register(prompt, [20, 21], 0)
+    assert pc.version > v2  # (fresh after evict: real insertion)
+    v3 = pc.version
+    pc.acquire([30, 31])
+    pc.register(prompt, [30, 31], 0)  # same chain already indexed
+    assert pc.version == v3
+    pc.register([1, 2], [40], 0)  # shorter than a page: nothing to insert
+    assert pc.version == v3
