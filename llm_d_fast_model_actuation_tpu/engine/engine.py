@@ -52,6 +52,9 @@ class EngineConfig:
     num_pages: int = 2048
     max_seq_len: int = 0  # 0 -> model.max_seq_len
     eos_token_id: int = -1  # -1 = never stop on EOS
+    #: additional stopping ids (Llama-3-Instruct declares [eos, eom, eot];
+    #: chat turns end with eot, not the primary eos)
+    extra_eos_ids: tuple = ()
     #: Attention implementation: "auto" (pallas on TPU, grouped elsewhere),
     #: "grouped" (GQA-grouped XLA, deferred cache scatter), "pallas"
     #: (hand-written TPU kernels; interpreter mode off-TPU), or "reference"
@@ -131,6 +134,10 @@ class Request:
     on_token: Optional[Callable[["Request", int], None]] = None
     #: tokens already delivered to on_token (stop-prefix holdback cursor)
     streamed: int = 0
+    #: external early-stop request (e.g. a stop STRING matched on decoded
+    #: text in the server layer): the engine finishes the request at the
+    #: next emitted token instead of decoding to eos/max_tokens
+    stop_requested: bool = False
 
 
 def _stop_holdback(out: List[int], stop_seqs) -> int:
@@ -221,6 +228,9 @@ class InferenceEngine:
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
         self._next_seq_id = 1
+        #: lifetime emitted-token count (observability; lets tests assert
+        #: that early stopping really saved decode work)
+        self.total_tokens_emitted = 0
         self._raw_key: Any = np.asarray(
             jax.random.key_data(jax.random.key(seed + 1))
         )  # uint32 key data; device-resident after first upload
@@ -631,6 +641,7 @@ class InferenceEngine:
             req.first_token_time = time.monotonic()
         req.out_tokens.append(token)
         req.out_logprobs.append(logprob)
+        self.total_tokens_emitted += 1
         if req.slot >= 0:
             # host counts mirror the device copy the chunk program updates
             # (stop-stripped tokens stay counted on both sides)
@@ -646,7 +657,11 @@ class InferenceEngine:
                 req.finish_reason = "stop"
                 break
         if not req.done:
-            if token == self.cfg.eos_token_id:
+            if (
+                req.stop_requested
+                or token == self.cfg.eos_token_id
+                or token in self.cfg.extra_eos_ids
+            ):
                 req.done = True
                 req.finish_reason = "stop"
             elif len(req.out_tokens) >= req.max_new_tokens:

@@ -212,6 +212,13 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "the chip (auto = on for TPU, off elsewhere)",
     )
     p.add_argument(
+        "--tokenizer",
+        default="",
+        help="HF tokenizer directory (text prompts, chat templates, stop "
+        "strings, response text). Defaults to the hf: model directory when "
+        "it ships tokenizer files; otherwise a byte-level fallback",
+    )
+    p.add_argument(
         "--checkpoint-dir",
         default="",
         help="load weights from this Orbax checkpoint (and reload from it "
@@ -254,9 +261,16 @@ def resolve_distributed(args: argparse.Namespace) -> Optional[Dict[str, Any]]:
 
 
 def validate_parsed_args(args: argparse.Namespace) -> None:
-    if args.model not in MODEL_CONFIGS:
+    if args.model.startswith("hf:"):
+        # Hugging Face model directory (models/hf.py). Existence is checked
+        # at engine start, not parse time: the controller validates options
+        # strings on hosts that don't mount the model volume.
+        if not args.model[3:]:
+            raise ValueError("--model hf: needs a directory path")
+    elif args.model not in MODEL_CONFIGS:
         raise ValueError(
-            f"unknown model {args.model!r}; known: {sorted(MODEL_CONFIGS)}"
+            f"unknown model {args.model!r}; known: {sorted(MODEL_CONFIGS)} "
+            "or hf:<model-dir>"
         )
     if args.tensor_parallel_size < 1:
         raise ValueError("--tensor-parallel-size must be >= 1")
@@ -312,12 +326,48 @@ class EngineService:
             import jax
 
             jax.distributed.initialize(**dist)
-        model_cfg = MODEL_CONFIGS[args.model]()
-        if args.quantization and model_cfg.quantization != args.quantization:
-            import dataclasses
+        self.hf_dir = ""
+        eos_token_id = args.eos_token_id
+        extra_eos: tuple = ()
+        if args.model.startswith("hf:"):
+            from ..models import hf as hf_models
 
-            model_cfg = dataclasses.replace(
-                model_cfg, quantization=args.quantization
+            self.hf_dir = args.model[3:]
+            model_cfg = hf_models.config_from_hf(
+                self.hf_dir, quantization=args.quantization or ""
+            )
+            if eos_token_id < 0:
+                all_eos = hf_models.eos_token_ids_from_hf(self.hf_dir)
+                if all_eos:
+                    # Llama-3-Instruct style multi-eos: chat turns end
+                    # with <|eot_id|>, not the primary eos
+                    eos_token_id = all_eos[0]
+                    extra_eos = tuple(all_eos[1:])
+        else:
+            model_cfg = MODEL_CONFIGS[args.model]()
+            if args.quantization and model_cfg.quantization != args.quantization:
+                import dataclasses
+
+                model_cfg = dataclasses.replace(
+                    model_cfg, quantization=args.quantization
+                )
+        from . import tokenizer as tokenizer_mod
+
+        tok_path = getattr(args, "tokenizer", "") or ""
+        if (
+            not tok_path
+            and self.hf_dir
+            and tokenizer_mod.has_tokenizer_files(self.hf_dir)
+        ):
+            tok_path = self.hf_dir
+        self.tokenizer = tokenizer_mod.load_tokenizer(tok_path)
+        if eos_token_id < 0 and self.hf_dir:
+            # last resort: the tokenizer knows its eos even when neither
+            # config.json nor generation_config.json declares one
+            eos_token_id = (
+                self.tokenizer.eos_token_id
+                if self.tokenizer.eos_token_id is not None
+                else -1
             )
         mesh = None
         if args.tensor_parallel_size > 1:
@@ -332,6 +382,11 @@ class EngineService:
             params = checkpoint.load_params(
                 self.checkpoint_dir, model_cfg, mesh=mesh
             )
+        elif self.hf_dir:
+            from ..models import hf as hf_models
+
+            # host-side load; InferenceEngine shards onto the mesh
+            params = hf_models.load_params(self.hf_dir, model_cfg)
         self.engine = InferenceEngine(
             EngineConfig(
                 model=model_cfg,
@@ -339,7 +394,8 @@ class EngineService:
                 page_size=args.page_size,
                 num_pages=args.num_pages,
                 max_seq_len=args.max_model_len or 0,
-                eos_token_id=args.eos_token_id,
+                eos_token_id=eos_token_id,
+                extra_eos_ids=extra_eos,
                 attention_impl=args.attention_impl,
                 decode_chunk=args.decode_chunk,
                 prefix_caching=args.prefix_caching == "on",
@@ -619,6 +675,15 @@ class EngineService:
                         params = _ckpt.load_params(
                             self.checkpoint_dir, m, mesh=eng.mesh
                         )
+                    elif self.hf_dir:
+                        from ..models import hf as _hf
+                        from ..models.registry import logical_axes_for
+
+                        params = _hf.load_params(self.hf_dir, m)
+                        if eng.mesh is not None:
+                            params = shard_pytree(
+                                params, eng.mesh, logical_axes_for(m)
+                            )
                     else:
                         from ..models.registry import (
                             init_params_for,
@@ -670,31 +735,17 @@ class EngineService:
             self._publisher.clear()
 
 
-def _tokenize(prompt: Any) -> List[int]:
-    if isinstance(prompt, list):
-        return [int(t) for t in prompt]
-    if isinstance(prompt, str):
-        return list(prompt.encode("utf-8"))
-    raise ValueError("prompt must be a string or a list of token ids")
-
-
-def _chat_prompt(messages: Any) -> List[int]:
-    """Flatten OpenAI-style chat messages into the engine's byte-level token
-    stream (role-tagged lines + assistant cue; a real tokenizer slots in
-    here when models ship with one)."""
+def _validate_messages(messages: Any) -> List[Dict[str, Any]]:
     if not isinstance(messages, list) or not messages:
         raise ValueError("messages must be a non-empty list")
-    parts: List[str] = []
     for m in messages:
         if not isinstance(m, dict) or "role" not in m or "content" not in m:
             raise ValueError("each message needs role and content")
-        parts.append(f"<|{m['role']}|>\n{m['content']}\n")
-    parts.append("<|assistant|>\n")
-    return list("".join(parts).encode("utf-8"))
-
-
-def _detok(tokens: List[int]) -> str:
-    return bytes(t % 256 for t in tokens).decode("utf-8", errors="replace")
+        if not isinstance(m["content"], str):
+            # OpenAI content-parts arrays (multimodal) are not supported;
+            # they would also crash HF chat templates with a 500
+            raise ValueError("message content must be a string")
+    return messages
 
 
 def _finish_reason(service: "EngineService", req: Any) -> str:
@@ -711,6 +762,26 @@ def _finish_reason(service: "EngineService", req: Any) -> str:
 def build_app(service: EngineService) -> web.Application:
     app = web.Application()
     vocab = service.engine.cfg.model.vocab_size
+    tok = service.tokenizer
+
+    def _encode_prompt(prompt: Any) -> List[int]:
+        if isinstance(prompt, list):
+            return [int(t) for t in prompt]
+        if isinstance(prompt, str):
+            return tok.encode(prompt)
+        raise ValueError("prompt must be a string or a list of token ids")
+
+    def _chat_tokens(messages: Any) -> List[int]:
+        msgs = _validate_messages(messages)
+        try:
+            return tok.chat_tokens(msgs)
+        except ValueError:
+            raise
+        except Exception as e:
+            # jinja TemplateError on role patterns the model's template
+            # rejects, TypeError on content-parts arrays, ...: malformed
+            # request input, not a server fault -> 400
+            raise ValueError(f"chat template failed: {e}")
 
     async def health(request: web.Request) -> web.Response:
         if service.failure is not None:
@@ -775,17 +846,24 @@ def build_app(service: EngineService) -> web.Application:
 
     def _parse_stop(stop: Any) -> tuple:
         """OpenAI `stop`: a string, a list of strings, or token-id lists.
-        Malformed values must surface as ValueError (-> HTTP 400)."""
+        Malformed values must surface as ValueError (-> HTTP 400).
+
+        Returns (token_seqs, stop_texts). Token-id stops match in the
+        engine; STRING stops match on decoded text in the response layer
+        (tokenizer.TextStopStream / truncate_at_text_stop) — BPE does not
+        round-trip decode→encode, and a stop string can start mid-token,
+        so re-encoding strings into token sequences would miss matches."""
         if stop is None:
-            return ()
+            return (), ()
         if isinstance(stop, str):
             stop = [stop]
         if not isinstance(stop, list):
             raise ValueError("stop must be a string or a list")
         seqs = []
+        texts = []
         for s in stop:
             if isinstance(s, str):
-                seqs.append(tuple(t % vocab for t in s.encode("utf-8")))
+                texts.append(s)
             elif isinstance(s, int):
                 seqs.append((s % vocab,))
             elif isinstance(s, list):
@@ -795,7 +873,7 @@ def build_app(service: EngineService) -> web.Application:
                     raise ValueError(f"invalid stop token list {s!r}") from e
             else:
                 raise ValueError(f"invalid stop entry {s!r}")
-        return tuple(s for s in seqs if s)
+        return tuple(s for s in seqs if s), tuple(t for t in texts if t)
 
     def _parse_generation(body: Dict[str, Any], tokens: List[int]):
         tokens = [t % vocab for t in tokens]
@@ -825,7 +903,7 @@ def build_app(service: EngineService) -> web.Application:
         for name, v in (("presence_penalty", presence), ("frequency_penalty", frequency)):
             if not (-2.0 <= v <= 2.0):
                 raise ValueError(f"{name} must be in [-2, 2], got {v}")
-        stop_seqs = _parse_stop(body.get("stop"))
+        stop_seqs, stop_texts = _parse_stop(body.get("stop"))
         # pre-validate everything add_request would reject, so streaming
         # requests fail with a 400 instead of an SSE error after headers
         # are out
@@ -846,7 +924,7 @@ def build_app(service: EngineService) -> web.Application:
                 f"{cfg.num_pages - 1}"
             )
         return (
-            tokens, max_tokens, temperature, top_p, stop_seqs,
+            tokens, max_tokens, temperature, top_p, stop_seqs, stop_texts,
             presence, frequency,
         )
 
@@ -857,6 +935,7 @@ def build_app(service: EngineService) -> web.Application:
         temperature: float,
         top_p: float,
         stop_seqs: tuple,
+        stop_texts: tuple,
         presence: float,
         frequency: float,
         make_chunk,
@@ -864,7 +943,15 @@ def build_app(service: EngineService) -> web.Application:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
         token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
         event-loop boundary via call_soon_threadsafe into an asyncio queue,
-        so delivery granularity is the engine's decode chunk."""
+        so delivery granularity is the engine's decode chunk.
+
+        Chunk text comes from an incremental detokenizer; stop STRINGS are
+        matched here on the decoded text (held back until disambiguated)
+        and end the stream early, aborting the in-flight generation."""
+        from .tokenizer import IncrementalDecoder, TextStopStream
+
+        filt = TextStopStream(tok, stop_texts) if stop_texts else None
+        dec = IncrementalDecoder(tok)
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -884,6 +971,7 @@ def build_app(service: EngineService) -> web.Application:
             }
         )
         qtask: Optional[asyncio.Task] = None
+        held_ids: List[int] = []
         try:
             # inside the try: a disconnect cancelling this await must still
             # abort the in-flight generation
@@ -896,9 +984,38 @@ def build_app(service: EngineService) -> web.Application:
                     {qtask, afut}, return_when=asyncio.FIRST_COMPLETED
                 )
                 if qtask in done_set:
-                    tok, req_done = qtask.result()
+                    t, req_done = qtask.result()
                     qtask = None
-                    payload = json.dumps(make_chunk(tok, index))
+                    if filt is not None:
+                        held_ids.append(t)
+                        text, matched = filt.push(t)
+                        if not matched and req_done:
+                            tail, matched = filt.flush()
+                            text += tail
+                        if matched:
+                            # everything before the stop flushes in one
+                            # final chunk; ids of the (possibly partial)
+                            # stop content are suppressed with its text
+                            if text:
+                                payload = json.dumps(
+                                    make_chunk(text, [], index)
+                                )
+                                index += 1
+                                await resp.write(
+                                    f"data: {payload}\n\n".encode()
+                                )
+                            if not req_done:
+                                service.abort(fut)
+                            break
+                        if not text and not req_done:
+                            continue  # held back: ids stay buffered too
+                        ids, held_ids = held_ids, []
+                    else:
+                        text = dec.push(t)
+                        if req_done:
+                            text += dec.flush()
+                        ids = [t]
+                    payload = json.dumps(make_chunk(text, ids, index))
                     index += 1
                     await resp.write(f"data: {payload}\n\n".encode())
                     if req_done:
@@ -951,9 +1068,25 @@ def build_app(service: EngineService) -> web.Application:
             raise web.HTTPBadRequest(text="n > 1 is not supported with stream")
         return n
 
+    def _text_stop_watcher(stop_texts: tuple):
+        """Engine-thread callback that asks for early termination once the
+        decoded text contains a stop string — without it, a non-streaming
+        request with stops would decode to eos/max_tokens holding a batch
+        slot, and only the response text would be truncated."""
+        from .tokenizer import TextStopStream
+
+        filt = TextStopStream(tok, stop_texts)
+
+        def on_token(req, t: int) -> None:
+            _, matched = filt.push(t)
+            if matched:
+                req.stop_requested = True
+
+        return on_token
+
     async def _gather_n(
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
-        presence, frequency,
+        presence, frequency, stop_texts=(),
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -963,6 +1096,9 @@ def build_app(service: EngineService) -> web.Application:
                 tokens, max_tokens, temperature,
                 top_p=top_p, stop_seqs=stop_seqs,
                 presence_penalty=presence, frequency_penalty=frequency,
+                on_token=(
+                    _text_stop_watcher(stop_texts) if stop_texts else None
+                ),
             )
             for _ in range(n)
         ]
@@ -982,30 +1118,30 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                presence, frequency,
-            ) = _parse_generation(body, _tokenize(body.get("prompt")))
+                stop_texts, presence, frequency,
+            ) = _parse_generation(body, _encode_prompt(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
 
         n = _parse_n(body)
         if body.get("stream"):
-            def chunk(tok: int, index: int) -> Dict[str, Any]:
+            def chunk(text: str, ids: List[int], index: int) -> Dict[str, Any]:
                 return {
                     "object": "text_completion",
                     "model": service.args.model,
                     "choices": [
-                        {"index": 0, "text": _detok([tok]), "token_ids": [tok]}
+                        {"index": 0, "text": text, "token_ids": ids}
                     ],
                 }
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                presence, frequency, chunk,
+                stop_texts, presence, frequency, chunk,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
-            presence, frequency,
+            presence, frequency, stop_texts,
         )
         req = reqs[0]
         ttft = (
@@ -1013,18 +1149,27 @@ def build_app(service: EngineService) -> web.Application:
             if req.first_token_time
             else None
         )
+        from .tokenizer import truncate_at_text_stop
+
         choices = []
+        total_completion = 0
         for i, r in enumerate(reqs):
+            kept, kept_lps, text, matched = truncate_at_text_stop(
+                tok, r.out_tokens, r.out_logprobs, stop_texts
+            )
+            total_completion += len(kept)
             choice = {
                 "index": i,
-                "token_ids": r.out_tokens,
-                "text": _detok(r.out_tokens),
-                "finish_reason": _finish_reason(service, r),
+                "token_ids": kept,
+                "text": text,
+                "finish_reason": (
+                    "stop" if matched else _finish_reason(service, r)
+                ),
             }
             if body.get("logprobs"):
                 choice["logprobs"] = {
-                    "tokens": r.out_tokens,
-                    "token_logprobs": r.out_logprobs,
+                    "tokens": kept,
+                    "token_logprobs": kept_lps,
                 }
             choices.append(choice)
         return web.json_response(
@@ -1034,9 +1179,7 @@ def build_app(service: EngineService) -> web.Application:
                 "choices": choices,
                 "usage": {
                     "prompt_tokens": len(tokens),
-                    "completion_tokens": sum(
-                        len(r.out_tokens) for r in reqs
-                    ),
+                    "completion_tokens": total_completion,
                     "time_to_first_token_s": ttft,
                 },
             }
@@ -1050,14 +1193,14 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                presence, frequency,
-            ) = _parse_generation(body, _chat_prompt(body.get("messages")))
+                stop_texts, presence, frequency,
+            ) = _parse_generation(body, _chat_tokens(body.get("messages")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         n = _parse_n(body)
         if body.get("stream"):
-            def chunk(tok: int, index: int) -> Dict[str, Any]:
-                delta: Dict[str, Any] = {"content": _detok([tok])}
+            def chunk(text: str, ids: List[int], index: int) -> Dict[str, Any]:
+                delta: Dict[str, Any] = {"content": text}
                 if index == 0:
                     delta["role"] = "assistant"
                 return {
@@ -1068,32 +1211,43 @@ def build_app(service: EngineService) -> web.Application:
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                presence, frequency, chunk,
+                stop_texts, presence, frequency, chunk,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
-            presence, frequency,
+            presence, frequency, stop_texts,
         )
+        from .tokenizer import truncate_at_text_stop
+
+        choices = []
+        total_completion = 0
+        for i, r in enumerate(reqs):
+            kept, _, text, matched = truncate_at_text_stop(
+                tok, r.out_tokens, r.out_logprobs, stop_texts
+            )
+            total_completion += len(kept)
+            choices.append(
+                {
+                    "index": i,
+                    "message": {
+                        "role": "assistant",
+                        "content": text,
+                        "token_ids": kept,
+                    },
+                    "finish_reason": (
+                        "stop" if matched else _finish_reason(service, r)
+                    ),
+                }
+            )
         return web.json_response(
             {
                 "object": "chat.completion",
                 "model": service.args.model,
-                "choices": [
-                    {
-                        "index": i,
-                        "message": {
-                            "role": "assistant",
-                            "content": _detok(r.out_tokens),
-                            "token_ids": r.out_tokens,
-                        },
-                        "finish_reason": _finish_reason(service, r),
-                    }
-                    for i, r in enumerate(reqs)
-                ],
+                "choices": choices,
                 "usage": {
                     "prompt_tokens": len(tokens),
-                    "completion_tokens": sum(len(r.out_tokens) for r in reqs),
+                    "completion_tokens": total_completion,
                 },
             }
         )

@@ -1,0 +1,240 @@
+"""Tokenization for the OpenAI-facing server.
+
+The reference's engine (vLLM) tokenizes text prompts with the model's own
+Hugging Face tokenizer; this module gives our server the same behavior.
+When the served model directory (or `--tokenizer`) carries tokenizer files,
+text prompts, chat templates, stop strings, and response text all go
+through the real tokenizer. Without one, the byte-level fallback keeps the
+token-id API fully functional (tests, synthetic models).
+
+Streaming uses `IncrementalDecoder`: decoding token-by-token is wrong for
+SentencePiece/BPE (word-boundary markers, multi-byte codepoints split
+across tokens), so deltas are computed as decode(all)[len(prev):], holding
+back a trailing U+FFFD that marks an incomplete byte sequence.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence
+
+
+def _fallback_chat_text(messages: Sequence[Any]) -> str:
+    """Role-tagged flattening for models without a chat template."""
+    parts: List[str] = []
+    for m in messages:
+        parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids — the no-tokenizer fallback."""
+
+    eos_token_id: Optional[int] = None
+
+    def encode(self, text: str, special: bool = True) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        return bytes(t % 256 for t in tokens).decode(
+            "utf-8", errors="replace"
+        )
+
+    def chat_tokens(self, messages: Sequence[Any]) -> List[int]:
+        return self.encode(_fallback_chat_text(messages))
+
+
+class HFTokenizer:
+    """A Hugging Face tokenizer loaded from a LOCAL directory (the image
+    has no network egress; models ship their tokenizers alongside the
+    weights, exactly as vLLM consumes them)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(
+            path, local_files_only=True
+        )
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._tok.eos_token_id
+
+    def encode(self, text: str, special: bool = True) -> List[int]:
+        return list(self._tok.encode(text, add_special_tokens=special))
+
+    def decode(self, tokens: Sequence[int]) -> str:
+        return self._tok.decode(list(tokens), skip_special_tokens=True)
+
+    def chat_tokens(self, messages: Sequence[Any]) -> List[int]:
+        if getattr(self._tok, "chat_template", None):
+            return list(
+                self._tok.apply_chat_template(
+                    list(messages), add_generation_prompt=True
+                )
+            )
+        return self.encode(_fallback_chat_text(messages))
+
+
+#: files whose presence marks an HF tokenizer directory
+_TOKENIZER_FILES = (
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "tokenizer.model",
+    "vocab.json",
+)
+
+
+def has_tokenizer_files(path: str) -> bool:
+    return any(
+        os.path.isfile(os.path.join(path, f)) for f in _TOKENIZER_FILES
+    )
+
+
+def load_tokenizer(path: str = ""):
+    """HFTokenizer for a directory path, ByteTokenizer for ''."""
+    if path:
+        return HFTokenizer(path)
+    return ByteTokenizer()
+
+
+class IncrementalDecoder:
+    """Stream-safe detokenization: each push returns the NEW text the
+    growing token sequence decodes to, never re-emitting and never
+    emitting the replacement character for a not-yet-complete byte
+    sequence (it flushes once the continuation tokens arrive).
+
+    Cost is O(window) per push, not O(tokens-so-far): only the tokens
+    since the last emission (plus a small already-emitted context window
+    for tokenizers whose spacing depends on the previous token) are
+    re-decoded — the prefix/read-offset scheme vLLM's incremental
+    detokenizer uses."""
+
+    def __init__(self, tokenizer) -> None:
+        self._tok = tokenizer
+        self._tokens: List[int] = []
+        self._prefix = 0  # start of the decode context window
+        self._read = 0  # tokens whose text has been emitted
+
+    def push(self, token: int) -> str:
+        self._tokens.append(int(token))
+        ctx = self._tok.decode(self._tokens[self._prefix : self._read])
+        full = self._tok.decode(self._tokens[self._prefix :])
+        # a trailing U+FFFD marks a split multi-byte sequence: hold until
+        # the continuation tokens arrive (flush releases a genuine one)
+        if len(full) > len(ctx) and not full.endswith("�"):
+            out = full[len(ctx) :]
+            self._prefix = self._read
+            self._read = len(self._tokens)
+            return out
+        return ""
+
+    def flush(self) -> str:
+        """Release any held tail (e.g. a trailing U+FFFD from a byte
+        sequence the stream ended mid-way through) so streamed text equals
+        the full decode exactly."""
+        ctx = self._tok.decode(self._tokens[self._prefix : self._read])
+        full = self._tok.decode(self._tokens[self._prefix :])
+        self._read = len(self._tokens)
+        return full[len(ctx) :]
+
+
+class TextStopStream:
+    """Streaming stop-STRING matching on decoded text (OpenAI semantics).
+
+    String stops cannot be matched as token sequences: BPE does not
+    round-trip decode→encode per token, and a stop string can start
+    mid-token. This filter sits between the engine's token stream and the
+    SSE writer: `push` returns (text_safe_to_emit, matched). Text that
+    could be the start of a stop string is held back until disambiguated;
+    on a match, everything before the stop is returned and the stream is
+    over. `flush` releases held text when generation ends without a match.
+    """
+
+    def __init__(self, tokenizer, stop_texts) -> None:
+        self._dec = IncrementalDecoder(tokenizer)
+        self._stops = [s for s in stop_texts if s]
+        self._pending = ""
+
+    def push(self, token: int):
+        self._pending += self._dec.push(token)
+        cut = -1
+        for s in self._stops:
+            j = self._pending.find(s)
+            if j >= 0 and (cut < 0 or j < cut):
+                cut = j
+        if cut >= 0:
+            out = self._pending[:cut]
+            self._pending = ""
+            return out, True
+        hold = 0
+        for s in self._stops:
+            m = min(len(s) - 1, len(self._pending))
+            for k in range(m, hold, -1):
+                if self._pending.endswith(s[:k]):
+                    hold = k
+                    break
+        out = self._pending[: len(self._pending) - hold]
+        self._pending = self._pending[len(out) :]
+        return out, False
+
+    def flush(self):
+        """End-of-generation: release held text, SCANNING it for stops
+        first — a stop string can hide in a tail the decoder was holding
+        (split multi-byte sequence). Returns (text, matched)."""
+        tail = self._pending + self._dec.flush()
+        self._pending = ""
+        cut = -1
+        for s in self._stops:
+            j = tail.find(s)
+            if j >= 0 and (cut < 0 or j < cut):
+                cut = j
+        if cut >= 0:
+            return tail[:cut], True
+        return tail, False
+
+
+def truncate_at_text_stop(tokenizer, tokens, logprobs, stop_texts):
+    """Non-streaming stop-string application: cut the response at the
+    first occurrence of any stop string in the decoded text.
+
+    Returns (kept_tokens, kept_logprobs, text, matched). The token list is
+    cut BEFORE the token whose arrival completed the match (a stop can
+    start mid-token, so text is the authoritative boundary; the token list
+    is the best id-aligned approximation).
+    """
+    tokens = list(tokens)
+    if not stop_texts:
+        return tokens, list(logprobs), tokenizer.decode(tokens), False
+    dec = IncrementalDecoder(tokenizer)
+    text = ""
+    max_stop = max(len(s) for s in stop_texts)
+    for i, t in enumerate(tokens):
+        new = dec.push(t)
+        text += new
+        # a fresh match must involve newly-emitted chars: bound the scan
+        start = max(0, len(text) - len(new) - max_stop)
+        cut = -1
+        for s in stop_texts:
+            if not s:
+                continue
+            j = text.find(s, start)
+            if j >= 0 and (cut < 0 or j < cut):
+                cut = j
+        if cut >= 0:
+            return tokens[:i], list(logprobs)[:i], text[:cut], True
+    # the decoder may have held a tail (split multi-byte sequence) that
+    # push never scanned; a stop can hide in it
+    text += dec.flush()
+    start = max(0, len(text) - max_stop * 2)
+    cut = -1
+    for s in stop_texts:
+        if not s:
+            continue
+        j = text.find(s, start)
+        if j >= 0 and (cut < 0 or j < cut):
+            cut = j
+    if cut >= 0:
+        return tokens, list(logprobs), text[:cut], True
+    return tokens, list(logprobs), text, False

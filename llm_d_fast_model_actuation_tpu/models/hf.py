@@ -1,0 +1,313 @@
+"""Hugging Face checkpoint import: config + safetensors -> our param tree.
+
+The reference actuates vLLM servers, which load Hugging Face model
+directories directly (`--model <hf-dir>`); a user switching to this
+framework brings the same directories. This module maps an HF Llama-family
+checkpoint (config.json + *.safetensors) onto the stacked-layer param tree
+`models/llama.py` scans over, so `--model hf:<dir>` serves the same weights.
+
+Supported architectures: LlamaForCausalLM (Llama 2/3, TinyLlama),
+MistralForCausalLM, Qwen2ForCausalLM (q/k/v biases), GemmaForCausalLM.
+Numeric parity with the `transformers` forward pass is pinned by
+`tests/test_hf_import.py`.
+
+Layout notes:
+  * HF stores per-layer `model.layers.{i}.<name>.weight` with shape
+    (out, in); our tree stacks all layers into one (L, in, out) array per
+    weight (transpose + stack) so one compiled `lax.scan` body serves
+    every layer.
+  * HF Llama checkpoints use the rotate-half RoPE layout, which is exactly
+    `ops/rope.py`'s convention — weights copy over without re-permutation.
+  * Gemma stores zero-centered RMSNorm weights (the (1+w) convention) and
+    scales embeddings by sqrt(hidden); both map onto config knobs
+    (`norm_offset`, `embed_scale`) — values copy verbatim.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterator, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+#: HF `architectures[0]` -> config-knob overrides for our shared forward
+ARCHITECTURES: Dict[str, Dict[str, Any]] = {
+    "LlamaForCausalLM": {},
+    "MistralForCausalLM": {},
+    "Qwen2ForCausalLM": {"attn_bias": True},
+    "GemmaForCausalLM": {
+        "hidden_activation": "gelu",
+        "norm_offset": 1.0,
+        "embed_scale": True,
+        # gemma ties embeddings by default, and config.json omits defaults
+        "tie_embeddings": True,
+    },
+}
+
+
+def _read_config(path: str) -> Dict[str, Any]:
+    cfg_path = os.path.join(path, "config.json")
+    if not os.path.isfile(cfg_path):
+        raise FileNotFoundError(f"no config.json under {path!r}")
+    with open(cfg_path) as f:
+        return json.load(f)
+
+
+def _int_list(v: Any) -> list:
+    """HF eos_token_id may be an int or a list (Llama-3's [eos, eom,
+    eot]); normalize to a list of ints."""
+    if isinstance(v, list):
+        return [int(t) for t in v]
+    if isinstance(v, (int, float)):
+        return [int(v)]
+    return []
+
+
+def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
+    """Build a LlamaConfig from an HF model directory's config.json.
+
+    `overrides` lets callers force serving knobs (dtype, attention_impl,
+    quantization, max_seq_len) without a second config source.
+    """
+    import dataclasses
+
+    hf = _read_config(path)
+    archs = hf.get("architectures") or []
+    arch = archs[0] if archs else "LlamaForCausalLM"
+    if arch not in ARCHITECTURES:
+        raise ValueError(
+            f"unsupported architecture {arch!r}; supported: "
+            f"{sorted(ARCHITECTURES)}"
+        )
+    heads = int(hf["num_attention_heads"])
+    hidden = int(hf["hidden_size"])
+    fields: Dict[str, Any] = {
+        "vocab_size": int(hf["vocab_size"]),
+        "hidden_size": hidden,
+        "num_layers": int(hf["num_hidden_layers"]),
+        "num_heads": heads,
+        "num_kv_heads": int(hf.get("num_key_value_heads", heads)),
+        "head_dim": int(hf.get("head_dim") or hidden // heads),
+        "intermediate_size": int(hf["intermediate_size"]),
+        "rope_theta": float(hf.get("rope_theta", 10000.0)),
+        "rms_eps": float(hf.get("rms_norm_eps", 1e-5)),
+        "max_seq_len": int(hf.get("max_position_embeddings", 8192)),
+    }
+    scaling = hf.get("rope_scaling")
+    if scaling:
+        rtype = scaling.get("rope_type") or scaling.get("type")
+        if rtype == "llama3":
+            fields["rope_scaling"] = (
+                "llama3",
+                float(scaling["factor"]),
+                float(scaling["low_freq_factor"]),
+                float(scaling["high_freq_factor"]),
+                int(scaling["original_max_position_embeddings"]),
+            )
+        elif rtype == "linear":
+            fields["rope_scaling"] = ("linear", float(scaling["factor"]))
+        elif rtype not in (None, "default"):
+            # an ignored scaling spec would serve silently-wrong logits
+            raise ValueError(
+                f"unsupported rope_scaling type {rtype!r} "
+                "(supported: llama3, linear)"
+            )
+    sw = hf.get("sliding_window")
+    if sw:
+        # Mistral-style sliding-window attention: within the window our
+        # full attention is exactly equivalent, so cap the servable
+        # context at the window instead of silently attending past it.
+        fields["max_seq_len"] = min(fields["max_seq_len"], int(sw))
+    arch_defaults = dict(ARCHITECTURES[arch])
+    fields["tie_embeddings"] = bool(
+        hf.get(
+            "tie_word_embeddings", arch_defaults.pop("tie_embeddings", False)
+        )
+    )
+    fields.update(arch_defaults)
+    fields.update(overrides)
+    return dataclasses.replace(LlamaConfig(), **fields)
+
+
+def eos_token_ids_from_hf(path: str) -> list:
+    """ALL declared eos ids (config.json union generation_config.json,
+    order-preserving) — Llama-3-Instruct ends chat turns with <|eot_id|>,
+    which is a SECOND eos id; stopping on just the first would decode
+    every chat request to max_tokens. Empty when neither file declares
+    one."""
+    ids = _int_list(_read_config(path).get("eos_token_id"))
+    gen_path = os.path.join(path, "generation_config.json")
+    if os.path.isfile(gen_path):
+        with open(gen_path) as f:
+            for t in _int_list(json.load(f).get("eos_token_id")):
+                if t not in ids:
+                    ids.append(t)
+    return ids
+
+
+def eos_token_id_from_hf(path: str, default: int = 2) -> int:
+    ids = eos_token_ids_from_hf(path)
+    return ids[0] if ids else default
+
+
+# -- weight loading ----------------------------------------------------------
+
+
+def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, fp32 numpy array) over every tensor in the
+    checkpoint, shard by shard (single-file, indexed-shard, or legacy
+    pytorch_model.bin layouts)."""
+    st_files = sorted(
+        f for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if st_files:
+        from safetensors import safe_open
+
+        for fname in st_files:
+            with safe_open(
+                os.path.join(path, fname), framework="pt", device="cpu"
+            ) as f:
+                for name in f.keys():
+                    t = f.get_tensor(name)
+                    yield name, t.to_dense().float().numpy()
+        return
+    bin_files = sorted(
+        f
+        for f in os.listdir(path)
+        if f.startswith("pytorch_model") and f.endswith(".bin")
+    )
+    if not bin_files:
+        raise FileNotFoundError(
+            f"no *.safetensors or pytorch_model*.bin under {path!r}"
+        )
+    import torch
+
+    for fname in bin_files:
+        sd = torch.load(
+            os.path.join(path, fname), map_location="cpu", weights_only=True
+        )
+        for name, t in sd.items():
+            yield name, t.float().numpy()
+
+
+#: per-layer HF suffix -> (our key, transpose?)
+_LAYER_MAP: Dict[str, Tuple[str, bool]] = {
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+    "input_layernorm.weight": ("attn_norm", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+}
+
+_TOP_MAP: Dict[str, Tuple[str, bool]] = {
+    "model.embed_tokens.weight": ("embed", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),
+}
+
+
+def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
+    """Load an HF checkpoint into the stacked (L, ...) param tree.
+
+    Tensors are staged per-layer into numpy buffers already in
+    `cfg.dtype` (the only fp32 transient is the single tensor being
+    converted), so peak host memory is ~one model in target dtype plus
+    one tensor — not an fp32 copy of the whole model.
+    """
+    from .llama import init_params  # shape source of truth
+
+    import jax
+
+    shapes = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    np_dtype = np.dtype(cfg.dtype)  # ml_dtypes registers bfloat16
+    buffers: Dict[str, Any] = {}
+
+    def stage(tree_key: Tuple[str, ...], layer: int | None, arr: np.ndarray):
+        node = shapes
+        for k in tree_key:
+            node = node[k]
+        flat = "/".join(tree_key)
+        if flat not in buffers:
+            buffers[flat] = np.zeros(node.shape, dtype=np_dtype)
+        want = node.shape[1:] if layer is not None else node.shape
+        if arr.shape != tuple(want):
+            raise ValueError(
+                f"{flat}: checkpoint shape {arr.shape} != model {tuple(want)}"
+            )
+        if layer is not None:
+            buffers[flat][layer] = arr.astype(np_dtype)
+        else:
+            buffers[flat][...] = arr.astype(np_dtype)
+
+    seen = set()
+    for name, arr in _iter_tensors(path):
+        seen.add(name)
+        if name in _TOP_MAP:
+            ours, transpose = _TOP_MAP[name]
+            if ours == "lm_head" and cfg.tie_embeddings:
+                continue  # tied: the forward reuses embed.T
+            stage((ours,), None, arr.T if transpose else arr)
+            continue
+        if not name.startswith("model.layers."):
+            continue  # rotary inv_freq buffers etc.
+        rest = name[len("model.layers.") :]
+        idx, _, suffix = rest.partition(".")
+        if suffix not in _LAYER_MAP:
+            continue
+        ours, transpose = _LAYER_MAP[suffix]
+        if ours in ("bq", "bk", "bv") and not cfg.attn_bias:
+            raise ValueError(
+                f"checkpoint has {name} but config attn_bias=False"
+            )
+        stage(("layers", ours), int(idx), arr.T if transpose else arr)
+
+    expected = {
+        "/".join(p)
+        for p, _ in _flatten(shapes)
+    }
+    missing = expected - set(buffers)
+    if missing:
+        raise ValueError(
+            f"checkpoint {path!r} is missing tensors for: {sorted(missing)}"
+        )
+    params = _unflatten(
+        {k: jnp.asarray(v) for k, v in buffers.items()}
+    )
+    from .registry import maybe_quantize
+
+    return maybe_quantize(cfg, params)
+
+
+def load_model(path: str, **overrides: Any) -> Tuple[LlamaConfig, Dict[str, Any]]:
+    cfg = config_from_hf(path, **overrides)
+    return cfg, load_params(path, cfg)
+
+
+def _flatten(tree: Dict[str, Any], prefix: Tuple[str, ...] = ()):
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from _flatten(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out

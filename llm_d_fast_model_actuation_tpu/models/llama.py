@@ -42,6 +42,10 @@ class LlamaConfig:
     head_dim: int = 128
     intermediate_size: int = 14336
     rope_theta: float = 500000.0
+    #: HF-style rope_scaling as a hashable tuple (ops/rope.py):
+    #: ("linear", factor) or ("llama3", factor, low_ff, high_ff, orig_max).
+    #: None = plain RoPE.
+    rope_scaling: Any = None
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: Any = jnp.bfloat16
@@ -63,6 +67,8 @@ class LlamaConfig:
     embed_scale: bool = False
     #: per-head RMSNorm on q and k before RoPE (gemma-3 style)
     qk_norm: bool = False
+    #: biases on the q/k/v projections (Qwen2 convention)
+    attn_bias: bool = False
 
     @classmethod
     def tiny_gemma(cls, vocab: int = 256) -> "LlamaConfig":
@@ -194,6 +200,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
     if cfg.qk_norm:
         layers["q_norm"] = norm_init((L, cfg.head_dim))
         layers["k_norm"] = norm_init((L, cfg.head_dim))
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype=cfg.dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype=cfg.dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype=cfg.dtype)
     params = {
         "embed": dense_init(k_embed, (cfg.vocab_size, h), h),
         "layers": layers,
@@ -223,6 +233,10 @@ def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
     if cfg.qk_norm:
         layers["q_norm"] = ("layers", None)
         layers["k_norm"] = ("layers", None)
+    if cfg.attn_bias:
+        layers["bq"] = ("layers", "heads")
+        layers["bk"] = ("layers", "kv_heads")
+        layers["bv"] = ("layers", "kv_heads")
     axes = {
         "embed": ("vocab", "embed"),
         "layers": layers,
@@ -276,9 +290,14 @@ def _ffn(cfg: "LlamaConfig", lp, x):
 def _project_qkv(cfg: LlamaConfig, lp, x, positions, cos_tab, sin_tab):
     """x: [b, s, h] -> q [b,s,heads,hd], k/v [b,s,kvh,hd], roped."""
     b, s, _ = x.shape
-    q = qmat(x, lp["wq"]).reshape(b, s, cfg.num_heads, cfg.head_dim)
-    k = qmat(x, lp["wk"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
-    v = qmat(x, lp["wv"]).reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    q, k, v = qmat(x, lp["wq"]), qmat(x, lp["wk"]), qmat(x, lp["wv"])
+    if cfg.attn_bias:
+        q = q + lp["bq"].astype(q.dtype)
+        k = k + lp["bk"].astype(k.dtype)
+        v = v + lp["bv"].astype(v.dtype)
+    q = q.reshape(b, s, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
         # per-head RMSNorm before RoPE (gemma-3 convention)
         q = rms_norm(q, lp["q_norm"], cfg.rms_eps, offset=cfg.norm_offset)
@@ -328,7 +347,9 @@ def prefill(
     b, s = tokens.shape
     k_pages, v_pages = cache
     page_size = k_pages.shape[2]
-    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     valid = positions < seq_lens[:, None]
@@ -378,7 +399,9 @@ def prefill_continue(
     b, s = tokens.shape
     k_pages, v_pages = cache
     page_size = k_pages.shape[2]
-    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     positions = start[:, None] + jnp.broadcast_to(
         jnp.arange(s, dtype=jnp.int32), (b, s)
@@ -442,7 +465,9 @@ def decode_step(
     k_pages, v_pages = cache
     page_size = k_pages.shape[2]
     num_pages = k_pages.shape[1]
-    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     x = _embed_tokens(cfg, params, tokens)  # [b, h]
 
@@ -498,7 +523,9 @@ def _decode_step_scatter_first(
     k_pages, v_pages = cache
     page_size = k_pages.shape[2]
     num_pages = k_pages.shape[1]
-    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
     seq_lens = positions + 1
     table = page_table
     if active is not None:

@@ -46,7 +46,9 @@ def forward_train(
     (ops/ring_attention.py): K/V chunks rotate the sp ring instead of GSPMD
     all-gathering the whole sequence — the long-context path."""
     b, s = tokens.shape
-    cos_tab, sin_tab = rope_table(cfg.max_seq_len, cfg.head_dim, cfg.rope_theta)
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     # the Gemma-family helpers keep training numerically identical to the
     # serving forward ((1+w) norms, sandwich norms, scaled embeddings)

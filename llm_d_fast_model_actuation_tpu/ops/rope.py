@@ -4,13 +4,46 @@ Tables are precomputed once per (max_len, head_dim, theta) and passed in —
 inside `jit` the gather by position fuses into the attention prologue.
 """
 
+import math
+
 import jax.numpy as jnp
 
 
-def rope_table(max_len: int, head_dim: int, theta: float = 10000.0):
+def _scale_freqs(freqs, scaling):
+    """Apply an HF-style rope_scaling spec to the inverse frequencies.
+
+    `scaling` is a hashable tuple (models/llama.py `LlamaConfig.rope_scaling`):
+      ("linear", factor)  — divide every frequency by factor
+      ("llama3", factor, low_freq_factor, high_freq_factor, original_max)
+        — Llama-3.1's banded NTK scheme: low-frequency bands divide by
+        factor, high-frequency bands pass through, mid bands interpolate
+        (matches transformers' `_compute_llama3_parameters`).
+    """
+    kind = scaling[0]
+    if kind == "linear":
+        return freqs / scaling[1]
+    if kind == "llama3":
+        _, factor, low_ff, high_ff, orig_max = scaling
+        low_wavelen = orig_max / low_ff
+        high_wavelen = orig_max / high_ff
+        wavelen = 2.0 * math.pi / freqs
+        scaled = freqs / factor
+        smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+        mid = (1.0 - smooth) * scaled + smooth * freqs
+        out = jnp.where(wavelen > low_wavelen, scaled, freqs)
+        is_mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+        return jnp.where(is_mid, mid, out)
+    raise ValueError(f"unsupported rope scaling {kind!r}")
+
+
+def rope_table(
+    max_len: int, head_dim: int, theta: float = 10000.0, scaling=None
+):
     """(cos, sin) tables of shape [max_len, head_dim//2], fp32."""
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        freqs = _scale_freqs(freqs, tuple(scaling))
     pos = jnp.arange(max_len, dtype=jnp.float32)
     angles = pos[:, None] * freqs[None, :]
     return jnp.cos(angles), jnp.sin(angles)

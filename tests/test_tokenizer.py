@@ -1,0 +1,347 @@
+"""Tokenizer layer: HF tokenizers for text prompts, byte fallback, and
+stream-safe incremental detokenization (engine/tokenizer.py)."""
+
+import json
+import os
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    IncrementalDecoder,
+    has_tokenizer_files,
+    load_tokenizer,
+)
+
+CHAT_TEMPLATE = (
+    "{% for m in messages %}<|{{ m['role'] }}|>{{ m['content'] }}\n"
+    "{% endfor %}{% if add_generation_prompt %}<|assistant|>{% endif %}"
+)
+
+
+@pytest.fixture(scope="module")
+def tok_dir(tmp_path_factory):
+    """A real byte-level BPE tokenizer built locally (no network): trained
+    on a tiny corpus, wrapped as a PreTrainedTokenizerFast, with a chat
+    template — the same file layout an HF model directory ships."""
+    import transformers
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    tk = Tokenizer(models.BPE())
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=320,
+        special_tokens=["<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tk.train_from_iterator(
+        ["hello world", "the quick brown fox", "günther straße"], trainer
+    )
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tk, bos_token="<s>", eos_token="</s>"
+    )
+    fast.chat_template = CHAT_TEMPLATE
+    d = str(tmp_path_factory.mktemp("tok"))
+    fast.save_pretrained(d)
+    return d
+
+
+def test_byte_fallback_roundtrip():
+    bt = ByteTokenizer()
+    assert bt.decode(bt.encode("hello ünïcode")) == "hello ünïcode"
+    assert bt.eos_token_id is None
+    # chat fallback carries role tags
+    toks = bt.chat_tokens([{"role": "user", "content": "hi"}])
+    assert "<|user|>" in bt.decode(toks)
+
+
+def test_hf_tokenizer_roundtrip_and_detection(tok_dir):
+    assert has_tokenizer_files(tok_dir)
+    t = HFTokenizer(tok_dir)
+    text = "hello world straße"
+    ids = t.encode(text, special=False)
+    assert t.decode(ids) == text
+    assert t.eos_token_id is not None
+    assert load_tokenizer(tok_dir).decode(ids) == text
+    assert isinstance(load_tokenizer(""), ByteTokenizer)
+
+
+def test_hf_chat_template_applies(tok_dir):
+    t = HFTokenizer(tok_dir)
+    toks = t.chat_tokens(
+        [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hello"},
+        ]
+    )
+    text = t.decode(toks)
+    # our template keeps role tags as plain text (not special tokens)
+    assert "<|system|>be brief" in text and text.endswith("<|assistant|>")
+
+
+def test_incremental_decoder_matches_full_decode(tok_dir):
+    t = HFTokenizer(tok_dir)
+    text = "the quick brown fox günther"
+    ids = t.encode(text, special=False)
+    dec = IncrementalDecoder(t)
+    streamed = "".join(dec.push(i) for i in ids)
+    assert streamed == t.decode(ids)
+
+
+def test_incremental_decoder_holds_split_multibyte():
+    bt = ByteTokenizer()
+    dec = IncrementalDecoder(bt)
+    b = "é".encode("utf-8")  # two bytes
+    assert dec.push(b[0]) == ""  # incomplete: held, no replacement char
+    assert dec.push(b[1]) == "é"
+    assert dec.push(ord("x")) == "x"
+
+
+def test_server_uses_model_dir_tokenizer(tmp_path, tok_dir):
+    """Full path: an hf: model directory that ships a tokenizer serves
+    TEXT — string prompt in, detokenized text out, string stop honored."""
+    import shutil
+
+    import torch
+    import transformers
+
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        build_app,
+        parse_engine_options,
+    )
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=512,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg)
+    d = str(tmp_path / "model")
+    m.save_pretrained(d)
+    for f in os.listdir(tok_dir):
+        shutil.copy(os.path.join(tok_dir, f), os.path.join(d, f))
+
+    args = parse_engine_options(
+        f"--model hf:{d} --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --eos-token-id -1"
+    )
+    svc = EngineService(args)
+    try:
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def scenario():
+            client = TestClient(TestServer(build_app(svc)))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/v1/completions",
+                    json={"prompt": "hello world", "max_tokens": 4},
+                )
+                body = await r.json()
+                assert r.status == 200, body
+                choice = body["choices"][0]
+                assert len(choice["token_ids"]) == 4
+                # text is the tokenizer's decode of those ids
+                assert choice["text"] == svc.tokenizer.decode(
+                    choice["token_ids"]
+                )
+
+                # string stop: pick a clean substring of the greedy text
+                # and stop on it -> text truncated exactly before it
+                # (OpenAI semantics: stops match on TEXT, not token ids)
+                full_text = choice["text"]
+                sub = next(
+                    (
+                        full_text[i : i + 2]
+                        for i in range(len(full_text) - 1)
+                        if "�" not in full_text[i : i + 2]
+                        and full_text[i : i + 2].strip()
+                    ),
+                    None,
+                )
+                if sub is not None:
+                    r = await client.post(
+                        "/v1/completions",
+                        json={
+                            "prompt": "hello world",
+                            "max_tokens": 4,
+                            "stop": sub,
+                        },
+                    )
+                    body = await r.json()
+                    c = body["choices"][0]
+                    assert c["finish_reason"] == "stop"
+                    assert c["text"] == full_text[: full_text.index(sub)]
+                    assert len(c["token_ids"]) < 4
+
+                # chat: template applied (prompt tokens > raw content)
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "messages": [{"role": "user", "content": "hello"}],
+                        "max_tokens": 3,
+                    },
+                )
+                body = await r.json()
+                assert r.status == 200, body
+                msg = body["choices"][0]["message"]
+                assert msg["content"] == svc.tokenizer.decode(
+                    msg["token_ids"]
+                )
+
+                # streamed text concatenates to the non-streamed text
+                r = await client.post(
+                    "/v1/completions",
+                    json={
+                        "prompt": "hello world",
+                        "max_tokens": 4,
+                        "stream": True,
+                    },
+                )
+                assert r.status == 200
+                texts, toks = [], []
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    ev = json.loads(line[6:])
+                    if "choices" in ev:
+                        texts.append(ev["choices"][0]["text"])
+                        toks.extend(ev["choices"][0]["token_ids"])
+                assert "".join(texts) == svc.tokenizer.decode(toks)
+            finally:
+                await client.close()
+
+        asyncio.run(scenario())
+    finally:
+        svc.shutdown()
+
+
+def test_malformed_chat_content_is_400(service_byte):
+    """Messages a chat template would choke on (content-parts arrays) must
+    be a 400, not a 500."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_d_fast_model_actuation_tpu.engine.server import build_app
+
+    async def scenario():
+        client = TestClient(TestServer(build_app(service_byte)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "messages": [
+                        {
+                            "role": "user",
+                            "content": [{"type": "text", "text": "hi"}],
+                        }
+                    ],
+                    "max_tokens": 2,
+                },
+            )
+            assert r.status == 400, await r.text()
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture
+def service_byte():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64"
+        )
+    )
+    yield svc
+    svc.shutdown()
+
+
+def test_text_stop_terminates_generation_early(service_byte):
+    """A stop STRING must end decoding in the engine (freeing the slot),
+    not just truncate the response text afterwards."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_d_fast_model_actuation_tpu.engine.server import build_app
+
+    svc = service_byte
+
+    async def scenario():
+        client = TestClient(TestServer(build_app(svc)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 40},
+            )
+            body = await r.json()
+            full = body["choices"][0]
+            if len(full["token_ids"]) < 8:
+                return  # model hit eos early; scenario not applicable
+            stop_char = svc.tokenizer.decode(full["token_ids"][2:3])
+            if not stop_char or "�" in stop_char:
+                return
+            before = svc.engine.total_tokens_emitted
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "prompt": [1, 2, 3],
+                    "max_tokens": 40,
+                    "stop": stop_char,
+                },
+            )
+            body = await r.json()
+            emitted = svc.engine.total_tokens_emitted - before
+            assert body["choices"][0]["finish_reason"] == "stop"
+            # the engine stopped within a decode-chunk of the match,
+            # instead of decoding all 40 tokens
+            assert emitted < 40, emitted
+        finally:
+            await client.close()
+
+    asyncio.run(scenario())
+
+
+def test_text_stop_hidden_in_held_tail_matches_on_flush():
+    """A stop string inside text the decoder held back (split multi-byte
+    tail) must still match at end-of-generation, not leak to the client."""
+    from llm_d_fast_model_actuation_tpu.engine.tokenizer import TextStopStream
+
+    class StubTok:
+        # token 2 decodes to 'X' plus the start of a split sequence
+        MAP = {1: "hello", 2: "X�"}
+
+        def decode(self, toks):
+            return "".join(self.MAP[t] for t in toks)
+
+    filt = TextStopStream(StubTok(), ("X",))
+    out, matched = filt.push(1)
+    assert (out, matched) == ("hello", False)
+    out, matched = filt.push(2)  # trailing U+FFFD: held by the decoder
+    assert (out, matched) == ("", False)
+    out, matched = filt.flush()
+    assert matched and out == ""  # the 'X' never reaches the client
