@@ -90,6 +90,14 @@ LAUNCHER_TEMPLATE_HASH_ANNOTATION = "dual-pods.llm-d.ai/launcher-populator-templ
 #: Port on which every launcher exposes its instance-management REST API.
 LAUNCHER_SERVICE_PORT = 8001
 
+#: Annotation: per-pod override of LAUNCHER_SERVICE_PORT. Needed when the
+#: LauncherConfig pod template uses hostNetwork (accelerator-host access):
+#: two launchers on one node then share the host's port space, and the
+#: populator must give the second a distinct port — the reference handles
+#: the same same-node port collision by spawning a differently-ported
+#: launcher (test/e2e/test-cases.sh:320).
+LAUNCHER_PORT_ANNOTATION = "dual-pods.llm-d.ai/launcher-port"
+
 # --------------------------------------------------------------------------
 # Instance state persisted on launcher Pods (restart recovery).
 # Reference: pkg/controller/dual-pods/controller.go:63-115.

@@ -261,8 +261,11 @@ class HttpTransports:
         self._http = _Http()
 
     def launcher(self, pod: Dict[str, Any]) -> LauncherHandle:
+        port = (pod["metadata"].get("annotations") or {}).get(
+            C.LAUNCHER_PORT_ANNOTATION, C.LAUNCHER_SERVICE_PORT
+        )
         return HttpLauncherHandle(
-            self._http, f"http://{pod_ip(pod)}:{C.LAUNCHER_SERVICE_PORT}"
+            self._http, f"http://{pod_ip(pod)}:{port}"
         )
 
     def requester_spi(self, pod: Dict[str, Any]) -> SpiHandle:

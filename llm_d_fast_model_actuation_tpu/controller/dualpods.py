@@ -836,6 +836,7 @@ class DualPodsController:
     ) -> Optional[Dict[str, Any]]:
         pod, _ = self._launcher_template(lc, node)
         pod["metadata"]["namespace"] = ns
+        self._assign_launcher_port(ns, pod, node)
         self._stamp_binding(pod, req, isc_name, sd)
         t0 = time.monotonic()
         created = await self._create_unique(pod, f"{lc.metadata.name}-{node}")
@@ -851,6 +852,49 @@ class DualPodsController:
             req["metadata"]["name"],
         )
         return self.store.try_get("Pod", ns, pod["metadata"]["name"])
+
+    def _assign_launcher_port(
+        self, ns: str, pod: Dict[str, Any], node: str
+    ) -> None:
+        """hostNetwork launchers on one node share the host's port space: a
+        second (third, ...) launcher gets the first free port above the
+        default, recorded where both sides look — the launcher-port
+        annotation (read by the controller's transport) and the
+        FMA_LAUNCHER_PORT env (the launcher binds it). Pod-network
+        launchers keep the fixed default: per-pod IPs cannot collide.
+        Reference analogue: same-node port collision creates a
+        differently-ported launcher (test/e2e/test-cases.sh:320)."""
+        spec = pod.get("spec") or {}
+        if not spec.get("hostNetwork"):
+            return
+        used = set()
+        for other in self.store.list(
+            "Pod", ns, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
+        ):
+            if (other.get("spec") or {}).get("nodeName") != node:
+                continue
+            ann = other["metadata"].get("annotations") or {}
+            try:
+                used.add(
+                    int(
+                        ann.get(
+                            C.LAUNCHER_PORT_ANNOTATION,
+                            C.LAUNCHER_SERVICE_PORT,
+                        )
+                    )
+                )
+            except (TypeError, ValueError):
+                continue
+        port = C.LAUNCHER_SERVICE_PORT
+        while port in used:
+            port += 1
+        if port == C.LAUNCHER_SERVICE_PORT:
+            return
+        _ann(pod)[C.LAUNCHER_PORT_ANNOTATION] = str(port)
+        for c in spec.get("containers") or []:
+            c.setdefault("env", []).append(
+                {"name": "FMA_LAUNCHER_PORT", "value": str(port)}
+            )
 
     def _stamp_binding(
         self, pod: Dict[str, Any], req: Dict[str, Any], isc_name: str, sd: ServerData

@@ -40,7 +40,15 @@ def preload(compile_cache_dir: str) -> None:
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="fma-tpu-launcher")
     p.add_argument("--host", default="0.0.0.0")
-    p.add_argument("--port", type=int, default=8001)
+    # FMA_LAUNCHER_PORT: the dual-pods controller injects this when a
+    # hostNetwork node already has a launcher on the default port (same-node
+    # port collision; the per-pod launcher-port annotation carries the same
+    # value for the controller's transport)
+    p.add_argument(
+        "--port",
+        type=int,
+        default=int(os.environ.get("FMA_LAUNCHER_PORT", "8001")),
+    )
     p.add_argument("--log-level", default="info")
     p.add_argument("--mock-chips", action="store_true")
     p.add_argument("--mock-chip-count", type=int, default=8)

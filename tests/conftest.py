@@ -70,3 +70,58 @@ def port_free(port: int) -> bool:
             return True
         except OSError:
             return False
+
+
+def build_tiny_bpe_tokenizer_files(dirpath: str, chat_template: str = ""):
+    """A real byte-level BPE tokenizer built locally (no network), saved in
+    the HF file layout a model directory ships. Shared by the tokenizer,
+    HF-import, and full-stack e2e suites so the file layout under test is
+    defined exactly once."""
+    import transformers
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    tk = Tokenizer(models.BPE())
+    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tk.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=320,
+        special_tokens=["<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tk.train_from_iterator(
+        ["hello world", "the quick brown fox", "günther straße"], trainer
+    )
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tk, bos_token="<s>", eos_token="</s>"
+    )
+    if chat_template:
+        fast.chat_template = chat_template
+    fast.save_pretrained(dirpath)
+    return dirpath
+
+
+def build_tiny_hf_model_dir(dirpath: str, chat_template: str = "", **cfg_kw):
+    """A tiny real HF model directory (config.json + safetensors +
+    tokenizer) like the ones vLLM users bring. `cfg_kw` overrides the
+    LlamaConfig fields."""
+    import torch
+    import transformers
+
+    cfg = transformers.LlamaConfig(
+        **{
+            **dict(
+                vocab_size=512,
+                hidden_size=32,
+                intermediate_size=64,
+                num_hidden_layers=2,
+                num_attention_heads=2,
+                num_key_value_heads=2,
+                max_position_embeddings=128,
+            ),
+            **cfg_kw,
+        }
+    )
+    torch.manual_seed(0)
+    transformers.LlamaForCausalLM(cfg).save_pretrained(dirpath)
+    build_tiny_bpe_tokenizer_files(dirpath, chat_template)
+    return dirpath

@@ -185,3 +185,70 @@ def test_populator_phase_flip_timer():
             await pop.stop()
 
     asyncio.run(body())
+
+
+def test_assign_launcher_port_hostnetwork_collision():
+    """hostNetwork launchers on one node get distinct ports: the second
+    pod is stamped with the launcher-port annotation and an
+    FMA_LAUNCHER_PORT env so the process binds it; pod-network launchers
+    keep the fixed default (per-pod IPs cannot collide)."""
+    from llm_d_fast_model_actuation_tpu.api import constants as C
+    from dualpods_harness import Harness
+
+    h = Harness()
+    ctl = h.controller
+
+    def launcher_pod(name, node="n1", host_network=True, port=None):
+        pod = {
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": h.ns,
+                "labels": {C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT},
+                "annotations": {},
+            },
+            "spec": {
+                "nodeName": node,
+                "hostNetwork": host_network,
+                "containers": [{"name": "launcher"}],
+            },
+        }
+        if port is not None:
+            pod["metadata"]["annotations"][C.LAUNCHER_PORT_ANNOTATION] = str(
+                port
+            )
+        return pod
+
+    # no hostNetwork: untouched regardless of neighbors
+    pod = launcher_pod("l0", host_network=False)
+    ctl._assign_launcher_port(h.ns, pod, "n1")
+    assert C.LAUNCHER_PORT_ANNOTATION not in pod["metadata"]["annotations"]
+
+    # first hostNetwork launcher on the node: default port, no annotation
+    pod1 = launcher_pod("l1")
+    ctl._assign_launcher_port(h.ns, pod1, "n1")
+    assert C.LAUNCHER_PORT_ANNOTATION not in pod1["metadata"]["annotations"]
+    h.store.create(pod1)
+
+    # second: first free port above the default + env for the process
+    pod2 = launcher_pod("l2")
+    ctl._assign_launcher_port(h.ns, pod2, "n1")
+    ann = pod2["metadata"]["annotations"]
+    assert ann[C.LAUNCHER_PORT_ANNOTATION] == str(C.LAUNCHER_SERVICE_PORT + 1)
+    env = pod2["spec"]["containers"][0]["env"]
+    assert {"name": "FMA_LAUNCHER_PORT",
+            "value": str(C.LAUNCHER_SERVICE_PORT + 1)} in env
+    h.store.create(pod2)
+
+    # third skips both taken ports; another NODE starts at the default again
+    pod3 = launcher_pod("l3")
+    ctl._assign_launcher_port(h.ns, pod3, "n1")
+    assert pod3["metadata"]["annotations"][C.LAUNCHER_PORT_ANNOTATION] == str(
+        C.LAUNCHER_SERVICE_PORT + 2
+    )
+    pod_other = launcher_pod("l4", node="n2")
+    ctl._assign_launcher_port(h.ns, pod_other, "n2")
+    assert (
+        C.LAUNCHER_PORT_ANNOTATION
+        not in pod_other["metadata"]["annotations"]
+    )

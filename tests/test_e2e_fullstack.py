@@ -22,6 +22,10 @@ Cases (reference analogue in parens):
   * obsolete sleeping instance GC        ("obsolete instance GC")
   * obsolete awake instance delete-on-unbind
                                          ("obsolete awake instance")
+  * same-node second launcher on a distinct port, both serving
+    concurrently with disjoint chips      ("same-node port collision")
+  * HF model directory served through the whole stack (hf: import +
+    real tokenizer + warm sleep/wake)
 """
 
 import asyncio
@@ -203,9 +207,12 @@ class Scenario:
             }
         )
 
-    def add_isc(self, name, engine_port, lc_name="lc1", extra_options="", env=None):
+    def add_isc(
+        self, name, engine_port, lc_name="lc1", extra_options="", env=None,
+        model="tiny",
+    ):
         options = (
-            f"--model tiny --port {engine_port} --num-pages 32 "
+            f"--model {model} --port {engine_port} --num-pages 32 "
             f"--max-batch 2 --page-size 8 --max-model-len 64" + extra_options
         )
         env_vars = {"JAX_PLATFORMS": "cpu"}
@@ -225,7 +232,7 @@ class Scenario:
             }
         )
 
-    def add_launcher_pod(self, lc_name="lc1", name="launcher-live"):
+    def add_launcher_pod(self, lc_name="lc1", name="launcher-live", port=None):
         from llm_d_fast_model_actuation_tpu.api.types import LauncherConfig
         from llm_d_fast_model_actuation_tpu.controller.populator import (
             build_launcher_template,
@@ -237,6 +244,12 @@ class Scenario:
         pod = specialize_to_node(lc, NODE, ti_hash)
         pod["metadata"]["namespace"] = self.ns
         pod["metadata"]["name"] = name
+        if port is not None:
+            # same-node second launcher (hostNetwork-style port collision):
+            # the controller's transport honors the per-pod port override
+            pod["metadata"].setdefault("annotations", {})[
+                C.LAUNCHER_PORT_ANNOTATION
+            ] = str(port)
         pod["status"] = {
             "podIP": "127.0.0.1",
             "conditions": [{"type": "Ready", "status": "True"}],
@@ -822,6 +835,174 @@ def test_obsolete_awake_instance_deleted_on_unbind(scenario):
             assert launcher_instances()["total_instances"] == 0, (
                 "obsolete awake instance must be deleted on unbind"
             )
+        finally:
+            await sc.stop()
+
+    run(body())
+
+
+@pytest.mark.e2e
+def test_same_node_second_launcher_distinct_port(scenario, tmp_path):
+    """Reference 'Same-Node Port Collision Creates New Launcher'
+    (test-cases.sh:320-400): a second requester arrives on the SAME node
+    while the first is still bound and serving. Launchers bind one
+    requester each, so the second requester needs a SECOND launcher pod —
+    under hostNetwork (how accelerator hosts deploy) the two share the
+    node's port space, so the second launcher runs on a distinct port,
+    carried by the per-pod launcher-port annotation the controller's
+    transport honors. Both servers end up awake CONCURRENTLY with
+    disjoint chips."""
+    sc = scenario
+    port_a, port_b = free_port(), free_port()
+    launcher2_port = free_port()
+    procs = []
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc-a", port_a)
+            sc.add_isc("isc-b", port_b)
+            sc.add_launcher_pod(name="launcher-one")
+            sc.add_launcher_pod(name="launcher-two", port=launcher2_port)
+
+            sc.add_requester("req-a", "isc-a", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            out_a = complete(port_a)
+
+            # second requester while the first is BOUND and awake
+            sc.add_requester("req-b", "isc-b", spi2)
+            await sc.wait_ready(probes2)
+
+            # both serve concurrently — no sleep in between
+            assert complete(port_a) == out_a
+            assert len(complete(port_b)) == 3
+
+            # bound to two DIFFERENT launcher pods
+            duals = {}
+            for req in ("req-a", "req-b"):
+                pod = sc.ks.get("Pod", sc.ns, req)
+                duals[req] = (pod["metadata"].get("labels") or {}).get(
+                    C.DUAL_LABEL
+                )
+            assert duals["req-a"] and duals["req-b"]
+            assert duals["req-a"] != duals["req-b"], (
+                "one launcher binds one requester; the second requester "
+                f"needs its own launcher: {duals}"
+            )
+
+            # disjoint chips (the reference's accelerator assertion)
+            accels = {}
+            for req in ("req-a", "req-b"):
+                pod = sc.ks.get("Pod", sc.ns, req)
+                accels[req] = (pod["metadata"].get("annotations") or {}).get(
+                    C.ACCELERATORS_ANNOTATION
+                )
+            assert accels["req-a"] and accels["req-b"]
+            assert set(accels["req-a"].split(",")).isdisjoint(
+                accels["req-b"].split(",")
+            ), accels
+
+            # exactly one instance landed on each launcher process
+            inv1 = launcher_instances()
+            inv2 = requests.get(
+                f"http://127.0.0.1:{launcher2_port}/v2/vllm/instances",
+                timeout=5,
+            ).json()
+            assert inv1["total_instances"] == 1
+            assert inv2["total_instances"] == 1
+        finally:
+            await sc.stop()
+
+    # spawn under try/finally: a startup failure (port race, slow
+    # launcher) must not leak the subprocesses past the test session
+    try:
+        stub2, spi2, probes2 = spawn_requester_stub(
+            [CHIP2], tmp_path / "stub2.log"
+        )
+        procs.append(stub2)
+        launcher2 = _spawn(
+            [
+                "llm_d_fast_model_actuation_tpu.launcher.main",
+                "--mock-chips",
+                "--mock-chip-count",
+                "4",
+                "--mock-topology",
+                "2x2",
+                "--host",
+                "127.0.0.1",
+                "--port",
+                str(launcher2_port),
+                "--log-dir",
+                str(tmp_path / "launcher2-logs"),
+            ],
+            tmp_path / "launcher2.log",
+        )
+        procs.append(launcher2)
+        wait_http(f"http://127.0.0.1:{launcher2_port}/health")
+        run(body())
+    finally:
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+
+
+def _build_hf_model_dir(tmp_path) -> str:
+    from conftest import build_tiny_hf_model_dir
+
+    return build_tiny_hf_model_dir(str(tmp_path / "hf-model"))
+
+
+@pytest.mark.e2e
+def test_hf_model_dir_served_through_full_stack(scenario, tmp_path):
+    """A user's Hugging Face model DIRECTORY (--model hf:<dir>) actuates
+    through the whole product path — controller binds, launcher forks the
+    engine, the engine loads safetensors + the real tokenizer — and serves
+    TEXT prompts; unbind/rebind exercises warm sleep/wake on the imported
+    weights (the reference actuates vLLM servers over exactly these
+    directories)."""
+    sc = scenario
+    hf_dir = _build_hf_model_dir(tmp_path)
+    port = free_port()
+
+    def text_complete():
+        r = requests.post(
+            f"http://127.0.0.1:{port}/v1/completions",
+            json={"prompt": "hello world", "max_tokens": 4},
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        return r.json()["choices"][0]
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc-hf", port, model=f"hf:{hf_dir}")
+            sc.add_launcher_pod()
+            sc.add_requester("req-hf", "isc-hf", sc.default_spi)
+
+            await sc.wait_ready(sc.default_probes)
+            first = text_complete()
+            assert len(first["token_ids"]) >= 1
+            assert isinstance(first["text"], str)
+
+            # unbind -> instance sleeps holding the imported weights
+            sc.ks.delete("Pod", sc.ns, "req-hf")
+            await sc.wait_engine_sleeping(port, True)
+
+            # warm wake: identical greedy generation from the HF weights
+            reset_stub(sc.default_spi)
+            sc.add_requester("req-hf2", "isc-hf", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+            await sc.wait_engine_sleeping(port, False)
+            again = text_complete()
+            assert again["token_ids"] == first["token_ids"]
+            assert again["text"] == first["text"]
         finally:
             await sc.stop()
 

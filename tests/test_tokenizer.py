@@ -22,30 +22,13 @@ CHAT_TEMPLATE = (
 
 @pytest.fixture(scope="module")
 def tok_dir(tmp_path_factory):
-    """A real byte-level BPE tokenizer built locally (no network): trained
-    on a tiny corpus, wrapped as a PreTrainedTokenizerFast, with a chat
-    template — the same file layout an HF model directory ships."""
-    import transformers
-    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    """Shared tiny BPE tokenizer directory (conftest builder) with a chat
+    template."""
+    from conftest import build_tiny_bpe_tokenizer_files
 
-    tk = Tokenizer(models.BPE())
-    tk.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
-    tk.decoder = decoders.ByteLevel()
-    trainer = trainers.BpeTrainer(
-        vocab_size=320,
-        special_tokens=["<s>", "</s>"],
-        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    return build_tiny_bpe_tokenizer_files(
+        str(tmp_path_factory.mktemp("tok")), CHAT_TEMPLATE
     )
-    tk.train_from_iterator(
-        ["hello world", "the quick brown fox", "günther straße"], trainer
-    )
-    fast = transformers.PreTrainedTokenizerFast(
-        tokenizer_object=tk, bos_token="<s>", eos_token="</s>"
-    )
-    fast.chat_template = CHAT_TEMPLATE
-    d = str(tmp_path_factory.mktemp("tok"))
-    fast.save_pretrained(d)
-    return d
 
 
 def test_byte_fallback_roundtrip():
