@@ -7,9 +7,10 @@ checkpoint (config.json + *.safetensors) onto the stacked-layer param tree
 `models/llama.py` scans over, so `--model hf:<dir>` serves the same weights.
 
 Supported architectures: LlamaForCausalLM (Llama 2/3, TinyLlama),
-MistralForCausalLM, Qwen2ForCausalLM (q/k/v biases), GemmaForCausalLM.
-Numeric parity with the `transformers` forward pass is pinned by
-`tests/test_hf_import.py`.
+MistralForCausalLM, Qwen2ForCausalLM (q/k/v biases), Qwen3ForCausalLM
+(per-head q/k norms), GemmaForCausalLM, MixtralForCausalLM (routed MoE:
+expert stacks + router, models/moe.py). Numeric parity with the
+`transformers` forward pass is pinned by `tests/test_hf_import.py`.
 
 Layout notes:
   * HF stores per-layer `model.layers.{i}.<name>.weight` with shape
@@ -39,6 +40,7 @@ ARCHITECTURES: Dict[str, Dict[str, Any]] = {
     "LlamaForCausalLM": {},
     "MistralForCausalLM": {},
     "Qwen2ForCausalLM": {"attn_bias": True},
+    "Qwen3ForCausalLM": {"qk_norm": True},
     "GemmaForCausalLM": {
         "hidden_activation": "gelu",
         "norm_offset": 1.0,
@@ -46,6 +48,7 @@ ARCHITECTURES: Dict[str, Dict[str, Any]] = {
         # gemma ties embeddings by default, and config.json omits defaults
         "tie_embeddings": True,
     },
+    "MixtralForCausalLM": {},
 }
 
 
@@ -83,6 +86,11 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
             f"unsupported architecture {arch!r}; supported: "
             f"{sorted(ARCHITECTURES)}"
         )
+    base: LlamaConfig = LlamaConfig()
+    if arch == "MixtralForCausalLM":
+        from .moe import MoeConfig
+
+        base = MoeConfig()
     heads = int(hf["num_attention_heads"])
     hidden = int(hf["hidden_size"])
     fields: Dict[str, Any] = {
@@ -122,6 +130,9 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
         # full attention is exactly equivalent, so cap the servable
         # context at the window instead of silently attending past it.
         fields["max_seq_len"] = min(fields["max_seq_len"], int(sw))
+    if arch == "MixtralForCausalLM":
+        fields["num_experts"] = int(hf["num_local_experts"])
+        fields["experts_per_token"] = int(hf["num_experts_per_tok"])
     arch_defaults = dict(ARCHITECTURES[arch])
     fields["tie_embeddings"] = bool(
         hf.get(
@@ -130,7 +141,7 @@ def config_from_hf(path: str, **overrides: Any) -> LlamaConfig:
     )
     fields.update(arch_defaults)
     fields.update(overrides)
-    return dataclasses.replace(LlamaConfig(), **fields)
+    return dataclasses.replace(base, **fields)
 
 
 def eos_token_ids_from_hf(path: str) -> list:
@@ -206,9 +217,21 @@ _LAYER_MAP: Dict[str, Tuple[str, bool]] = {
     "mlp.gate_proj.weight": ("w_gate", True),
     "mlp.up_proj.weight": ("w_up", True),
     "mlp.down_proj.weight": ("w_down", True),
+    "self_attn.q_norm.weight": ("q_norm", False),
+    "self_attn.k_norm.weight": ("k_norm", False),
     "input_layernorm.weight": ("attn_norm", False),
     "post_attention_layernorm.weight": ("mlp_norm", False),
 }
+
+#: mixtral block-sparse FFN: per-expert suffix -> (our key, transpose?)
+_EXPERT_MAP: Dict[str, Tuple[str, bool]] = {
+    "w1.weight": ("w_gate", True),
+    "w2.weight": ("w_down", True),
+    "w3.weight": ("w_up", True),
+}
+
+#: harmless checkpoint extras (precomputed buffers, not weights)
+_IGNORED_SUFFIXES = ("rotary_emb.inv_freq",)
 
 _TOP_MAP: Dict[str, Tuple[str, bool]] = {
     "model.embed_tokens.weight": ("embed", False),
@@ -225,52 +248,102 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
     converted), so peak host memory is ~one model in target dtype plus
     one tensor — not an fp32 copy of the whole model.
     """
-    from .llama import init_params  # shape source of truth
-
     import jax
 
-    shapes = jax.eval_shape(lambda: init_params(jax.random.key(0), cfg))
+    from .registry import init_params_for  # shape source of truth
+
+    import dataclasses
+
+    # eval_shape over the UNquantized tree: staging happens in cfg.dtype,
+    # quantization (if any) runs once at the end like the serving path
+    plain = (
+        dataclasses.replace(cfg, quantization="")
+        if getattr(cfg, "quantization", "")
+        else cfg
+    )
+    shapes = jax.eval_shape(
+        lambda: init_params_for(jax.random.key(0), plain)
+    )
     np_dtype = np.dtype(cfg.dtype)  # ml_dtypes registers bfloat16
     buffers: Dict[str, Any] = {}
 
-    def stage(tree_key: Tuple[str, ...], layer: int | None, arr: np.ndarray):
+    def stage(
+        tree_key: Tuple[str, ...],
+        layer: int | None,
+        arr: np.ndarray,
+        expert: int | None = None,
+        name: str = "",
+    ):
         node = shapes
         for k in tree_key:
+            if not isinstance(node, dict) or k not in node:
+                # a tensor the config does not expect would be silently
+                # dropped weight otherwise (e.g. biases with
+                # attn_bias=False, q_norm without qk_norm)
+                raise ValueError(
+                    f"checkpoint tensor {name or '/'.join(tree_key)} has no "
+                    f"place in the model config (architecture mismatch?)"
+                )
             node = node[k]
         flat = "/".join(tree_key)
         if flat not in buffers:
             buffers[flat] = np.zeros(node.shape, dtype=np_dtype)
-        want = node.shape[1:] if layer is not None else node.shape
+        if expert is not None:
+            want, dst = node.shape[2:], lambda b: b[layer].__setitem__(
+                expert, arr.astype(np_dtype)
+            )
+        elif layer is not None:
+            want, dst = node.shape[1:], lambda b: b.__setitem__(
+                layer, arr.astype(np_dtype)
+            )
+        else:
+            want, dst = node.shape, lambda b: b.__setitem__(
+                ..., arr.astype(np_dtype)
+            )
         if arr.shape != tuple(want):
             raise ValueError(
                 f"{flat}: checkpoint shape {arr.shape} != model {tuple(want)}"
             )
-        if layer is not None:
-            buffers[flat][layer] = arr.astype(np_dtype)
-        else:
-            buffers[flat][...] = arr.astype(np_dtype)
+        dst(buffers[flat])
 
-    seen = set()
     for name, arr in _iter_tensors(path):
-        seen.add(name)
         if name in _TOP_MAP:
             ours, transpose = _TOP_MAP[name]
             if ours == "lm_head" and cfg.tie_embeddings:
                 continue  # tied: the forward reuses embed.T
-            stage((ours,), None, arr.T if transpose else arr)
+            stage((ours,), None, arr.T if transpose else arr, name=name)
             continue
         if not name.startswith("model.layers."):
-            continue  # rotary inv_freq buffers etc.
+            if name.endswith(_IGNORED_SUFFIXES):
+                continue
+            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
         rest = name[len("model.layers.") :]
         idx, _, suffix = rest.partition(".")
-        if suffix not in _LAYER_MAP:
-            continue
-        ours, transpose = _LAYER_MAP[suffix]
-        if ours in ("bq", "bk", "bv") and not cfg.attn_bias:
-            raise ValueError(
-                f"checkpoint has {name} but config attn_bias=False"
+        if not idx.isdigit():
+            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
+        layer = int(idx)
+        if suffix in _LAYER_MAP:
+            ours, transpose = _LAYER_MAP[suffix]
+            stage(
+                ("layers", ours), layer, arr.T if transpose else arr,
+                name=name,
             )
-        stage(("layers", ours), int(idx), arr.T if transpose else arr)
+        elif suffix == "block_sparse_moe.gate.weight":
+            stage(("layers", "router"), layer, arr.T, name=name)
+        elif suffix.startswith("block_sparse_moe.experts."):
+            rest2 = suffix[len("block_sparse_moe.experts.") :]
+            e_str, _, w = rest2.partition(".")
+            if w not in _EXPERT_MAP:
+                raise ValueError(f"unrecognized expert tensor {name!r}")
+            ours, transpose = _EXPERT_MAP[w]
+            stage(
+                ("layers", ours), layer, arr.T if transpose else arr,
+                expert=int(e_str), name=name,
+            )
+        elif suffix.endswith(_IGNORED_SUFFIXES):
+            continue
+        else:
+            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
 
     expected = {
         "/".join(p)

@@ -289,3 +289,64 @@ def test_eos_from_generation_config(tmp_path):
     with open(os.path.join(d, "generation_config.json"), "w") as f:
         json.dump({"eos_token_id": [11, 13]}, f)
     assert hf.eos_token_id_from_hf(d, default=-1) == 11
+
+
+def test_qwen3_qk_norm_parity(tmp_path):
+    """Qwen3: per-head RMSNorm on q/k before RoPE, no projection biases."""
+    d, m = _save(
+        tmp_path,
+        transformers.Qwen3Config,
+        transformers.Qwen3ForCausalLM,
+        head_dim=16,
+    )
+    cfg, params = hf.load_model(d, dtype=jnp.float32)
+    assert cfg.qk_norm and not cfg.attn_bias
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, TINY["vocab_size"], (2, 12))
+    with torch.no_grad():
+        ref = m(torch.from_numpy(tokens)).logits.float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_mixtral_moe_parity(tmp_path):
+    """Mixtral: routed MoE — router + per-expert SwiGLU stacks must match
+    transformers' block-sparse forward."""
+    d, m = _save(
+        tmp_path,
+        transformers.MixtralConfig,
+        transformers.MixtralForCausalLM,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+    )
+    from llm_d_fast_model_actuation_tpu.models.moe import MoeConfig
+
+    cfg, params = hf.load_model(d, dtype=jnp.float32)
+    assert isinstance(cfg, MoeConfig)
+    assert cfg.num_experts == 4 and cfg.experts_per_token == 2
+    assert params["layers"]["w_gate"].shape[:2] == (TINY["num_hidden_layers"], 4)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, TINY["vocab_size"], (2, 12))
+    with torch.no_grad():
+        ref = m(torch.from_numpy(tokens)).logits.float().numpy()
+    ours = _our_logits(cfg, params, tokens)
+    np.testing.assert_allclose(ours, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_unrecognized_checkpoint_tensor_rejected(tmp_path):
+    """A weight tensor with no place in the model must fail loudly, not be
+    silently dropped (silently-dropped weights serve wrong logits)."""
+    d, _ = _save(
+        tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM
+    )
+    import safetensors.torch as st
+    import os
+
+    fn = next(f for f in os.listdir(d) if f.endswith(".safetensors"))
+    sd = st.load_file(os.path.join(d, fn))
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(
+        TINY["num_attention_heads"] * (TINY["hidden_size"] // TINY["num_attention_heads"])
+    )
+    st.save_file(sd, os.path.join(d, fn))
+    with pytest.raises(ValueError, match="no place in the model config"):
+        hf.load_params(d, hf.config_from_hf(d))
