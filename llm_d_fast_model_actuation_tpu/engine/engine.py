@@ -55,6 +55,10 @@ class EngineConfig:
     #: additional stopping ids (Llama-3-Instruct declares [eos, eom, eot];
     #: chat turns end with eot, not the primary eos)
     extra_eos_ids: tuple = ()
+    #: top-k alternative logprobs computed per emitted token inside the
+    #: compiled programs (OpenAI `logprobs`/`top_logprobs`; vLLM caps at
+    #: 5). 0 disables the extra top-k + transfer.
+    logprobs_topk: int = 5
     #: Attention implementation: "auto" (pallas on TPU, grouped elsewhere),
     #: "grouped" (GQA-grouped XLA, deferred cache scatter), "pallas"
     #: (hand-written TPU kernels; interpreter mode off-TPU), or "reference"
@@ -104,6 +108,13 @@ class Request:
     temperature: float = 0.0
     out_tokens: List[int] = field(default_factory=list)
     out_logprobs: List[float] = field(default_factory=list)
+    #: per emitted token: [(token_id, logprob), ...] top-k alternatives of
+    #: the raw distribution (filled only when `want_top_logprobs`)
+    out_top_logprobs: List[list] = field(default_factory=list)
+    #: materialize per-token alternatives on the host (the device always
+    #: computes cfg.logprobs_topk; the Python tuple-building per token is
+    #: what this gates — most requests never ask for logprobs)
+    want_top_logprobs: bool = False
     #: nucleus sampling threshold; >= 1.0 = full distribution
     top_p: float = 1.0
     #: OpenAI repetition penalties (0 = off); applied to logits before
@@ -138,6 +149,15 @@ class Request:
     #: text in the server layer): the engine finishes the request at the
     #: next emitted token instead of decoding to eos/max_tokens
     stop_requested: bool = False
+
+
+def _alts_row(av, ai, row: int) -> list:
+    """Device [b, k] top-k arrays -> [(token_id, logprob), ...] for one row."""
+    av = np.asarray(av)
+    ai = np.asarray(ai)
+    return [
+        (int(ai[row, j]), float(av[row, j])) for j in range(av.shape[1])
+    ]
 
 
 def _stop_holdback(out: List[int], stop_seqs) -> int:
@@ -245,6 +265,8 @@ class InferenceEngine:
         model_cfg = m
         self._model_cfg = m
 
+        alt_k = cfg.logprobs_topk
+
         def _sample_last(logits, lens, temp, topp, counts, pres, freq, raw_key):
             """Shared sampling tail of both prefill programs: take the last
             valid logit, split the key, sample — one definition so the
@@ -254,11 +276,17 @@ class InferenceEngine:
             )[:, 0]
             key = jax.random.wrap_key_data(raw_key)
             key, sub = jax.random.split(key)
-            tok, lp = sample(
+            out = sample(
                 last, sub, temp, top_p=topp,
                 counts=counts, presence_penalty=pres, frequency_penalty=freq,
+                alt_k=alt_k,
             )
-            return tok, lp, jax.random.key_data(key)
+            tok, lp = out[0], out[1]
+            alts = out[2:] if alt_k > 0 else (
+                jnp.zeros((tok.shape[0], 0), jnp.float32),
+                jnp.zeros((tok.shape[0], 0), jnp.int32),
+            )
+            return tok, lp, alts[0], alts[1], jax.random.key_data(key)
 
         def _prefill(
             params, tokens, seq_lens, cache, page_table, temp, topp,
@@ -267,10 +295,10 @@ class InferenceEngine:
             logits, cache = llama.prefill(
                 params, model_cfg, tokens, seq_lens, cache, page_table
             )
-            tok, lp, raw_key = _sample_last(
+            tok, lp, av, ai, raw_key = _sample_last(
                 logits, seq_lens, temp, topp, counts, pres, freq, raw_key
             )
-            return tok, lp, cache, raw_key
+            return tok, lp, av, ai, cache, raw_key
 
         # cache (arg 3) donated: prefill updates pages in place.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
@@ -282,10 +310,10 @@ class InferenceEngine:
             logits, cache = llama.prefill_continue(
                 params, model_cfg, tokens, start, suffix_lens, cache, page_table
             )
-            tok, lp, raw_key = _sample_last(
+            tok, lp, av, ai, raw_key = _sample_last(
                 logits, suffix_lens, temp, topp, counts, pres, freq, raw_key
             )
-            return tok, lp, cache, raw_key
+            return tok, lp, av, ai, cache, raw_key
 
         self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(4,))
 
@@ -302,7 +330,13 @@ class InferenceEngine:
             )
             toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             lps = jnp.take_along_axis(norm, toks[..., None], axis=-1)[..., 0]
-            return toks, lps, cache
+            if cfg.logprobs_topk > 0:
+                avs, ais = jax.lax.top_k(norm, cfg.logprobs_topk)
+            else:
+                b, w = toks.shape
+                avs = jnp.zeros((b, w, 0), jnp.float32)
+                ais = jnp.zeros((b, w, 0), jnp.int32)
+            return toks, lps, avs, ais.astype(jnp.int32), cache
 
         self._verify_fn = jax.jit(_verify, donate_argnums=(4,))
         #: speculative decoding counters (observability)
@@ -331,11 +365,18 @@ class InferenceEngine:
                     params, model_cfg, lt, pos, cache, page_table, active
                 )
                 key, sub = jax.random.split(key)
-                nxt, lp = sample(
+                out = sample(
                     logits, sub, temps, top_p=topps,
                     counts=counts, presence_penalty=pres,
                     frequency_penalty=freq,
+                    alt_k=self.cfg.logprobs_topk,
                 )
+                nxt, lp = out[0], out[1]
+                if self.cfg.logprobs_topk > 0:
+                    av, ai = out[2], out[3]
+                else:
+                    av = jnp.zeros((nxt.shape[0], 0), jnp.float32)
+                    ai = jnp.zeros((nxt.shape[0], 0), jnp.int32)
                 nxt = jnp.where(active, nxt, lt)
                 a32 = active.astype(jnp.int32)
                 # the emitted token joins the counts the NEXT step penalizes
@@ -344,13 +385,18 @@ class InferenceEngine:
                 budget = budget - a32
                 if eos >= 0:
                     budget = jnp.where(active & (nxt == eos), 0, budget)
-                return (nxt, pos, budget, cache, counts, key), (nxt, lp)
+                return (
+                    (nxt, pos, budget, cache, counts, key), (nxt, lp, av, ai)
+                )
 
-            (lt, pos, budget, cache, counts, key), (toks, lps) = jax.lax.scan(
+            (
+                (lt, pos, budget, cache, counts, key),
+                (toks, lps, avs, ais),
+            ) = jax.lax.scan(
                 body, (lt, pos, budget, cache, counts, key), None, length=T
             )
             return (
-                toks, lps, lt, pos, budget, cache, counts,
+                toks, lps, avs, ais, lt, pos, budget, cache, counts,
                 jax.random.key_data(key),
             )
 
@@ -414,6 +460,7 @@ class InferenceEngine:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         on_token: Optional[Callable[[Request, int], None]] = None,
+        want_top_logprobs: bool = False,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -444,6 +491,7 @@ class InferenceEngine:
             presence_penalty=float(presence_penalty),
             frequency_penalty=float(frequency_penalty),
             on_token=on_token,
+            want_top_logprobs=want_top_logprobs,
         )
         self._next_seq_id += 1
         self._waiting.append(req)
@@ -555,7 +603,7 @@ class InferenceEngine:
             self.lockstep.prefill_suffix(
                 req, bucket, start_pos, len(seg), advance_key=final
             )
-        tok, lp, cache, new_key = self._suffix_prefill_fn(
+        tok, lp, av, ai, cache, new_key = self._suffix_prefill_fn(
             self.params,
             tokens,
             start,
@@ -572,7 +620,7 @@ class InferenceEngine:
         if final:
             self._raw_key = new_key
         self.pool.replace(cache)
-        return tok, lp
+        return tok, lp, av, ai
 
     def _run_prefill(self, req: Request) -> None:
         n = len(req.prompt)
@@ -592,7 +640,7 @@ class InferenceEngine:
             seq_lens = np.array([n], dtype=np.int32)
             if self.lockstep is not None:
                 self.lockstep.prefill(req, bucket)
-            tok, lp, cache, self._raw_key = self._prefill_fn(
+            tok, lp, av, ai, cache, self._raw_key = self._prefill_fn(
                 self.params,
                 tokens,
                 seq_lens,
@@ -613,7 +661,7 @@ class InferenceEngine:
             pos = k
             while pos < n:
                 seg = req.prompt[pos : min(n, pos + limit)]
-                tok, lp = self._run_suffix_segment(
+                tok, lp, av, ai = self._run_suffix_segment(
                     req, pos, seg, temp, topp, counts_row, pres, freq,
                     final=pos + len(seg) >= n,
                 )
@@ -628,7 +676,12 @@ class InferenceEngine:
             )
         first = int(np.asarray(tok)[0])
         req.pos = n
-        self._emit(req, first, float(np.asarray(lp)[0]))
+        self._emit(
+            req,
+            first,
+            float(np.asarray(lp)[0]),
+            _alts_row(av, ai, 0) if req.want_top_logprobs else None,
+        )
         self._positions[req.slot] = req.pos  # position of the token to place
         self._last_tokens[req.slot] = first
         self._temps[req.slot] = req.temperature
@@ -636,11 +689,18 @@ class InferenceEngine:
         self._budgets[req.slot] = req.max_new_tokens - len(req.out_tokens)
         self._dirty = True
 
-    def _emit(self, req: Request, token: int, logprob: float = 0.0) -> None:
+    def _emit(
+        self,
+        req: Request,
+        token: int,
+        logprob: float = 0.0,
+        alts: Optional[list] = None,
+    ) -> None:
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         req.out_tokens.append(token)
         req.out_logprobs.append(logprob)
+        req.out_top_logprobs.append(alts or [])
         self.total_tokens_emitted += 1
         if req.slot >= 0:
             # host counts mirror the device copy the chunk program updates
@@ -653,6 +713,7 @@ class InferenceEngine:
                 # OpenAI semantics: finish on the stop sequence and strip it
                 del req.out_tokens[-len(seq):]
                 del req.out_logprobs[-len(seq):]
+                del req.out_top_logprobs[-len(seq):]
                 req.done = True
                 req.finish_reason = "stop"
                 break
@@ -801,23 +862,36 @@ class InferenceEngine:
         start = np.array([req.pos], dtype=np.int32)
         window_len = np.array([len(window)], dtype=np.int32)
         table = self._page_table[req.slot : req.slot + 1]
-        toks, lps_dev, cache = self._verify_fn(
+        toks, lps_dev, avs_dev, ais_dev, cache = self._verify_fn(
             self.params, tokens, start, window_len, self.pool.as_tuple(), table
         )
         self.pool.replace(cache)
         o = np.asarray(toks)[0]
         o_lp = np.asarray(lps_dev)[0]
+        o_av = np.asarray(avs_dev)[0]
+        o_ai = np.asarray(ais_dev)[0]
         self.spec_proposed += len(props)
         accepted = 0
-        emitted: List[Tuple[int, float]] = []
+        emitted: List[Tuple[int, float, list]] = []
+
+        def _spec_alts(i):
+            if not req.want_top_logprobs:
+                return None
+            return [
+                (int(o_ai[i, j]), float(o_av[i, j]))
+                for j in range(o_av.shape[1])
+            ]
+
         for i, q in enumerate(props):
             if int(o[i]) != q:
-                emitted.append((int(o[i]), float(o_lp[i])))  # corrected token
+                # corrected token
+                emitted.append((int(o[i]), float(o_lp[i]), _spec_alts(i)))
                 break
             accepted += 1
-            emitted.append((q, float(o_lp[i])))
+            emitted.append((q, float(o_lp[i]), _spec_alts(i)))
         else:
-            emitted.append((int(o[len(props)]), float(o_lp[len(props)])))
+            i = len(props)
+            emitted.append((int(o[i]), float(o_lp[i]), _spec_alts(i)))
         self.spec_accepted += accepted
         if accepted == 0:
             self._spec_miss_streak += 1
@@ -826,14 +900,14 @@ class InferenceEngine:
                 self._spec_miss_streak = 0
         else:
             self._spec_miss_streak = 0
-        for t, lp in emitted:
+        for t, lp, alts in emitted:
             req.pos += 1
             self._positions[req.slot] = req.pos
             self._last_tokens[req.slot] = t
             self._budgets[req.slot] = max(
                 0, req.max_new_tokens - len(req.out_tokens) - 1
             )
-            self._emit(req, t, lp)
+            self._emit(req, t, lp, alts)
             if req.done:
                 break
         self._dirty = True  # device scheduler state is stale
@@ -885,8 +959,8 @@ class InferenceEngine:
                 self._upload_sched()
             d = self._dev
             (
-                toks_dev, lps_dev, lt, pos, budget, cache, counts_dev,
-                self._raw_key,
+                toks_dev, lps_dev, avs_dev, ais_dev, lt, pos, budget, cache,
+                counts_dev, self._raw_key,
             ) = self._chunk_fn(T)(
                 self.params,
                 d["lt"],
@@ -907,15 +981,25 @@ class InferenceEngine:
                 "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
                 "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
             }
-            toks = np.asarray(toks_dev)  # ONE host sync per chunk
-            lps = np.asarray(lps_dev)
+            # ONE host sync per chunk (batched device_get)
+            toks, lps, avs, ais = jax.device_get(
+                (toks_dev, lps_dev, avs_dev, ais_dev)
+            )
             for t in range(T):
                 for slot, req in list(running.items()):
                     tok = int(toks[t, slot])
                     req.pos += 1
                     self._positions[slot] = req.pos
                     self._last_tokens[slot] = tok
-                    self._emit(req, tok, float(lps[t, slot]))
+                    self._emit(
+                        req, tok, float(lps[t, slot]),
+                        [
+                            (int(ais[t, slot, j]), float(avs[t, slot, j]))
+                            for j in range(avs.shape[2])
+                        ]
+                        if req.want_top_logprobs
+                        else None,
+                    )
                     # keep the budget mirror exact: a dirty re-upload with a
                     # stale budget would un-freeze finished slots on device
                     self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)

@@ -217,7 +217,7 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
     topp = np.asarray([float(f["top_p"])], np.float32)
     counts_row = engine._token_counts[slot : slot + 1]
     zero = np.zeros((1,), np.float32)
-    _tok, _lp, cache, engine._raw_key = engine._prefill_fn(
+    _tok, _lp, _av, _ai, cache, engine._raw_key = engine._prefill_fn(
         engine.params,
         tokens,
         seq_lens,
@@ -248,7 +248,7 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
     topp = np.asarray([float(f["top_p"])], np.float32)
     counts_row = engine._token_counts[slot : slot + 1]
     zero = np.zeros((1,), np.float32)
-    _tok, _lp, cache, new_key = engine._suffix_prefill_fn(
+    _tok, _lp, _av, _ai, cache, new_key = engine._suffix_prefill_fn(
         engine.params,
         tokens,
         start,
@@ -274,7 +274,8 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         engine._upload_sched()
     d = engine._dev
     (
-        _toks, _lps, lt, pos, budget, cache, counts_dev, engine._raw_key
+        _toks, _lps, _avs, _ais, lt, pos, budget, cache, counts_dev,
+        engine._raw_key,
     ) = engine._chunk_fn(T)(
         engine.params,
         d["lt"],

@@ -27,8 +27,12 @@ def sample(
     counts: "jnp.ndarray | None" = None,  # [b, vocab] int32 token counts
     presence_penalty: "jnp.ndarray | None" = None,  # [b] fp32
     frequency_penalty: "jnp.ndarray | None" = None,  # [b] fp32
+    alt_k: int = 0,  # static; also return the top-k alternative logprobs
 ):
-    """Returns (token [b] int32, logprob [b] fp32 of the chosen token).
+    """Returns (token [b] int32, logprob [b] fp32 of the chosen token) —
+    plus, when `alt_k > 0`, (alt_logprobs [b, alt_k] fp32,
+    alt_ids [b, alt_k] int32): the top-k of the same raw distribution the
+    reported logprob comes from (OpenAI `logprobs`/`top_logprobs`).
 
     OpenAI-order transform chain: repetition penalties (subtract
     freq*count + pres*[count>0] from the logits) -> temperature ->
@@ -68,4 +72,7 @@ def sample(
         sampled = jnp.where(top_p < 1.0, nucleus, sampled)
     tok = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
     lp = jnp.take_along_axis(norm, tok[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if alt_k > 0:
+        alt_lps, alt_ids = jax.lax.top_k(norm, alt_k)
+        return tok, lp, alt_lps, alt_ids.astype(jnp.int32)
     return tok, lp

@@ -205,6 +205,13 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "0 = off",
     )
     p.add_argument(
+        "--logprobs-topk",
+        type=int,
+        default=5,
+        help="top-k alternative logprobs computed per token inside the "
+        "compiled programs (OpenAI logprobs/top_logprobs; 0 disables)",
+    )
+    p.add_argument(
         "--sleep-release-devices",
         default="auto",
         choices=["auto", "always", "never"],
@@ -401,6 +408,7 @@ class EngineService:
                 prefix_caching=args.prefix_caching == "on",
                 max_prefill_tokens=args.max_prefill_tokens,
                 speculative_ngram=args.speculative_ngram,
+                logprobs_topk=max(0, getattr(args, "logprobs_topk", 5)),
             ),
             params=params,
             mesh=mesh,
@@ -489,6 +497,7 @@ class EngineService:
                             (
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
+                                want_alts,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -497,6 +506,7 @@ class EngineService:
                                     presence_penalty=presence,
                                     frequency_penalty=freq,
                                     on_token=on_token,
+                                    want_top_logprobs=want_alts,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -579,6 +589,7 @@ class EngineService:
         stop_seqs: Any = (),
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
+        want_top_logprobs: bool = False,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -597,7 +608,7 @@ class EngineService:
             return fut
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
-             presence_penalty, frequency_penalty)
+             presence_penalty, frequency_penalty, want_top_logprobs)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -1068,6 +1079,36 @@ def build_app(service: EngineService) -> web.Application:
             raise web.HTTPBadRequest(text="n > 1 is not supported with stream")
         return n
 
+    def _top_dict(alts, n: int) -> Dict[str, float]:
+        """OpenAI completions top_logprobs entry: decoded-token -> logprob.
+        Distinct ids can decode to the same string (byte fallback,
+        whitespace variants); keep the best logprob on collision."""
+        out: Dict[str, float] = {}
+        for tid, lp_ in alts[:n]:
+            key = tok.decode([tid])
+            if key not in out or lp_ > out[key]:
+                out[key] = lp_
+        return out
+
+    def _parse_logprobs_n(v: Any, field: str = "logprobs") -> int:
+        """OpenAI completions `logprobs` / chat `top_logprobs`: false/true
+        (sampled-token logprobs only) or an int = how many top
+        alternatives per position. Bounded by the engine's compiled
+        top-k. Validated BEFORE submission: a bad value must 400 without
+        burning a full generation."""
+        if v is None or isinstance(v, bool):
+            return 0
+        try:
+            n = int(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"{field} must be a bool or int, got {v!r}")
+        limit = service.engine.cfg.logprobs_topk
+        if n < 0 or n > limit:
+            raise ValueError(
+                f"{field} must be in [0, {limit}] (engine --logprobs-topk)"
+            )
+        return n
+
     def _text_stop_watcher(stop_texts: tuple):
         """Engine-thread callback that asks for early termination once the
         decoded text contains a stop string — without it, a non-streaming
@@ -1086,7 +1127,7 @@ def build_app(service: EngineService) -> web.Application:
 
     async def _gather_n(
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
-        presence, frequency, stop_texts=(),
+        presence, frequency, stop_texts=(), want_alts=False,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -1099,6 +1140,7 @@ def build_app(service: EngineService) -> web.Application:
                 on_token=(
                     _text_stop_watcher(stop_texts) if stop_texts else None
                 ),
+                want_top_logprobs=want_alts,
             )
             for _ in range(n)
         ]
@@ -1124,7 +1166,16 @@ def build_app(service: EngineService) -> web.Application:
             raise web.HTTPBadRequest(text=str(e))
 
         n = _parse_n(body)
+        try:
+            logprobs_n = _parse_logprobs_n(body.get("logprobs"), "logprobs")
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
         if body.get("stream"):
+            if logprobs_n > 0:
+                raise web.HTTPBadRequest(
+                    text="integer logprobs is not supported with stream"
+                )
+
             def chunk(text: str, ids: List[int], index: int) -> Dict[str, Any]:
                 return {
                     "object": "text_completion",
@@ -1141,7 +1192,7 @@ def build_app(service: EngineService) -> web.Application:
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
-            presence, frequency, stop_texts,
+            presence, frequency, stop_texts, want_alts=logprobs_n > 0,
         )
         req = reqs[0]
         ttft = (
@@ -1171,6 +1222,11 @@ def build_app(service: EngineService) -> web.Application:
                     "tokens": kept,
                     "token_logprobs": kept_lps,
                 }
+                if logprobs_n > 0:
+                    choice["logprobs"]["top_logprobs"] = [
+                        _top_dict(alts, logprobs_n)
+                        for alts in r.out_top_logprobs[: len(kept)]
+                    ]
             choices.append(choice)
         return web.json_response(
             {
@@ -1198,7 +1254,20 @@ def build_app(service: EngineService) -> web.Application:
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         n = _parse_n(body)
+        try:
+            top_n = (
+                _parse_logprobs_n(body.get("top_logprobs"), "top_logprobs")
+                if body.get("logprobs")
+                else 0
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
         if body.get("stream"):
+            if top_n > 0:
+                raise web.HTTPBadRequest(
+                    text="top_logprobs is not supported with stream"
+                )
+
             def chunk(text: str, ids: List[int], index: int) -> Dict[str, Any]:
                 delta: Dict[str, Any] = {"content": text}
                 if index == 0:
@@ -1216,30 +1285,50 @@ def build_app(service: EngineService) -> web.Application:
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
-            presence, frequency, stop_texts,
+            presence, frequency, stop_texts, want_alts=top_n > 0,
         )
         from .tokenizer import truncate_at_text_stop
 
         choices = []
         total_completion = 0
         for i, r in enumerate(reqs):
-            kept, _, text, matched = truncate_at_text_stop(
+            kept, kept_lps, text, matched = truncate_at_text_stop(
                 tok, r.out_tokens, r.out_logprobs, stop_texts
             )
             total_completion += len(kept)
-            choices.append(
-                {
-                    "index": i,
-                    "message": {
-                        "role": "assistant",
-                        "content": text,
-                        "token_ids": kept,
-                    },
-                    "finish_reason": (
-                        "stop" if matched else _finish_reason(service, r)
-                    ),
+            choice = {
+                "index": i,
+                "message": {
+                    "role": "assistant",
+                    "content": text,
+                    "token_ids": kept,
+                },
+                "finish_reason": (
+                    "stop" if matched else _finish_reason(service, r)
+                ),
+            }
+            if body.get("logprobs"):
+                # OpenAI chat logprobs shape: per-token entries with
+                # optional top_logprobs alternatives
+                choice["logprobs"] = {
+                    "content": [
+                        {
+                            "token": tok.decode([tid]),
+                            "logprob": lp,
+                            "top_logprobs": [
+                                {
+                                    "token": tok.decode([aid]),
+                                    "logprob": alp,
+                                }
+                                for aid, alp in alts[:top_n]
+                            ],
+                        }
+                        for tid, lp, alts in zip(
+                            kept, kept_lps, r.out_top_logprobs[: len(kept)]
+                        )
+                    ]
                 }
-            )
+            choices.append(choice)
         return web.json_response(
             {
                 "object": "chat.completion",

@@ -513,3 +513,82 @@ def test_max_tokens_validation(service):
         assert r.status == 200 and len(body["choices"][0]["token_ids"]) == 1
 
     run_async(_client(service, scenario))
+
+
+def test_top_logprobs_completions_and_chat(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 3, "logprobs": 3},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        lp = body["choices"][0]["logprobs"]
+        toks = body["choices"][0]["token_ids"]
+        assert len(lp["top_logprobs"]) == len(toks)
+        for t, tlp, alts in zip(toks, lp["token_logprobs"], lp["top_logprobs"]):
+            # dict keyed by decoded token text (OpenAI shape): distinct ids
+            # can decode to the same string under the byte fallback
+            assert 1 <= len(alts) <= 3
+            # greedy: the sampled token IS the argmax, so its logprob
+            # equals the best alternative's
+            best = max(alts.values())
+            assert abs(best - tlp) < 1e-4
+            assert all(v <= best + 1e-6 for v in alts.values())
+
+        # out of range -> 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 2, "logprobs": 50},
+        )
+        assert r.status == 400
+
+        # int logprobs with stream: rejected up front, not silently dropped
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 2, "logprobs": 2,
+                  "stream": True},
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 2, "logprobs": True, "top_logprobs": 2,
+                  "stream": True},
+        )
+        assert r.status == 400
+        # bad top_logprobs 400 names the right field
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 2, "logprobs": True, "top_logprobs": 50},
+        )
+        assert r.status == 400 and "top_logprobs" in await r.text()
+
+        # chat: OpenAI content shape with top_logprobs
+        r = await client.post(
+            "/v1/chat/completions",
+            json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3,
+                "logprobs": True,
+                "top_logprobs": 2,
+            },
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        content = body["choices"][0]["logprobs"]["content"]
+        assert len(content) == len(body["choices"][0]["message"]["token_ids"])
+        for entry in content:
+            assert isinstance(entry["token"], str)
+            assert len(entry["top_logprobs"]) == 2
+
+        # logprobs: true (bool) keeps the legacy sampled-only shape
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 2, "logprobs": True},
+        )
+        body = await r.json()
+        assert "top_logprobs" not in body["choices"][0]["logprobs"]
+
+    run_async(_client(service, scenario))
