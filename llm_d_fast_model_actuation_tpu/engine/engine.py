@@ -115,6 +115,12 @@ class Request:
     #: computes cfg.logprobs_topk; the Python tuple-building per token is
     #: what this gates — most requests never ask for logprobs)
     want_top_logprobs: bool = False
+    #: OpenAI `echo` + `logprobs`: logprob of every PROMPT token under the
+    #: model (first entry None — nothing precedes it). Requesting this
+    #: bypasses the prefix cache: cached pages skip exactly the forward
+    #: that would produce these numbers.
+    want_prompt_logprobs: bool = False
+    prompt_logprobs: List[Optional[float]] = field(default_factory=list)
     #: nucleus sampling threshold; >= 1.0 = full distribution
     top_p: float = 1.0
     #: OpenAI repetition penalties (0 = off); applied to logits before
@@ -288,34 +294,76 @@ class InferenceEngine:
             )
             return tok, lp, alts[0], alts[1], jax.random.key_data(key)
 
-        def _prefill(
-            params, tokens, seq_lens, cache, page_table, temp, topp,
-            counts, pres, freq, raw_key,
-        ):
-            logits, cache = llama.prefill(
-                params, model_cfg, tokens, seq_lens, cache, page_table
+        def _prompt_lps(logits, targets):
+            """Per-position logprob of `targets` (the NEXT prompt token at
+            each position) under the model — OpenAI echo+logprobs."""
+            norm = logits - jax.scipy.special.logsumexp(
+                logits, axis=-1, keepdims=True
             )
-            tok, lp, av, ai, raw_key = _sample_last(
-                logits, seq_lens, temp, topp, counts, pres, freq, raw_key
-            )
-            return tok, lp, av, ai, cache, raw_key
+            return jnp.take_along_axis(
+                norm, targets[..., None], axis=-1
+            )[..., 0]
+
+        def _make_prefill(with_plp: bool):
+            """Two compiled variants: prompt-logprob scoring is an extra
+            vocab-wide logsumexp over the WHOLE bucket — only echo
+            requests pay for it. Signatures match, so call sites just
+            pick the function."""
+
+            def _prefill(
+                params, tokens, seq_lens, cache, page_table, temp, topp,
+                counts, pres, freq, raw_key,
+            ):
+                logits, cache = llama.prefill(
+                    params, model_cfg, tokens, seq_lens, cache, page_table
+                )
+                tok, lp, av, ai, raw_key = _sample_last(
+                    logits, seq_lens, temp, topp, counts, pres, freq, raw_key
+                )
+                if with_plp:
+                    # position i predicts token i+1: shift the prompt left
+                    targets = jnp.roll(tokens, -1, axis=1)
+                    plp = _prompt_lps(logits, targets)
+                else:
+                    plp = jnp.zeros(tokens.shape, jnp.float32)
+                return tok, lp, av, ai, plp, cache, raw_key
+
+            return _prefill
 
         # cache (arg 3) donated: prefill updates pages in place.
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=(3,))
+        self._prefill_fn = jax.jit(_make_prefill(False), donate_argnums=(3,))
+        self._prefill_plp_fn = jax.jit(_make_prefill(True), donate_argnums=(3,))
 
-        def _suffix_prefill(
-            params, tokens, start, suffix_lens, cache, page_table, temp, topp,
-            counts, pres, freq, raw_key,
-        ):
-            logits, cache = llama.prefill_continue(
-                params, model_cfg, tokens, start, suffix_lens, cache, page_table
-            )
-            tok, lp, av, ai, raw_key = _sample_last(
-                logits, suffix_lens, temp, topp, counts, pres, freq, raw_key
-            )
-            return tok, lp, av, ai, cache, raw_key
+        def _make_suffix_prefill(with_plp: bool):
+            def _suffix_prefill(
+                params, tokens, targets, start, suffix_lens, cache,
+                page_table, temp, topp, counts, pres, freq, raw_key,
+            ):
+                logits, cache = llama.prefill_continue(
+                    params, model_cfg, tokens, start, suffix_lens, cache,
+                    page_table,
+                )
+                tok, lp, av, ai, raw_key = _sample_last(
+                    logits, suffix_lens, temp, topp, counts, pres, freq,
+                    raw_key,
+                )
+                if with_plp:
+                    # a segment cannot derive its last target (the NEXT
+                    # segment's first token) from its own tokens, so
+                    # targets come in
+                    plp = _prompt_lps(logits, targets)
+                else:
+                    plp = jnp.zeros(tokens.shape, jnp.float32)
+                return tok, lp, av, ai, plp, cache, raw_key
 
-        self._suffix_prefill_fn = jax.jit(_suffix_prefill, donate_argnums=(4,))
+            return _suffix_prefill
+
+        self._suffix_prefill_fn = jax.jit(
+            _make_suffix_prefill(False), donate_argnums=(5,)
+        )
+        self._suffix_prefill_plp_fn = jax.jit(
+            _make_suffix_prefill(True), donate_argnums=(5,)
+        )
 
         def _verify(params, tokens, start, window_len, cache, page_table):
             """Speculative verify: run the window [last_token, q1..q_{k-1}]
@@ -461,6 +509,7 @@ class InferenceEngine:
         frequency_penalty: float = 0.0,
         on_token: Optional[Callable[[Request, int], None]] = None,
         want_top_logprobs: bool = False,
+        want_prompt_logprobs: bool = False,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -492,6 +541,7 @@ class InferenceEngine:
             frequency_penalty=float(frequency_penalty),
             on_token=on_token,
             want_top_logprobs=want_top_logprobs,
+            want_prompt_logprobs=want_prompt_logprobs,
         )
         self._next_seq_id += 1
         self._waiting.append(req)
@@ -521,7 +571,7 @@ class InferenceEngine:
         need = PageAllocator.pages_needed(total, self.cfg.page_size)
         shared: List[int] = []
         hashes: List[str] = []
-        if self.prefix_cache is not None:
+        if self.prefix_cache is not None and not req.want_prompt_logprobs:
             shared, req.cached_tokens, hashes = self.prefix_cache.match(
                 req.prompt
             )
@@ -597,15 +647,27 @@ class InferenceEngine:
         bucket = self._prefill_bucket(len(seg))
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, : len(seg)] = seg
+        # next prompt token at each segment position (prompt-logprob
+        # targets); the final position of the final segment has none
+        targets = np.zeros((1, bucket), dtype=np.int32)
+        nxt = req.prompt[start_pos + 1 : start_pos + len(seg) + 1]
+        targets[0, : len(nxt)] = nxt
         start = np.array([start_pos], dtype=np.int32)
         seg_lens = np.array([len(seg)], dtype=np.int32)
         if self.lockstep is not None:
             self.lockstep.prefill_suffix(
-                req, bucket, start_pos, len(seg), advance_key=final
+                req, bucket, start_pos, len(seg), advance_key=final,
+                want_plp=req.want_prompt_logprobs,
             )
-        tok, lp, av, ai, cache, new_key = self._suffix_prefill_fn(
+        fn = (
+            self._suffix_prefill_plp_fn
+            if req.want_prompt_logprobs
+            else self._suffix_prefill_fn
+        )
+        tok, lp, av, ai, plp, cache, new_key = fn(
             self.params,
             tokens,
+            targets,
             start,
             seg_lens,
             self.pool.as_tuple(),
@@ -620,7 +682,7 @@ class InferenceEngine:
         if final:
             self._raw_key = new_key
         self.pool.replace(cache)
-        return tok, lp, av, ai
+        return tok, lp, av, ai, plp
 
     def _run_prefill(self, req: Request) -> None:
         n = len(req.prompt)
@@ -639,8 +701,15 @@ class InferenceEngine:
             tokens[0, :n] = req.prompt
             seq_lens = np.array([n], dtype=np.int32)
             if self.lockstep is not None:
-                self.lockstep.prefill(req, bucket)
-            tok, lp, av, ai, cache, self._raw_key = self._prefill_fn(
+                self.lockstep.prefill(
+                    req, bucket, want_plp=req.want_prompt_logprobs
+                )
+            fn = (
+                self._prefill_plp_fn
+                if req.want_prompt_logprobs
+                else self._prefill_fn
+            )
+            tok, lp, av, ai, plp, cache, self._raw_key = fn(
                 self.params,
                 tokens,
                 seq_lens,
@@ -654,17 +723,32 @@ class InferenceEngine:
                 self._raw_key,
             )
             self.pool.replace(cache)
+            if req.want_prompt_logprobs:
+                row = np.asarray(plp)[0]
+                req.prompt_logprobs = [None] + [
+                    float(row[i]) for i in range(n - 1)
+                ]
         else:
             # prefix-cache hit and/or chunked prefill: run [k, n) through
             # the continue program in segments of <= limit tokens; only the
             # final segment's sample is consumed
             pos = k
+            if req.want_prompt_logprobs:
+                req.prompt_logprobs = [None]  # nothing precedes token 0
             while pos < n:
                 seg = req.prompt[pos : min(n, pos + limit)]
-                tok, lp, av, ai = self._run_suffix_segment(
+                tok, lp, av, ai, plp = self._run_suffix_segment(
                     req, pos, seg, temp, topp, counts_row, pres, freq,
                     final=pos + len(seg) >= n,
                 )
+                if req.want_prompt_logprobs:
+                    row = np.asarray(plp)[0]
+                    # entries predict prompt[pos+1 .. pos+len(seg)]; the
+                    # final segment's last entry predicts nothing
+                    take = len(seg) if pos + len(seg) < n else len(seg) - 1
+                    req.prompt_logprobs.extend(
+                        float(row[i]) for i in range(take)
+                    )
                 pos += len(seg)
         if self.prefix_cache is not None:
             # the full prompt pages now hold prompt KV: make them reusable

@@ -67,6 +67,7 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         #: suffix prefill: thread the returned RNG key (1) or discard it
         #: (0 — non-final chunked-prefill segments)
         "advance_key": np.ones((), np.int32),
+        "want_plp": np.zeros((), np.int32),
         "lt": np.zeros((b,), np.int32),
         "pos": np.zeros((b,), np.int32),
         "budget": np.zeros((b,), np.int32),
@@ -116,7 +117,7 @@ class LockstepLeader:
 
     # -- hooks ---------------------------------------------------------------
 
-    def prefill(self, req: Any, bucket: int) -> None:
+    def prefill(self, req: Any, bucket: int, want_plp: bool = False) -> None:
         tokens = np.zeros((self.engine.cfg.seq_len,), np.int32)
         tokens[: len(req.prompt)] = req.prompt
         self._send(
@@ -127,6 +128,7 @@ class LockstepLeader:
             temp=req.temperature,
             top_p=req.top_p,
             tokens=tokens,
+            want_plp=int(want_plp),
         )
 
     def prefill_suffix(
@@ -136,6 +138,7 @@ class LockstepLeader:
         start: int,
         seg_len: int = -1,
         advance_key: bool = True,
+        want_plp: bool = False,
     ) -> None:
         if seg_len < 0:
             seg_len = len(req.prompt) - start
@@ -152,6 +155,7 @@ class LockstepLeader:
             top_p=req.top_p,
             tokens=tokens,
             advance_key=int(advance_key),
+            want_plp=int(want_plp),
         )
 
     def chunk(self, T: int, reupload: bool) -> None:
@@ -217,7 +221,12 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
     topp = np.asarray([float(f["top_p"])], np.float32)
     counts_row = engine._token_counts[slot : slot + 1]
     zero = np.zeros((1,), np.float32)
-    _tok, _lp, _av, _ai, cache, engine._raw_key = engine._prefill_fn(
+    fn = (
+        engine._prefill_plp_fn
+        if int(f.get("want_plp", 0))
+        else engine._prefill_fn
+    )
+    _tok, _lp, _av, _ai, _plp, cache, engine._raw_key = fn(
         engine.params,
         tokens,
         seq_lens,
@@ -248,9 +257,17 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
     topp = np.asarray([float(f["top_p"])], np.float32)
     counts_row = engine._token_counts[slot : slot + 1]
     zero = np.zeros((1,), np.float32)
-    _tok, _lp, _av, _ai, cache, new_key = engine._suffix_prefill_fn(
+    # targets feed prompt-logprob gathering; followers discard outputs,
+    # so zeros keep the program shape without carrying data in the frame
+    fn = (
+        engine._suffix_prefill_plp_fn
+        if int(f.get("want_plp", 0))
+        else engine._suffix_prefill_fn
+    )
+    _tok, _lp, _av, _ai, _plp, cache, new_key = fn(
         engine.params,
         tokens,
+        np.zeros_like(tokens),
         start,
         suffix_lens,
         engine.pool.as_tuple(),

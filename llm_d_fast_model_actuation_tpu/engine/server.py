@@ -497,7 +497,7 @@ class EngineService:
                             (
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
-                                want_alts,
+                                want_alts, want_plp,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -507,6 +507,7 @@ class EngineService:
                                     frequency_penalty=freq,
                                     on_token=on_token,
                                     want_top_logprobs=want_alts,
+                                    want_prompt_logprobs=want_plp,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -590,6 +591,7 @@ class EngineService:
         presence_penalty: float = 0.0,
         frequency_penalty: float = 0.0,
         want_top_logprobs: bool = False,
+        want_prompt_logprobs: bool = False,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -608,7 +610,8 @@ class EngineService:
             return fut
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
-             presence_penalty, frequency_penalty, want_top_logprobs)
+             presence_penalty, frequency_penalty, want_top_logprobs,
+             want_prompt_logprobs)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -1128,6 +1131,7 @@ def build_app(service: EngineService) -> web.Application:
     async def _gather_n(
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
         presence, frequency, stop_texts=(), want_alts=False,
+        want_prompt_logprobs=False,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -1141,8 +1145,12 @@ def build_app(service: EngineService) -> web.Application:
                     _text_stop_watcher(stop_texts) if stop_texts else None
                 ),
                 want_top_logprobs=want_alts,
+                # prompt scores are identical across siblings: only the
+                # first bypasses the prefix cache and pays the forward;
+                # the response copies them onto the other choices
+                want_prompt_logprobs=want_prompt_logprobs and i == 0,
             )
-            for _ in range(n)
+            for i in range(n)
         ]
         try:
             return [await _await_generation(f) for f in futs]
@@ -1170,10 +1178,15 @@ def build_app(service: EngineService) -> web.Application:
             logprobs_n = _parse_logprobs_n(body.get("logprobs"), "logprobs")
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        echo = bool(body.get("echo"))
         if body.get("stream"):
             if logprobs_n > 0:
                 raise web.HTTPBadRequest(
                     text="integer logprobs is not supported with stream"
+                )
+            if echo:
+                raise web.HTTPBadRequest(
+                    text="echo is not supported with stream"
                 )
 
             def chunk(text: str, ids: List[int], index: int) -> Dict[str, Any]:
@@ -1193,6 +1206,7 @@ def build_app(service: EngineService) -> web.Application:
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=logprobs_n > 0,
+            want_prompt_logprobs=echo and bool(body.get("logprobs")),
         )
         req = reqs[0]
         ttft = (
@@ -1212,21 +1226,32 @@ def build_app(service: EngineService) -> web.Application:
             choice = {
                 "index": i,
                 "token_ids": kept,
-                "text": text,
+                "text": (tok.decode(tokens) + text) if echo else text,
                 "finish_reason": (
                     "stop" if matched else _finish_reason(service, r)
                 ),
             }
             if body.get("logprobs"):
+                # OpenAI echo+logprobs: the arrays cover prompt tokens
+                # too (first entry null — nothing precedes it)
+                lp_tokens = (tokens + kept) if echo else kept
+                lp_vals = (
+                    (reqs[0].prompt_logprobs + kept_lps)
+                    if echo
+                    else kept_lps
+                )
                 choice["logprobs"] = {
-                    "tokens": kept,
-                    "token_logprobs": kept_lps,
+                    "tokens": lp_tokens,
+                    "token_logprobs": lp_vals,
                 }
                 if logprobs_n > 0:
-                    choice["logprobs"]["top_logprobs"] = [
+                    tops = [
                         _top_dict(alts, logprobs_n)
                         for alts in r.out_top_logprobs[: len(kept)]
                     ]
+                    if echo:
+                        tops = [{} for _ in tokens] + tops
+                    choice["logprobs"]["top_logprobs"] = tops
             choices.append(choice)
         return web.json_response(
             {

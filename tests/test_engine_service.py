@@ -592,3 +592,70 @@ def test_top_logprobs_completions_and_chat(service):
         assert "top_logprobs" not in body["choices"][0]["logprobs"]
 
     run_async(_client(service, scenario))
+
+
+def test_echo_with_prompt_logprobs(service):
+    async def scenario(client):
+        prompt = [7, 8, 9, 10]
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 3, "echo": True,
+                  "logprobs": 2},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        c = body["choices"][0]
+        lp = c["logprobs"]
+        # arrays cover prompt + completion; first prompt entry is null
+        assert lp["tokens"] == prompt + c["token_ids"]
+        assert lp["token_logprobs"][0] is None
+        assert len(lp["token_logprobs"]) == len(prompt) + len(c["token_ids"])
+        assert all(
+            v is None or v <= 0.0 for v in lp["token_logprobs"]
+        )
+        # prompt positions carry empty top_logprobs, completions real ones
+        assert lp["top_logprobs"][: len(prompt)] == [{}] * len(prompt)
+        assert all(len(d) >= 1 for d in lp["top_logprobs"][len(prompt):])
+        # echoed text starts with the decoded prompt
+        assert c["text"].startswith(
+            service.tokenizer.decode(prompt)
+        )
+
+        # prompt logprobs must agree with a prefix-cache-off rerun of the
+        # same prompt (the cache is bypassed for these requests)
+        r2 = await client.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 3, "echo": True,
+                  "logprobs": 2},
+        )
+        body2 = await r2.json()
+        assert (
+            body2["choices"][0]["logprobs"]["token_logprobs"]
+            == lp["token_logprobs"]
+        )
+
+        # echo + stream -> 400
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 2, "echo": True,
+                  "stream": True},
+        )
+        assert r.status == 400
+
+        # n > 1: all choices carry the (identical) prompt scores; only
+        # the first sibling paid the uncached prompt forward
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": prompt, "max_tokens": 2, "echo": True,
+                  "logprobs": True, "n": 2},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        c0, c1 = body["choices"]
+        np_ = len(prompt)
+        assert (
+            c0["logprobs"]["token_logprobs"][:np_]
+            == c1["logprobs"]["token_logprobs"][:np_]
+        )
+        assert c1["logprobs"]["token_logprobs"][0] is None
+    run_async(_client(service, scenario))

@@ -350,3 +350,43 @@ def test_unrecognized_checkpoint_tensor_rejected(tmp_path):
     st.save_file(sd, os.path.join(d, fn))
     with pytest.raises(ValueError, match="no place in the model config"):
         hf.load_params(d, hf.config_from_hf(d))
+
+
+def test_prompt_logprobs_match_transformers(tmp_path):
+    """echo+logprobs prompt scores must equal the model's actual
+    next-token logprobs — checked against transformers, through BOTH the
+    single-shot prefill and the chunked (segmented) prefill path."""
+    d, m = _save(
+        tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM
+    )
+    cfg, params = hf.load_model(d, dtype=jnp.float32)
+    from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
+
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    with torch.no_grad():
+        logits = m(torch.tensor([prompt])).logits[0].float()
+    norm = torch.log_softmax(logits, dim=-1)
+    ref = [None] + [
+        float(norm[i, prompt[i + 1]]) for i in range(len(prompt) - 1)
+    ]
+
+    for max_prefill in (0, 4):  # whole-prompt and 3-segment chunked
+        eng = InferenceEngine(
+            EngineConfig(
+                model=cfg, max_batch=2, page_size=8, num_pages=32,
+                max_seq_len=64, eos_token_id=-1,
+                max_prefill_tokens=max_prefill,
+            ),
+            params=params,
+        )
+        eng.add_request(prompt, max_new_tokens=1, want_prompt_logprobs=True)
+        done = []
+        while eng.has_work():
+            done.extend(eng.step())
+        (req,) = done
+        assert req.prompt_logprobs[0] is None
+        got = req.prompt_logprobs
+        assert len(got) == len(ref)
+        np.testing.assert_allclose(
+            [g for g in got[1:]], [r for r in ref[1:]], rtol=2e-3, atol=2e-3,
+        )
