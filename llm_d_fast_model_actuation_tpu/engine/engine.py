@@ -115,6 +115,11 @@ class Request:
     #: computes cfg.logprobs_topk; the Python tuple-building per token is
     #: what this gates — most requests never ask for logprobs)
     want_top_logprobs: bool = False
+    #: per-request RNG seed (OpenAI/vLLM `seed`): with it, a sampled
+    #: (temperature > 0) request's output depends only on (seed, params,
+    #: prompt, sampling knobs) — not on batch composition or arrival
+    #: order. None = a stream derived from the engine seed and seq_id.
+    seed: Optional[int] = None
     #: OpenAI `echo` + `logprobs`: logprob of every PROMPT token under the
     #: model (first entry None — nothing precedes it). Requesting this
     #: bypasses the prefix cache: cached pages skip exactly the forward
@@ -257,9 +262,13 @@ class InferenceEngine:
         #: lifetime emitted-token count (observability; lets tests assert
         #: that early stopping really saved decode work)
         self.total_tokens_emitted = 0
-        self._raw_key: Any = np.asarray(
-            jax.random.key_data(jax.random.key(seed + 1))
-        )  # uint32 key data; device-resident after first upload
+        self._seed = seed
+        #: per-slot RNG key data [b, 2]: every slot samples from its OWN
+        #: key stream (seeded requests get key(seed); unseeded get a
+        #: fold_in of the engine seed and their seq_id), so a seeded
+        #: request's draws are independent of batch neighbors. The host
+        #: mirror re-syncs from the device after every chunk.
+        self._slot_keys = np.zeros((b, 2), dtype=np.uint32)
         self._dev: Optional[Dict[str, Any]] = None  # device scheduler arrays
         self._dirty = True
         #: Multi-host lockstep (engine/multihost.py): the gang leader's
@@ -273,14 +282,15 @@ class InferenceEngine:
 
         alt_k = cfg.logprobs_topk
 
-        def _sample_last(logits, lens, temp, topp, counts, pres, freq, raw_key):
+        def _sample_last(logits, lens, temp, topp, counts, pres, freq, skey):
             """Shared sampling tail of both prefill programs: take the last
-            valid logit, split the key, sample — one definition so the
-            cache-hit path can never diverge from the cold one."""
+            valid logit, split the request's OWN key, sample — one
+            definition so the cache-hit path can never diverge from the
+            cold one."""
             last = jnp.take_along_axis(
                 logits, (lens - 1)[:, None, None], axis=1
             )[:, 0]
-            key = jax.random.wrap_key_data(raw_key)
+            key = jax.random.wrap_key_data(skey)
             key, sub = jax.random.split(key)
             out = sample(
                 last, sub, temp, top_p=topp,
@@ -312,13 +322,13 @@ class InferenceEngine:
 
             def _prefill(
                 params, tokens, seq_lens, cache, page_table, temp, topp,
-                counts, pres, freq, raw_key,
+                counts, pres, freq, skey,
             ):
                 logits, cache = llama.prefill(
                     params, model_cfg, tokens, seq_lens, cache, page_table
                 )
-                tok, lp, av, ai, raw_key = _sample_last(
-                    logits, seq_lens, temp, topp, counts, pres, freq, raw_key
+                tok, lp, av, ai, skey = _sample_last(
+                    logits, seq_lens, temp, topp, counts, pres, freq, skey
                 )
                 if with_plp:
                     # position i predicts token i+1: shift the prompt left
@@ -326,7 +336,7 @@ class InferenceEngine:
                     plp = _prompt_lps(logits, targets)
                 else:
                     plp = jnp.zeros(tokens.shape, jnp.float32)
-                return tok, lp, av, ai, plp, cache, raw_key
+                return tok, lp, av, ai, plp, cache, skey
 
             return _prefill
 
@@ -337,15 +347,15 @@ class InferenceEngine:
         def _make_suffix_prefill(with_plp: bool):
             def _suffix_prefill(
                 params, tokens, targets, start, suffix_lens, cache,
-                page_table, temp, topp, counts, pres, freq, raw_key,
+                page_table, temp, topp, counts, pres, freq, skey,
             ):
                 logits, cache = llama.prefill_continue(
                     params, model_cfg, tokens, start, suffix_lens, cache,
                     page_table,
                 )
-                tok, lp, av, ai, raw_key = _sample_last(
+                tok, lp, av, ai, skey = _sample_last(
                     logits, suffix_lens, temp, topp, counts, pres, freq,
-                    raw_key,
+                    skey,
                 )
                 if with_plp:
                     # a segment cannot derive its last target (the NEXT
@@ -354,7 +364,7 @@ class InferenceEngine:
                     plp = _prompt_lps(logits, targets)
                 else:
                     plp = jnp.zeros(tokens.shape, jnp.float32)
-                return tok, lp, av, ai, plp, cache, raw_key
+                return tok, lp, av, ai, plp, cache, skey
 
             return _suffix_prefill
 
@@ -402,19 +412,24 @@ class InferenceEngine:
 
         def chunk(
             params, lt, pos, budget, cache, page_table, temps, topps,
-            counts, pres, freq, raw_key,
+            counts, pres, freq, skeys,
         ):
-            key = jax.random.wrap_key_data(raw_key)
-
             def body(carry, _):
-                lt, pos, budget, cache, counts, key = carry
+                lt, pos, budget, cache, counts, skeys = carry
                 active = budget > 0
                 logits, cache = llama.decode_step(
                     params, model_cfg, lt, pos, cache, page_table, active
                 )
-                key, sub = jax.random.split(key)
+                # each slot splits its OWN key — and only while active, so
+                # a request's draw count is a function of its own progress,
+                # not of how long it shared the batch with others
+                keys = jax.random.wrap_key_data(skeys)  # [b] typed keys
+                pairs = jax.vmap(jax.random.split)(keys)  # [b, 2]
+                subs = pairs[:, 1]
+                new_data = jax.random.key_data(pairs[:, 0])
+                skeys = jnp.where(active[:, None], new_data, skeys)
                 out = sample(
-                    logits, sub, temps, top_p=topps,
+                    logits, subs, temps, top_p=topps,
                     counts=counts, presence_penalty=pres,
                     frequency_penalty=freq,
                     alt_k=self.cfg.logprobs_topk,
@@ -434,18 +449,18 @@ class InferenceEngine:
                 if eos >= 0:
                     budget = jnp.where(active & (nxt == eos), 0, budget)
                 return (
-                    (nxt, pos, budget, cache, counts, key), (nxt, lp, av, ai)
+                    (nxt, pos, budget, cache, counts, skeys),
+                    (nxt, lp, av, ai),
                 )
 
             (
-                (lt, pos, budget, cache, counts, key),
+                (lt, pos, budget, cache, counts, skeys),
                 (toks, lps, avs, ais),
             ) = jax.lax.scan(
-                body, (lt, pos, budget, cache, counts, key), None, length=T
+                body, (lt, pos, budget, cache, counts, skeys), None, length=T
             )
             return (
-                toks, lps, avs, ais, lt, pos, budget, cache, counts,
-                jax.random.key_data(key),
+                toks, lps, avs, ais, lt, pos, budget, cache, counts, skeys,
             )
 
         # donate scheduler state + cache + counts + key data
@@ -471,16 +486,14 @@ class InferenceEngine:
             "counts": jax.device_put(self._token_counts),
             "pres": jax.device_put(self._pres),
             "freq": jax.device_put(self._freqs),
+            "skeys": jax.device_put(self._slot_keys),
         }
-        if isinstance(self._raw_key, np.ndarray):
-            self._raw_key = jax.device_put(self._raw_key)
         self._dirty = False
 
     def drop_device_sched_state(self) -> None:
-        """Forget device scheduler arrays (sleep path). Host mirrors remain
-        the source of truth; the next chunk re-uploads them."""
-        if self._raw_key is not None and not isinstance(self._raw_key, np.ndarray):
-            self._raw_key = np.asarray(self._raw_key)
+        """Forget device scheduler arrays (sleep path). Host mirrors —
+        including the per-slot RNG keys, re-synced after every chunk —
+        remain the source of truth; the next chunk re-uploads them."""
         self._dev = None
         self._dirty = True
 
@@ -510,9 +523,14 @@ class InferenceEngine:
         on_token: Optional[Callable[[Request, int], None]] = None,
         want_top_logprobs: bool = False,
         want_prompt_logprobs: bool = False,
+        seed: Optional[int] = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
+        if seed is not None and not (-(2**63) <= int(seed) < 2**63):
+            # would overflow jax.random.key at admission, inside the
+            # engine loop where it can't be attributed to this request
+            raise ValueError("seed must fit in a signed 64-bit integer")
         if self.lockstep is not None and (presence_penalty or frequency_penalty):
             # penalties need the token-count state, which is too large for
             # the lockstep frame; followers run with zero penalties only
@@ -542,10 +560,20 @@ class InferenceEngine:
             on_token=on_token,
             want_top_logprobs=want_top_logprobs,
             want_prompt_logprobs=want_prompt_logprobs,
+            seed=seed,
         )
         self._next_seq_id += 1
         self._waiting.append(req)
         return req.seq_id
+
+    def _init_slot_key(self, req: Request) -> None:
+        if req.seed is not None:
+            k = jax.random.key(int(req.seed))
+        else:
+            k = jax.random.fold_in(
+                jax.random.key(self._seed + 1), req.seq_id
+            )
+        self._slot_keys[req.slot] = np.asarray(jax.random.key_data(k))
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -600,6 +628,7 @@ class InferenceEngine:
             self.prefix_cache.commit(hashes)
         req.slot = slot
         self._slots[slot] = req
+        self._init_slot_key(req)
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
         row[: len(req.pages)] = req.pages
         self._page_table[slot] = row
@@ -677,10 +706,10 @@ class InferenceEngine:
             counts_row,
             pres,
             freq,
-            self._raw_key,
+            self._slot_keys[req.slot],
         )
         if final:
-            self._raw_key = new_key
+            self._slot_keys[req.slot] = np.asarray(new_key)
         self.pool.replace(cache)
         return tok, lp, av, ai, plp
 
@@ -709,7 +738,7 @@ class InferenceEngine:
                 if req.want_prompt_logprobs
                 else self._prefill_fn
             )
-            tok, lp, av, ai, plp, cache, self._raw_key = fn(
+            tok, lp, av, ai, plp, cache, new_key = fn(
                 self.params,
                 tokens,
                 seq_lens,
@@ -720,8 +749,9 @@ class InferenceEngine:
                 counts_row,
                 pres,
                 freq,
-                self._raw_key,
+                self._slot_keys[req.slot],
             )
+            self._slot_keys[req.slot] = np.asarray(new_key)
             self.pool.replace(cache)
             if req.want_prompt_logprobs:
                 row = np.asarray(plp)[0]
@@ -857,6 +887,7 @@ class InferenceEngine:
         self._freqs[req.slot] = 0.0
         self._token_counts[req.slot] = 0
         self._budgets[req.slot] = 0
+        self._slot_keys[req.slot] = 0
         req.slot = -1
         self._dirty = True
 
@@ -1044,7 +1075,7 @@ class InferenceEngine:
             d = self._dev
             (
                 toks_dev, lps_dev, avs_dev, ais_dev, lt, pos, budget, cache,
-                counts_dev, self._raw_key,
+                counts_dev, skeys_dev,
             ) = self._chunk_fn(T)(
                 self.params,
                 d["lt"],
@@ -1057,18 +1088,22 @@ class InferenceEngine:
                 d["counts"],
                 d["pres"],
                 d["freq"],
-                self._raw_key,
+                d["skeys"],
             )
             self.pool.replace(cache)
             self._dev = {
                 "lt": lt, "pos": pos, "budget": budget,
                 "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
                 "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
+                "skeys": skeys_dev,
             }
-            # ONE host sync per chunk (batched device_get)
-            toks, lps, avs, ais = jax.device_get(
-                (toks_dev, lps_dev, avs_dev, ais_dev)
+            # ONE host sync per chunk (batched device_get). The key
+            # mirror rides along: a dirty re-upload must not rewind any
+            # slot's key stream to a pre-chunk state.
+            toks, lps, avs, ais, skeys_host = jax.device_get(
+                (toks_dev, lps_dev, avs_dev, ais_dev, skeys_dev)
             )
+            self._slot_keys[:] = skeys_host
             for t in range(T):
                 for slot, req in list(running.items()):
                     tok = int(toks[t, slot])

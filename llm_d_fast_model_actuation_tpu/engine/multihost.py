@@ -79,6 +79,8 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         "pres": np.zeros((b,), np.float32),
         "freqs": np.zeros((b,), np.float32),
         "page_table": np.zeros((b, p), np.int32),
+        #: per-slot RNG key data (per-request seed streams)
+        "skeys": np.zeros((b, 2), np.uint32),
     }
 
 
@@ -107,6 +109,7 @@ class LockstepLeader:
         f["pres"] = e._pres.copy()
         f["freqs"] = e._freqs.copy()
         f["page_table"] = e._page_table.copy()
+        f["skeys"] = e._slot_keys.copy()
 
     def _send(self, **fields: Any) -> None:
         f = dict(self._template)
@@ -206,6 +209,7 @@ def _sync_mirrors(engine: Any, f: Dict[str, np.ndarray]) -> None:
     engine._pres[:] = f["pres"]
     engine._freqs[:] = f["freqs"]
     engine._page_table[:] = f["page_table"]
+    engine._slot_keys[:] = f["skeys"]
 
 
 def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
@@ -226,7 +230,7 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
         if int(f.get("want_plp", 0))
         else engine._prefill_fn
     )
-    _tok, _lp, _av, _ai, _plp, cache, engine._raw_key = fn(
+    _tok, _lp, _av, _ai, _plp, cache, new_key = fn(
         engine.params,
         tokens,
         seq_lens,
@@ -237,8 +241,9 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
         counts_row,
         zero,
         zero,
-        engine._raw_key,
+        engine._slot_keys[slot],
     )
+    engine._slot_keys[slot] = np.asarray(new_key)
     engine.pool.replace(cache)
     # no host sync: the leader alone consumes tokens
 
@@ -277,10 +282,10 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
         counts_row,
         zero,
         zero,
-        engine._raw_key,
+        engine._slot_keys[slot],
     )
     if int(f["advance_key"]):
-        engine._raw_key = new_key
+        engine._slot_keys[slot] = np.asarray(new_key)
     engine.pool.replace(cache)
 
 
@@ -292,7 +297,7 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
     d = engine._dev
     (
         _toks, _lps, _avs, _ais, lt, pos, budget, cache, counts_dev,
-        engine._raw_key,
+        skeys_dev,
     ) = engine._chunk_fn(T)(
         engine.params,
         d["lt"],
@@ -305,11 +310,12 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         d["counts"],
         d["pres"],
         d["freq"],
-        engine._raw_key,
+        d["skeys"],
     )
     engine.pool.replace(cache)
     engine._dev = {
         "lt": lt, "pos": pos, "budget": budget,
         "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
         "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
+        "skeys": skeys_dev,
     }

@@ -20,7 +20,7 @@ TOP_P_CANDIDATES = 64
 
 def sample(
     logits: jnp.ndarray,  # [b, vocab] fp32
-    key: jax.Array,
+    key: jax.Array,  # scalar key, or [b] per-row keys (per-request seeds)
     temperature: jnp.ndarray,  # [b] fp32; 0 = greedy
     top_p: "jnp.ndarray | None" = None,  # [b] fp32; >= 1 = full distribution
     top_k: int = 0,  # static; 0 = no truncation
@@ -55,8 +55,17 @@ def sample(
     norm = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
     greedy = jnp.argmax(logits, axis=-1)
     t = jnp.maximum(temperature, 1e-6)[:, None]
-    key_full, key_nuc = jax.random.split(key)
-    sampled = jax.random.categorical(key_full, logits / t, axis=-1)
+    per_row = getattr(key, "ndim", 0) == 1  # [b] per-request keys
+    if per_row:
+        key_full, key_nuc = jax.vmap(
+            lambda k: tuple(jax.random.split(k))
+        )(key)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(key_full, logits / t)
+    else:
+        key_full, key_nuc = jax.random.split(key)
+        sampled = jax.random.categorical(key_full, logits / t, axis=-1)
     if top_p is not None:
         c = min(TOP_P_CANDIDATES, logits.shape[-1])
         vals, idx = jax.lax.top_k(logits, c)  # [b, c] descending
@@ -67,7 +76,12 @@ def sample(
         # keep tokens whose PRECEDING mass is < p (the first is always kept)
         keep = (csum - probs) < top_p[:, None]
         masked = jnp.where(keep, vals, -jnp.inf)
-        choice = jax.random.categorical(key_nuc, masked / t, axis=-1)
+        if per_row:
+            choice = jax.vmap(
+                lambda k, row: jax.random.categorical(k, row)
+            )(key_nuc, masked / t)
+        else:
+            choice = jax.random.categorical(key_nuc, masked / t, axis=-1)
         nucleus = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
         sampled = jnp.where(top_p < 1.0, nucleus, sampled)
     tok = jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -497,7 +497,7 @@ class EngineService:
                             (
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
-                                want_alts, want_plp,
+                                want_alts, want_plp, seed,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -508,6 +508,7 @@ class EngineService:
                                     on_token=on_token,
                                     want_top_logprobs=want_alts,
                                     want_prompt_logprobs=want_plp,
+                                    seed=seed,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -592,6 +593,7 @@ class EngineService:
         frequency_penalty: float = 0.0,
         want_top_logprobs: bool = False,
         want_prompt_logprobs: bool = False,
+        seed: "int | None" = None,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -611,7 +613,7 @@ class EngineService:
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
              presence_penalty, frequency_penalty, want_top_logprobs,
-             want_prompt_logprobs)
+             want_prompt_logprobs, seed)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -905,6 +907,14 @@ def build_app(service: EngineService) -> web.Application:
             raise ValueError(f"invalid generation parameter: {e}")
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        sv = body.get("seed")
+        if sv is not None and (isinstance(sv, bool) or not isinstance(sv, int)):
+            raise ValueError(f"seed must be an integer, got {sv!r}")
+        if sv is not None and not (-(2**63) <= sv < 2**63):
+            # out-of-int64 seeds would overflow jax.random.key at
+            # admission — inside the engine thread, not this request
+            raise ValueError("seed must fit in a signed 64-bit integer")
+        seed = None if sv is None else int(sv)
         if not (0.0 < top_p <= 1.0):
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         try:
@@ -939,7 +949,7 @@ def build_app(service: EngineService) -> web.Application:
             )
         return (
             tokens, max_tokens, temperature, top_p, stop_seqs, stop_texts,
-            presence, frequency,
+            presence, frequency, seed,
         )
 
     async def _stream_sse(
@@ -953,6 +963,7 @@ def build_app(service: EngineService) -> web.Application:
         presence: float,
         frequency: float,
         make_chunk,
+        seed=None,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
         token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
@@ -976,6 +987,7 @@ def build_app(service: EngineService) -> web.Application:
             tokens, max_tokens, temperature, on_token=on_token,
             top_p=top_p, stop_seqs=stop_seqs,
             presence_penalty=presence, frequency_penalty=frequency,
+            seed=seed,
         )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
@@ -1131,7 +1143,7 @@ def build_app(service: EngineService) -> web.Application:
     async def _gather_n(
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
         presence, frequency, stop_texts=(), want_alts=False,
-        want_prompt_logprobs=False,
+        want_prompt_logprobs=False, seed=None,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -1149,6 +1161,9 @@ def build_app(service: EngineService) -> web.Application:
                 # first bypasses the prefix cache and pays the forward;
                 # the response copies them onto the other choices
                 want_prompt_logprobs=want_prompt_logprobs and i == 0,
+                # OpenAI n + seed: distinct samples per choice, but the
+                # SET of choices is reproducible
+                seed=None if seed is None else seed + i,
             )
             for i in range(n)
         ]
@@ -1168,7 +1183,7 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency,
+                stop_texts, presence, frequency, seed,
             ) = _parse_generation(body, _encode_prompt(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1200,13 +1215,14 @@ def build_app(service: EngineService) -> web.Application:
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency, chunk,
+                stop_texts, presence, frequency, chunk, seed=seed,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=logprobs_n > 0,
             want_prompt_logprobs=echo and bool(body.get("logprobs")),
+            seed=seed,
         )
         req = reqs[0]
         ttft = (
@@ -1274,7 +1290,7 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency,
+                stop_texts, presence, frequency, seed,
             ) = _parse_generation(body, _chat_tokens(body.get("messages")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1305,12 +1321,12 @@ def build_app(service: EngineService) -> web.Application:
 
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency, chunk,
+                stop_texts, presence, frequency, chunk, seed=seed,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
-            presence, frequency, stop_texts, want_alts=top_n > 0,
+            presence, frequency, stop_texts, want_alts=top_n > 0, seed=seed,
         )
         from .tokenizer import truncate_at_text_stop
 
