@@ -928,6 +928,29 @@ def build_app(service: EngineService) -> web.Application:
             if not (-2.0 <= v <= 2.0):
                 raise ValueError(f"{name} must be in [-2, 2], got {v}")
         stop_seqs, stop_texts = _parse_stop(body.get("stop"))
+        sti = body.get("stop_token_ids")
+        if sti is not None:
+            # vLLM's parameter name; matching is engine-level single-id
+            # stops with OUR strip semantics (the matched token is removed
+            # from the output, like every other stop here — vLLM keeps
+            # non-special ids in the completion; docs/engine.md says so)
+            if not isinstance(sti, list):
+                raise ValueError("stop_token_ids must be a list of ints")
+            extra = []
+            for t in sti:
+                if isinstance(t, bool) or not isinstance(t, int):
+                    raise ValueError(
+                        f"stop_token_ids entries must be ints, got {t!r}"
+                    )
+                if not (0 <= t < vocab):
+                    # an id the model cannot emit: wrapping it onto an
+                    # unrelated real token would truncate generations
+                    # at random; reject instead
+                    raise ValueError(
+                        f"stop_token_ids entry {t} outside vocab [0, {vocab})"
+                    )
+                extra.append((t,))
+            stop_seqs = stop_seqs + tuple(extra)
         # pre-validate everything add_request would reject, so streaming
         # requests fail with a 400 instead of an SSE error after headers
         # are out

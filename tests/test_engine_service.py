@@ -707,3 +707,28 @@ def test_seed_parameter_over_http(service):
         assert a == b
 
     run_async(_client(service, scenario))
+
+
+def test_stop_token_ids_param(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 6}
+        )
+        toks = (await r.json())["choices"][0]["token_ids"]
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 6,
+                  "stop_token_ids": [toks[0]]},
+        )
+        body = await r.json()
+        assert body["choices"][0]["token_ids"] == []
+        assert body["choices"][0]["finish_reason"] == "stop"
+        for bad in ("nope", [99999], [-1], [True], [1.5]):
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 2,
+                      "stop_token_ids": bad},
+            )
+            assert r.status == 400, bad
+
+    run_async(_client(service, scenario))
