@@ -120,6 +120,9 @@ class Request:
     #: prompt, sampling knobs) — not on batch composition or arrival
     #: order. None = a stream derived from the engine seed and seq_id.
     seed: Optional[int] = None
+    #: vLLM `ignore_eos`: decode the full token budget even when the
+    #: model emits eos (benchmark harnesses need length-controlled runs)
+    ignore_eos: bool = False
     #: OpenAI `echo` + `logprobs`: logprob of every PROMPT token under the
     #: model (first entry None — nothing precedes it). Requesting this
     #: bypasses the prefix cache: cached pages skip exactly the forward
@@ -256,6 +259,9 @@ class InferenceEngine:
         #: host-exact mirror of the device copy the chunk program maintains
         self._token_counts = np.zeros((b, cfg.model.vocab_size), dtype=np.int32)
         self._budgets = np.zeros((b,), dtype=np.int32)
+        #: per-slot eos sensitivity (0 = ignore_eos request): the chunk
+        #: program zeroes a slot's budget at eos only when enabled
+        self._eos_on = np.ones((b,), dtype=np.int32)
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
         self._next_seq_id = 1
@@ -412,7 +418,7 @@ class InferenceEngine:
 
         def chunk(
             params, lt, pos, budget, cache, page_table, temps, topps,
-            counts, pres, freq, skeys,
+            counts, pres, freq, skeys, eos_on,
         ):
             def body(carry, _):
                 lt, pos, budget, cache, counts, skeys = carry
@@ -447,7 +453,9 @@ class InferenceEngine:
                 pos = pos + a32
                 budget = budget - a32
                 if eos >= 0:
-                    budget = jnp.where(active & (nxt == eos), 0, budget)
+                    budget = jnp.where(
+                        active & (nxt == eos) & (eos_on > 0), 0, budget
+                    )
                 return (
                     (nxt, pos, budget, cache, counts, skeys),
                     (nxt, lp, av, ai),
@@ -487,6 +495,7 @@ class InferenceEngine:
             "pres": jax.device_put(self._pres),
             "freq": jax.device_put(self._freqs),
             "skeys": jax.device_put(self._slot_keys),
+            "eos_on": jax.device_put(self._eos_on),
         }
         self._dirty = False
 
@@ -524,6 +533,7 @@ class InferenceEngine:
         want_top_logprobs: bool = False,
         want_prompt_logprobs: bool = False,
         seed: Optional[int] = None,
+        ignore_eos: bool = False,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -561,6 +571,7 @@ class InferenceEngine:
             want_top_logprobs=want_top_logprobs,
             want_prompt_logprobs=want_prompt_logprobs,
             seed=seed,
+            ignore_eos=ignore_eos,
         )
         self._next_seq_id += 1
         self._waiting.append(req)
@@ -629,6 +640,7 @@ class InferenceEngine:
         req.slot = slot
         self._slots[slot] = req
         self._init_slot_key(req)
+        self._eos_on[slot] = 0 if req.ignore_eos else 1
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
         row[: len(req.pages)] = req.pages
         self._page_table[slot] = row
@@ -832,11 +844,11 @@ class InferenceEngine:
                 req.finish_reason = "stop"
                 break
         if not req.done:
-            if (
-                req.stop_requested
-                or token == self.cfg.eos_token_id
+            eos_hit = (
+                token == self.cfg.eos_token_id
                 or token in self.cfg.extra_eos_ids
-            ):
+            ) and not req.ignore_eos
+            if req.stop_requested or eos_hit:
                 req.done = True
                 req.finish_reason = "stop"
             elif len(req.out_tokens) >= req.max_new_tokens:
@@ -888,6 +900,7 @@ class InferenceEngine:
         self._token_counts[req.slot] = 0
         self._budgets[req.slot] = 0
         self._slot_keys[req.slot] = 0
+        self._eos_on[req.slot] = 1
         req.slot = -1
         self._dirty = True
 
@@ -1089,13 +1102,14 @@ class InferenceEngine:
                 d["pres"],
                 d["freq"],
                 d["skeys"],
+                d["eos_on"],
             )
             self.pool.replace(cache)
             self._dev = {
                 "lt": lt, "pos": pos, "budget": budget,
                 "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
                 "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
-                "skeys": skeys_dev,
+                "skeys": skeys_dev, "eos_on": d["eos_on"],
             }
             # ONE host sync per chunk (batched device_get). The key
             # mirror rides along: a dirty re-upload must not rewind any

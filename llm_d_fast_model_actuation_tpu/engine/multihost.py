@@ -81,6 +81,8 @@ def _frame_template(cfg) -> Dict[str, np.ndarray]:
         "page_table": np.zeros((b, p), np.int32),
         #: per-slot RNG key data (per-request seed streams)
         "skeys": np.zeros((b, 2), np.uint32),
+        #: per-slot eos sensitivity (ignore_eos requests = 0)
+        "eos_on": np.ones((b,), np.int32),
     }
 
 
@@ -110,6 +112,7 @@ class LockstepLeader:
         f["freqs"] = e._freqs.copy()
         f["page_table"] = e._page_table.copy()
         f["skeys"] = e._slot_keys.copy()
+        f["eos_on"] = e._eos_on.copy()
 
     def _send(self, **fields: Any) -> None:
         f = dict(self._template)
@@ -210,6 +213,7 @@ def _sync_mirrors(engine: Any, f: Dict[str, np.ndarray]) -> None:
     engine._freqs[:] = f["freqs"]
     engine._page_table[:] = f["page_table"]
     engine._slot_keys[:] = f["skeys"]
+    engine._eos_on[:] = f["eos_on"]
 
 
 def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
@@ -311,11 +315,12 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         d["pres"],
         d["freq"],
         d["skeys"],
+        d["eos_on"],
     )
     engine.pool.replace(cache)
     engine._dev = {
         "lt": lt, "pos": pos, "budget": budget,
         "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
         "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
-        "skeys": skeys_dev,
+        "skeys": skeys_dev, "eos_on": d["eos_on"],
     }

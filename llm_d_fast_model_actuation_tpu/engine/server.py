@@ -497,7 +497,7 @@ class EngineService:
                             (
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
-                                want_alts, want_plp, seed,
+                                want_alts, want_plp, seed, ignore_eos,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -509,6 +509,7 @@ class EngineService:
                                     want_top_logprobs=want_alts,
                                     want_prompt_logprobs=want_plp,
                                     seed=seed,
+                                    ignore_eos=ignore_eos,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -594,6 +595,7 @@ class EngineService:
         want_top_logprobs: bool = False,
         want_prompt_logprobs: bool = False,
         seed: "int | None" = None,
+        ignore_eos: bool = False,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -613,7 +615,7 @@ class EngineService:
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
              presence_penalty, frequency_penalty, want_top_logprobs,
-             want_prompt_logprobs, seed)
+             want_prompt_logprobs, seed, ignore_eos)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -907,6 +909,10 @@ def build_app(service: EngineService) -> web.Application:
             raise ValueError(f"invalid generation parameter: {e}")
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        iev = body.get("ignore_eos")
+        if iev is not None and not isinstance(iev, bool):
+            raise ValueError(f"ignore_eos must be a bool, got {iev!r}")
+        ignore_eos = bool(iev)
         sv = body.get("seed")
         if sv is not None and (isinstance(sv, bool) or not isinstance(sv, int)):
             raise ValueError(f"seed must be an integer, got {sv!r}")
@@ -972,7 +978,7 @@ def build_app(service: EngineService) -> web.Application:
             )
         return (
             tokens, max_tokens, temperature, top_p, stop_seqs, stop_texts,
-            presence, frequency, seed,
+            presence, frequency, seed, ignore_eos,
         )
 
     async def _stream_sse(
@@ -987,6 +993,7 @@ def build_app(service: EngineService) -> web.Application:
         frequency: float,
         make_chunk,
         seed=None,
+        ignore_eos=False,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
         token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
@@ -1010,7 +1017,7 @@ def build_app(service: EngineService) -> web.Application:
             tokens, max_tokens, temperature, on_token=on_token,
             top_p=top_p, stop_seqs=stop_seqs,
             presence_penalty=presence, frequency_penalty=frequency,
-            seed=seed,
+            seed=seed, ignore_eos=ignore_eos,
         )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
@@ -1166,7 +1173,7 @@ def build_app(service: EngineService) -> web.Application:
     async def _gather_n(
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
         presence, frequency, stop_texts=(), want_alts=False,
-        want_prompt_logprobs=False, seed=None,
+        want_prompt_logprobs=False, seed=None, ignore_eos=False,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -1187,6 +1194,7 @@ def build_app(service: EngineService) -> web.Application:
                 # OpenAI n + seed: distinct samples per choice, but the
                 # SET of choices is reproducible
                 seed=None if seed is None else seed + i,
+                ignore_eos=ignore_eos,
             )
             for i in range(n)
         ]
@@ -1206,7 +1214,7 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency, seed,
+                stop_texts, presence, frequency, seed, ignore_eos,
             ) = _parse_generation(body, _encode_prompt(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1239,13 +1247,14 @@ def build_app(service: EngineService) -> web.Application:
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
+                ignore_eos=ignore_eos,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=logprobs_n > 0,
             want_prompt_logprobs=echo and bool(body.get("logprobs")),
-            seed=seed,
+            seed=seed, ignore_eos=ignore_eos,
         )
         req = reqs[0]
         ttft = (
@@ -1313,7 +1322,7 @@ def build_app(service: EngineService) -> web.Application:
         try:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
-                stop_texts, presence, frequency, seed,
+                stop_texts, presence, frequency, seed, ignore_eos,
             ) = _parse_generation(body, _chat_tokens(body.get("messages")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1345,11 +1354,13 @@ def build_app(service: EngineService) -> web.Application:
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
+                ignore_eos=ignore_eos,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=top_n > 0, seed=seed,
+            ignore_eos=ignore_eos,
         )
         from .tokenizer import truncate_at_text_stop
 

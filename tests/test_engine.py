@@ -371,3 +371,39 @@ def test_unseeded_requests_still_vary_and_greedy_unaffected():
     eng.add_request([5, 6, 7], max_new_tokens=5, temperature=0.0, seed=2)
     a, b = _drain(eng)
     assert a.out_tokens == b.out_tokens
+
+
+def test_ignore_eos_decodes_full_budget():
+    """vLLM `ignore_eos`: the request decodes its whole budget even when
+    the model emits eos — both the host finish check AND the device-side
+    budget zeroing must stand down for that slot."""
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(), max_batch=2, page_size=8,
+        num_pages=64, max_seq_len=64, eos_token_id=-1,
+    )
+    params = llama.init_params(jax.random.key(0), cfg.model)
+    # find the greedy stream, then make its SECOND token the eos id so a
+    # normal request stops early and an ignore_eos one continues
+    eng = InferenceEngine(cfg, params=params, seed=0)
+    eng.add_request([5, 6, 7], max_new_tokens=8)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    stream = done[0].out_tokens
+    eos = stream[1]
+
+    import dataclasses
+    cfg2 = dataclasses.replace(cfg, eos_token_id=eos)
+    eng = InferenceEngine(cfg2, params=params, seed=0)
+    eng.add_request([5, 6, 7], max_new_tokens=8)
+    eng.add_request([5, 6, 7], max_new_tokens=8, ignore_eos=True)
+    done = []
+    while eng.has_work():
+        done.extend(eng.step())
+    normal = next(r for r in done if not r.ignore_eos)
+    ignored = next(r for r in done if r.ignore_eos)
+    assert normal.finish_reason == "stop"
+    assert len(normal.out_tokens) < 8
+    assert len(ignored.out_tokens) == 8
+    assert ignored.finish_reason == "length"
+    assert eos in ignored.out_tokens  # the eos token itself is kept

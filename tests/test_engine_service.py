@@ -732,3 +732,61 @@ def test_stop_token_ids_param(service):
             assert r.status == 400, bad
 
     run_async(_client(service, scenario))
+
+
+def test_ignore_eos_over_http():
+    """Two services: one learns the greedy stream, the second is BUILT
+    with that stream's second token as eos (set before first compile, so
+    the device-side eos budget-zeroing is genuinely in the programs)."""
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    base = (
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64"
+    )
+    svc = EngineService(parse_engine_options(base))
+    try:
+        async def learn(client):
+            r = await client.post(
+                "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 6}
+            )
+            return (await r.json())["choices"][0]["token_ids"]
+
+        toks = run_async(_client(svc, learn))
+    finally:
+        svc.shutdown()
+
+    svc = EngineService(
+        parse_engine_options(base + f" --eos-token-id {toks[1]}")
+    )
+    try:
+        async def scenario(client):
+            r = await client.post(
+                "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 6}
+            )
+            short = (await r.json())["choices"][0]
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 6,
+                      "ignore_eos": True},
+            )
+            full = (await r.json())["choices"][0]
+            assert len(short["token_ids"]) < 6
+            assert short["finish_reason"] == "stop"
+            assert len(full["token_ids"]) == 6
+            assert full["finish_reason"] == "length"
+
+            # junk values are 400s, not silently truthy
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 2,
+                      "ignore_eos": "false"},
+            )
+            assert r.status == 400
+
+        run_async(_client(svc, scenario))
+    finally:
+        svc.shutdown()
