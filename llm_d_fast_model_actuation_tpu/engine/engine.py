@@ -120,6 +120,10 @@ class Request:
     #: prompt, sampling knobs) — not on batch composition or arrival
     #: order. None = a stream derived from the engine seed and seq_id.
     seed: Optional[int] = None
+    #: OpenAI `logit_bias`: token_id -> additive logit bias in [-100,
+    #: 100]; applied before temperature/top-p, shifts greedy too. Empty
+    #: = off.
+    logit_bias: Dict[int, float] = field(default_factory=dict)
     #: vLLM `ignore_eos`: decode the full token budget even when the
     #: model emits eos (benchmark harnesses need length-controlled runs)
     ignore_eos: bool = False
@@ -172,6 +176,29 @@ def _alts_row(av, ai, row: int) -> list:
     return [
         (int(ai[row, j]), float(av[row, j])) for j in range(av.shape[1])
     ]
+
+
+def validate_logit_bias(lb, vocab_size: int) -> "Dict[int, float] | None":
+    """OpenAI logit_bias validation, shared by the HTTP layer (-> 400)
+    and add_request (-> per-request error): token ids must be in-vocab,
+    values in [-100, 100]. Returns a normalized {int: float} dict."""
+    if lb is None:
+        return None
+    if not isinstance(lb, dict):
+        raise ValueError("logit_bias must be an object")
+    out: Dict[int, float] = {}
+    for k, v in lb.items():
+        try:
+            t = int(k)
+            fv = float(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"invalid logit_bias entry {k!r}: {v!r}")
+        if not (0 <= t < vocab_size):
+            raise ValueError(f"logit_bias token {t} outside vocab")
+        if not (-100.0 <= fv <= 100.0):
+            raise ValueError(f"logit_bias value {fv} outside [-100, 100]")
+        out[t] = fv
+    return out
 
 
 def _stop_holdback(out: List[int], stop_seqs) -> int:
@@ -262,6 +289,9 @@ class InferenceEngine:
         #: per-slot eos sensitivity (0 = ignore_eos request): the chunk
         #: program zeroes a slot's budget at eos only when enabled
         self._eos_on = np.ones((b,), dtype=np.int32)
+        #: per-slot additive logit bias [b, vocab] (OpenAI logit_bias);
+        #: zero rows for requests without one
+        self._bias = np.zeros((b, cfg.model.vocab_size), dtype=np.float32)
         self._slots: List[Optional[Request]] = [None] * b
         self._waiting: List[Request] = []
         self._next_seq_id = 1
@@ -288,7 +318,9 @@ class InferenceEngine:
 
         alt_k = cfg.logprobs_topk
 
-        def _sample_last(logits, lens, temp, topp, counts, pres, freq, skey):
+        def _sample_last(
+            logits, lens, temp, topp, counts, pres, freq, skey, bias
+        ):
             """Shared sampling tail of both prefill programs: take the last
             valid logit, split the request's OWN key, sample — one
             definition so the cache-hit path can never diverge from the
@@ -301,7 +333,7 @@ class InferenceEngine:
             out = sample(
                 last, sub, temp, top_p=topp,
                 counts=counts, presence_penalty=pres, frequency_penalty=freq,
-                alt_k=alt_k,
+                alt_k=alt_k, bias=bias,
             )
             tok, lp = out[0], out[1]
             alts = out[2:] if alt_k > 0 else (
@@ -328,13 +360,14 @@ class InferenceEngine:
 
             def _prefill(
                 params, tokens, seq_lens, cache, page_table, temp, topp,
-                counts, pres, freq, skey,
+                counts, pres, freq, skey, bias,
             ):
                 logits, cache = llama.prefill(
                     params, model_cfg, tokens, seq_lens, cache, page_table
                 )
                 tok, lp, av, ai, skey = _sample_last(
-                    logits, seq_lens, temp, topp, counts, pres, freq, skey
+                    logits, seq_lens, temp, topp, counts, pres, freq, skey,
+                    bias,
                 )
                 if with_plp:
                     # position i predicts token i+1: shift the prompt left
@@ -353,7 +386,7 @@ class InferenceEngine:
         def _make_suffix_prefill(with_plp: bool):
             def _suffix_prefill(
                 params, tokens, targets, start, suffix_lens, cache,
-                page_table, temp, topp, counts, pres, freq, skey,
+                page_table, temp, topp, counts, pres, freq, skey, bias,
             ):
                 logits, cache = llama.prefill_continue(
                     params, model_cfg, tokens, start, suffix_lens, cache,
@@ -361,7 +394,7 @@ class InferenceEngine:
                 )
                 tok, lp, av, ai, skey = _sample_last(
                     logits, suffix_lens, temp, topp, counts, pres, freq,
-                    skey,
+                    skey, bias,
                 )
                 if with_plp:
                     # a segment cannot derive its last target (the NEXT
@@ -418,7 +451,7 @@ class InferenceEngine:
 
         def chunk(
             params, lt, pos, budget, cache, page_table, temps, topps,
-            counts, pres, freq, skeys, eos_on,
+            counts, pres, freq, skeys, eos_on, bias,
         ):
             def body(carry, _):
                 lt, pos, budget, cache, counts, skeys = carry
@@ -438,7 +471,7 @@ class InferenceEngine:
                     logits, subs, temps, top_p=topps,
                     counts=counts, presence_penalty=pres,
                     frequency_penalty=freq,
-                    alt_k=self.cfg.logprobs_topk,
+                    alt_k=self.cfg.logprobs_topk, bias=bias,
                 )
                 nxt, lp = out[0], out[1]
                 if self.cfg.logprobs_topk > 0:
@@ -496,6 +529,7 @@ class InferenceEngine:
             "freq": jax.device_put(self._freqs),
             "skeys": jax.device_put(self._slot_keys),
             "eos_on": jax.device_put(self._eos_on),
+            "bias": jax.device_put(self._bias),
         }
         self._dirty = False
 
@@ -534,6 +568,7 @@ class InferenceEngine:
         want_prompt_logprobs: bool = False,
         seed: Optional[int] = None,
         ignore_eos: bool = False,
+        logit_bias: "Dict[int, float] | None" = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -541,6 +576,15 @@ class InferenceEngine:
             # would overflow jax.random.key at admission, inside the
             # engine loop where it can't be attributed to this request
             raise ValueError("seed must fit in a signed 64-bit integer")
+        if self.lockstep is not None and logit_bias:
+            # like penalties: the [vocab] bias row is too large for the
+            # lockstep frame; followers would sample unbiased
+            raise ValueError(
+                "logit_bias is not supported for multi-host gangs"
+            )
+        logit_bias = validate_logit_bias(
+            logit_bias, self.cfg.model.vocab_size
+        )
         if self.lockstep is not None and (presence_penalty or frequency_penalty):
             # penalties need the token-count state, which is too large for
             # the lockstep frame; followers run with zero penalties only
@@ -572,6 +616,7 @@ class InferenceEngine:
             want_prompt_logprobs=want_prompt_logprobs,
             seed=seed,
             ignore_eos=ignore_eos,
+            logit_bias=logit_bias or {},
         )
         self._next_seq_id += 1
         self._waiting.append(req)
@@ -641,6 +686,9 @@ class InferenceEngine:
         self._slots[slot] = req
         self._init_slot_key(req)
         self._eos_on[slot] = 0 if req.ignore_eos else 1
+        self._bias[slot] = 0.0
+        for t, v in req.logit_bias.items():
+            self._bias[slot, t] = v
         row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
         row[: len(req.pages)] = req.pages
         self._page_table[slot] = row
@@ -719,6 +767,7 @@ class InferenceEngine:
             pres,
             freq,
             self._slot_keys[req.slot],
+            self._bias[req.slot : req.slot + 1],
         )
         if final:
             self._slot_keys[req.slot] = np.asarray(new_key)
@@ -762,6 +811,7 @@ class InferenceEngine:
                 pres,
                 freq,
                 self._slot_keys[req.slot],
+                self._bias[req.slot : req.slot + 1],
             )
             self._slot_keys[req.slot] = np.asarray(new_key)
             self.pool.replace(cache)
@@ -901,6 +951,7 @@ class InferenceEngine:
         self._budgets[req.slot] = 0
         self._slot_keys[req.slot] = 0
         self._eos_on[req.slot] = 1
+        self._bias[req.slot] = 0.0
         req.slot = -1
         self._dirty = True
 
@@ -927,6 +978,7 @@ class InferenceEngine:
             r.temperature != 0.0
             or r.presence_penalty != 0.0
             or r.frequency_penalty != 0.0
+            or r.logit_bias
         ):
             return None
         return r
@@ -1103,13 +1155,14 @@ class InferenceEngine:
                 d["freq"],
                 d["skeys"],
                 d["eos_on"],
+                d["bias"],
             )
             self.pool.replace(cache)
             self._dev = {
                 "lt": lt, "pos": pos, "budget": budget,
                 "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
                 "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
-                "skeys": skeys_dev, "eos_on": d["eos_on"],
+                "skeys": skeys_dev, "eos_on": d["eos_on"], "bias": d["bias"],
             }
             # ONE host sync per chunk (batched device_get). The key
             # mirror rides along: a dirty re-upload must not rewind any

@@ -246,6 +246,9 @@ def _replay_prefill(engine: Any, f: Dict[str, np.ndarray]) -> None:
         zero,
         zero,
         engine._slot_keys[slot],
+        # biased requests are rejected for gangs; a zero row keeps the
+        # program signature
+        np.zeros((1, engine.cfg.model.vocab_size), np.float32),
     )
     engine._slot_keys[slot] = np.asarray(new_key)
     engine.pool.replace(cache)
@@ -287,6 +290,7 @@ def _replay_prefill_suffix(engine: Any, f: Dict[str, np.ndarray]) -> None:
         zero,
         zero,
         engine._slot_keys[slot],
+        np.zeros((1, engine.cfg.model.vocab_size), np.float32),
     )
     if int(f["advance_key"]):
         engine._slot_keys[slot] = np.asarray(new_key)
@@ -316,11 +320,12 @@ def _replay_chunk(engine: Any, f: Dict[str, np.ndarray]) -> None:
         d["freq"],
         d["skeys"],
         d["eos_on"],
+        d["bias"],
     )
     engine.pool.replace(cache)
     engine._dev = {
         "lt": lt, "pos": pos, "budget": budget,
         "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
         "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
-        "skeys": skeys_dev, "eos_on": d["eos_on"],
+        "skeys": skeys_dev, "eos_on": d["eos_on"], "bias": d["bias"],
     }

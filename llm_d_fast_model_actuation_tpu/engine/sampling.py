@@ -28,6 +28,7 @@ def sample(
     presence_penalty: "jnp.ndarray | None" = None,  # [b] fp32
     frequency_penalty: "jnp.ndarray | None" = None,  # [b] fp32
     alt_k: int = 0,  # static; also return the top-k alternative logprobs
+    bias: "jnp.ndarray | None" = None,  # [b, vocab] fp32 logit bias
 ):
     """Returns (token [b] int32, logprob [b] fp32 of the chosen token) —
     plus, when `alt_k > 0`, (alt_logprobs [b, alt_k] fp32,
@@ -41,6 +42,10 @@ def sample(
     top-k-truncated) logits BEFORE temperature scaling and top-p
     truncation — for temperature != 1 or top_p < 1 it is not the exact
     distribution the token was drawn from."""
+    if bias is not None:
+        # OpenAI logit_bias: added before everything else, so it shifts
+        # greedy decoding, the reported logprobs, and the alternatives
+        logits = logits + bias
     if counts is not None:
         cf = counts.astype(jnp.float32)
         pen = jnp.zeros_like(logits)

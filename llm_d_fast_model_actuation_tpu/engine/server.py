@@ -498,6 +498,7 @@ class EngineService:
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
                                 want_alts, want_plp, seed, ignore_eos,
+                                logit_bias,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -510,6 +511,7 @@ class EngineService:
                                     want_prompt_logprobs=want_plp,
                                     seed=seed,
                                     ignore_eos=ignore_eos,
+                                    logit_bias=logit_bias,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -596,6 +598,7 @@ class EngineService:
         want_prompt_logprobs: bool = False,
         seed: "int | None" = None,
         ignore_eos: bool = False,
+        logit_bias: "Dict[int, float] | None" = None,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
@@ -615,7 +618,7 @@ class EngineService:
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
              presence_penalty, frequency_penalty, want_top_logprobs,
-             want_prompt_logprobs, seed, ignore_eos)
+             want_prompt_logprobs, seed, ignore_eos, logit_bias)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -909,6 +912,9 @@ def build_app(service: EngineService) -> web.Application:
             raise ValueError(f"invalid generation parameter: {e}")
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        from .engine import validate_logit_bias
+
+        logit_bias = validate_logit_bias(body.get("logit_bias"), vocab)
         iev = body.get("ignore_eos")
         if iev is not None and not isinstance(iev, bool):
             raise ValueError(f"ignore_eos must be a bool, got {iev!r}")
@@ -978,7 +984,7 @@ def build_app(service: EngineService) -> web.Application:
             )
         return (
             tokens, max_tokens, temperature, top_p, stop_seqs, stop_texts,
-            presence, frequency, seed, ignore_eos,
+            presence, frequency, seed, ignore_eos, logit_bias,
         )
 
     async def _stream_sse(
@@ -994,6 +1000,7 @@ def build_app(service: EngineService) -> web.Application:
         make_chunk,
         seed=None,
         ignore_eos=False,
+        logit_bias=None,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
         token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
@@ -1017,7 +1024,7 @@ def build_app(service: EngineService) -> web.Application:
             tokens, max_tokens, temperature, on_token=on_token,
             top_p=top_p, stop_seqs=stop_seqs,
             presence_penalty=presence, frequency_penalty=frequency,
-            seed=seed, ignore_eos=ignore_eos,
+            seed=seed, ignore_eos=ignore_eos, logit_bias=logit_bias,
         )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
@@ -1174,6 +1181,7 @@ def build_app(service: EngineService) -> web.Application:
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
         presence, frequency, stop_texts=(), want_alts=False,
         want_prompt_logprobs=False, seed=None, ignore_eos=False,
+        logit_bias=None,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -1195,6 +1203,7 @@ def build_app(service: EngineService) -> web.Application:
                 # SET of choices is reproducible
                 seed=None if seed is None else seed + i,
                 ignore_eos=ignore_eos,
+                logit_bias=logit_bias,
             )
             for i in range(n)
         ]
@@ -1215,6 +1224,7 @@ def build_app(service: EngineService) -> web.Application:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, seed, ignore_eos,
+                logit_bias,
             ) = _parse_generation(body, _encode_prompt(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1247,14 +1257,14 @@ def build_app(service: EngineService) -> web.Application:
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
-                ignore_eos=ignore_eos,
+                ignore_eos=ignore_eos, logit_bias=logit_bias,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=logprobs_n > 0,
             want_prompt_logprobs=echo and bool(body.get("logprobs")),
-            seed=seed, ignore_eos=ignore_eos,
+            seed=seed, ignore_eos=ignore_eos, logit_bias=logit_bias,
         )
         req = reqs[0]
         ttft = (
@@ -1323,6 +1333,7 @@ def build_app(service: EngineService) -> web.Application:
             (
                 tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, seed, ignore_eos,
+                logit_bias,
             ) = _parse_generation(body, _chat_tokens(body.get("messages")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1354,13 +1365,13 @@ def build_app(service: EngineService) -> web.Application:
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
-                ignore_eos=ignore_eos,
+                ignore_eos=ignore_eos, logit_bias=logit_bias,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=top_n > 0, seed=seed,
-            ignore_eos=ignore_eos,
+            ignore_eos=ignore_eos, logit_bias=logit_bias,
         )
         from .tokenizer import truncate_at_text_stop
 

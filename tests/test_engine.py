@@ -407,3 +407,46 @@ def test_ignore_eos_decodes_full_budget():
     assert len(ignored.out_tokens) == 8
     assert ignored.finish_reason == "length"
     assert eos in ignored.out_tokens  # the eos token itself is kept
+
+
+def test_logit_bias_forces_and_bans_tokens():
+    """OpenAI logit_bias: +100 pins greedy decoding to a token; -100
+    effectively bans one (shifting greedy to the next-best)."""
+    cfg = EngineConfig(
+        model=llama.LlamaConfig.tiny(), max_batch=2, page_size=8,
+        num_pages=64, max_seq_len=64, eos_token_id=-1,
+    )
+    params = llama.init_params(jax.random.key(0), cfg.model)
+    eng = InferenceEngine(cfg, params=params, seed=0)
+    eng.add_request([5, 6, 7], max_new_tokens=4)
+    (plain,) = _drain(eng)
+
+    # +100 on an arbitrary token: greedy emits it everywhere
+    eng = InferenceEngine(cfg, params=params, seed=0)
+    eng.add_request([5, 6, 7], max_new_tokens=4, logit_bias={17: 100.0})
+    (forced,) = _drain(eng)
+    assert forced.out_tokens == [17, 17, 17, 17]
+    # the reported logprob reflects the BIASED distribution
+    assert forced.out_logprobs[0] > -1e-3
+
+    # -100 on the plain run's first token: it disappears from the output
+    eng = InferenceEngine(cfg, params=params, seed=0)
+    eng.add_request(
+        [5, 6, 7], max_new_tokens=4, logit_bias={plain.out_tokens[0]: -100.0}
+    )
+    (banned,) = _drain(eng)
+    assert plain.out_tokens[0] not in banned.out_tokens
+
+    # an unbiased neighbor in the same batch is unaffected
+    eng = InferenceEngine(cfg, params=params, seed=0)
+    eng.add_request([5, 6, 7], max_new_tokens=4, logit_bias={17: 100.0})
+    eng.add_request([5, 6, 7], max_new_tokens=4)
+    done = _drain(eng)
+    neighbor = next(r for r in done if not r.logit_bias)
+    assert neighbor.out_tokens == plain.out_tokens
+
+    import pytest as _p
+    with _p.raises(ValueError, match="outside vocab"):
+        eng.add_request([1], max_new_tokens=1, logit_bias={9999: 1.0})
+    with _p.raises(ValueError, match="outside"):
+        eng.add_request([1], max_new_tokens=1, logit_bias={1: 200.0})

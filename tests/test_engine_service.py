@@ -790,3 +790,36 @@ def test_ignore_eos_over_http():
         run_async(_client(svc, scenario))
     finally:
         svc.shutdown()
+
+
+def test_logit_bias_over_http(service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 3,
+                  "logit_bias": {"23": 100}},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["choices"][0]["token_ids"] == [23, 23, 23]
+        for bad in ({"23": 101}, {"99999": 1}, {"x": 1}, [1, 2], {"1": "y"}):
+            r = await client.post(
+                "/v1/completions",
+                json={"prompt": [1, 2, 3], "max_tokens": 2,
+                      "logit_bias": bad},
+            )
+            assert r.status == 400, bad
+
+        # streamed completions honor the bias too
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [1, 2, 3], "max_tokens": 3,
+                  "logit_bias": {"23": 100}, "stream": True},
+        )
+        assert r.status == 200
+        events, done = await _read_sse(r)
+        assert done
+        toks = [t for e in events for t in e["choices"][0]["token_ids"]]
+        assert toks == [23, 23, 23]
+
+    run_async(_client(service, scenario))
