@@ -1007,3 +1007,58 @@ def test_hf_model_dir_served_through_full_stack(scenario, tmp_path):
             await sc.stop()
 
     run(body())
+
+
+@pytest.mark.e2e
+def test_sampling_parameters_through_full_stack(scenario):
+    """The round's sampling features driven through the PRODUCT path
+    (controller binds, launcher forks the engine): per-request seed
+    reproducibility, logit_bias forcing, ignore_eos length control, and
+    top-k logprobs — all served by a launcher-forked engine process."""
+    sc = scenario
+    port = free_port()
+
+    def post(body, expect=200):
+        r = requests.post(
+            f"http://127.0.0.1:{port}/v1/completions", json=body, timeout=60
+        )
+        assert r.status_code == expect, r.text
+        return r.status_code, r.json() if r.status_code == 200 else r.text
+
+    async def body():
+        await sc.start()
+        try:
+            sc.add_lc()
+            sc.add_isc("isc-s", port)
+            sc.add_launcher_pod()
+            sc.add_requester("req-s", "isc-s", sc.default_spi)
+            await sc.wait_ready(sc.default_probes)
+
+            # seed reproducibility across real HTTP
+            b = {"prompt": [4, 5, 6], "max_tokens": 5, "temperature": 0.9,
+                 "seed": 11}
+            _, r1 = post(b)
+            _, r2 = post(b)
+            assert (
+                r1["choices"][0]["token_ids"] == r2["choices"][0]["token_ids"]
+            )
+
+            # logit_bias forces greedy
+            _, r3 = post({"prompt": [4, 5, 6], "max_tokens": 3,
+                          "logit_bias": {"31": 100}})
+            assert r3["choices"][0]["token_ids"] == [31, 31, 31]
+
+            # ignore_eos + top-k logprobs
+            _, r4 = post({"prompt": [4, 5, 6], "max_tokens": 4,
+                          "ignore_eos": True, "logprobs": 2})
+            c = r4["choices"][0]
+            assert len(c["token_ids"]) == 4
+            assert len(c["logprobs"]["top_logprobs"]) == 4
+
+            # validation errors are 400s end-to-end
+            post({"prompt": [4, 5, 6], "max_tokens": 2,
+                  "logit_bias": {"1": 200}}, expect=400)
+        finally:
+            await sc.stop()
+
+    run(body())
