@@ -135,6 +135,7 @@ def _measure() -> None:
     # already-loaded params (runtime quantization, same as serving) and
     # measure the same steady-state decode.
     decode_tok_s_int8 = 0.0
+    int8_error = ""
     if on_tpu:
         # Secondary measurement: a failure here (compile budget, HBM) must
         # not sink the headline actuation numbers below.
@@ -153,6 +154,9 @@ def _measure() -> None:
             qeng = InferenceEngine(qcfg, params=qparams, seed=0)
             decode_tok_s_int8 = measure_decode(qeng)
         except Exception as e:  # noqa: BLE001 — report, don't abort
+            # the reason must survive into the JSON artifact (a bare 0.0
+            # with the error on stderr reads as "mysteriously slow")
+            int8_error = f"{type(e).__name__}: {e}"[:300]
             print(f"int8 sub-bench failed: {e}", file=sys.stderr)
         finally:
             # Release the quantized engine's HBM before the actuation
@@ -161,20 +165,36 @@ def _measure() -> None:
             # it does NOT share with the live engine: quantize_params
             # reuses the bf16 embed/norm arrays, and deleting those would
             # kill the engine the rest of the bench measures.
+            # Deleting "anything not id()-identical to a live-engine leaf"
+            # is NOT safe: the engine's device_put (engine.py:253) can
+            # return a distinct Array object aliasing the SAME buffer as
+            # the live engine's reused bf16 leaf, and deleting the alias
+            # frees the shared buffer (r4 TPU bench died exactly here:
+            # "Array has been deleted bfloat16[32000,2048]" = the embed).
+            # Delete only what quantization freshly created — the
+            # {"q","s"} pairs and the quantized engine's own KV pool —
+            # and leave every reused bf16 leaf alone.
             try:
-                keep = {
-                    id(x)
-                    for x in jax.tree.leaves(params)
-                    + jax.tree.leaves(eng.params)
-                }
-                qstate = {}
+                from llm_d_fast_model_actuation_tpu.models.quant import (
+                    is_quantized,
+                )
+
+                doomed = []
+
+                def _collect_quant(node):
+                    if is_quantized(node):
+                        doomed.extend(jax.tree.leaves(node))
+                    elif isinstance(node, dict):
+                        for v in node.values():
+                            _collect_quant(v)
+
                 if qeng is not None:
-                    qstate = {"p": qeng.params, "kv": qeng.pool.as_tuple()}
-                elif qparams is not None:
-                    qstate = {"p": qparams}
-                for x in jax.tree.leaves(qstate):
-                    if id(x) not in keep:
-                        x.delete()
+                    _collect_quant(qeng.params)
+                    doomed.extend(jax.tree.leaves(qeng.pool.as_tuple()))
+                if qparams is not None:
+                    _collect_quant(qparams)
+                for x in doomed:
+                    x.delete()
             except Exception as e:  # noqa: BLE001
                 print(f"int8 cleanup failed: {e}", file=sys.stderr)
             del qeng, qparams
@@ -235,6 +255,7 @@ def _measure() -> None:
             ),
             "decode_tok_s": round(decode_tok_s, 1),
             "decode_tok_s_int8": round(decode_tok_s_int8, 1),
+            **({"int8_error": int8_error} if int8_error else {}),
             "checkpoint_load_s": round(ckpt_load_s, 2),
             "checkpoint_load_gibps": round(
                 param_gib / ckpt_load_s if ckpt_load_s > 0 else 0.0, 2

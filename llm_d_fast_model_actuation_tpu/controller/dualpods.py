@@ -836,7 +836,7 @@ class DualPodsController:
     ) -> Optional[Dict[str, Any]]:
         pod, _ = self._launcher_template(lc, node)
         pod["metadata"]["namespace"] = ns
-        self._assign_launcher_port(ns, pod, node)
+        self._assign_launcher_port(pod, node)
         self._stamp_binding(pod, req, isc_name, sd)
         t0 = time.monotonic()
         created = await self._create_unique(pod, f"{lc.metadata.name}-{node}")
@@ -854,7 +854,7 @@ class DualPodsController:
         return self.store.try_get("Pod", ns, pod["metadata"]["name"])
 
     def _assign_launcher_port(
-        self, ns: str, pod: Dict[str, Any], node: str
+        self, pod: Dict[str, Any], node: str
     ) -> None:
         """hostNetwork launchers on one node share the host's port space: a
         second (third, ...) launcher gets the first free port above the
@@ -868,8 +868,18 @@ class DualPodsController:
         if not spec.get("hostNetwork"):
             return
         used = set()
+        # hostNetwork port space is node-wide, not namespace-wide: scan
+        # every launcher pod the store knows about regardless of namespace
+        # (namespace=None = cache-wide), so launchers from LauncherConfigs
+        # in different namespaces on the same node can't collide. Scope
+        # caveat: KubeStore's informer watches a single namespace, so when
+        # the controller runs namespace-scoped this still only sees its own
+        # namespace plus its own cross-namespace write-throughs; full
+        # protection against launchers created by OTHER controller
+        # instances needs a cluster-scoped watch (deploy the controller
+        # cluster-scoped, or give each namespace a disjoint port range).
         for other in self.store.list(
-            "Pod", ns, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
+            "Pod", None, selector={C.COMPONENT_LABEL: C.LAUNCHER_COMPONENT}
         ):
             if (other.get("spec") or {}).get("nodeName") != node:
                 continue

@@ -1034,7 +1034,6 @@ def build_app(service: EngineService) -> web.Application:
             }
         )
         qtask: Optional[asyncio.Task] = None
-        held_ids: List[int] = []
         try:
             # inside the try: a disconnect cancelling this await must still
             # abort the in-flight generation
@@ -1050,18 +1049,21 @@ def build_app(service: EngineService) -> web.Application:
                     t, req_done = qtask.result()
                     qtask = None
                     if filt is not None:
-                        held_ids.append(t)
-                        text, matched = filt.push(t)
+                        # the filter tracks id<->text attribution through
+                        # its hold-back window: every emission's ids are
+                        # exactly the tokens whose decoded text it contains
+                        text, ids, matched = filt.push(t)
                         if not matched and req_done:
-                            tail, matched = filt.flush()
+                            tail, tids, matched = filt.flush()
                             text += tail
+                            ids = ids + tids
                         if matched:
                             # everything before the stop flushes in one
-                            # final chunk; ids of the (possibly partial)
-                            # stop content are suppressed with its text
+                            # final chunk; text AND ids of the (possibly
+                            # partial) stop content are suppressed together
                             if text:
                                 payload = json.dumps(
-                                    make_chunk(text, [], index)
+                                    make_chunk(text, ids, index)
                                 )
                                 index += 1
                                 await resp.write(
@@ -1071,8 +1073,7 @@ def build_app(service: EngineService) -> web.Application:
                                 service.abort(fut)
                             break
                         if not text and not req_done:
-                            continue  # held back: ids stay buffered too
-                        ids, held_ids = held_ids, []
+                            continue  # held back: ids stay in the filter
                     else:
                         text = dec.push(t)
                         if req_done:
@@ -1137,7 +1138,7 @@ def build_app(service: EngineService) -> web.Application:
         whitespace variants); keep the best logprob on collision."""
         out: Dict[str, float] = {}
         for tid, lp_ in alts[:n]:
-            key = tok.decode([tid])
+            key = tok.decode([tid], skip_special=False)
             if key not in out or lp_ > out[key]:
                 out[key] = lp_
         return out
@@ -1171,7 +1172,7 @@ def build_app(service: EngineService) -> web.Application:
         filt = TextStopStream(tok, stop_texts)
 
         def on_token(req, t: int) -> None:
-            _, matched = filt.push(t)
+            _, _, matched = filt.push(t)
             if matched:
                 req.stop_requested = True
 
@@ -1200,8 +1201,11 @@ def build_app(service: EngineService) -> web.Application:
                 # the response copies them onto the other choices
                 want_prompt_logprobs=want_prompt_logprobs and i == 0,
                 # OpenAI n + seed: distinct samples per choice, but the
-                # SET of choices is reproducible
-                seed=None if seed is None else seed + i,
+                # SET of choices is reproducible. Wrap into int64 so a
+                # seed near the bound that _parse_generation accepted
+                # can't overflow jax.random.key for i>0.
+                seed=None if seed is None
+                else ((seed + i + 2**63) % 2**64) - 2**63,
                 ignore_eos=ignore_eos,
                 logit_bias=logit_bias,
             )
@@ -1228,6 +1232,7 @@ def build_app(service: EngineService) -> web.Application:
             ) = _parse_generation(body, _encode_prompt(body.get("prompt")))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        raw_prompt = body.get("prompt")
 
         n = _parse_n(body)
         try:
@@ -1284,7 +1289,21 @@ def build_app(service: EngineService) -> web.Application:
             choice = {
                 "index": i,
                 "token_ids": kept,
-                "text": (tok.decode(tokens) + text) if echo else text,
+                "text": (
+                    # echo returns the prompt the client sent: a text
+                    # prompt verbatim (re-decoding would render the
+                    # tokenizer's auto-added BOS), a token-id prompt as
+                    # its literal decode, specials included (distinct
+                    # special ids must not silently vanish)
+                    (
+                        raw_prompt
+                        if isinstance(raw_prompt, str)
+                        else tok.decode(tokens, skip_special=False)
+                    )
+                    + text
+                    if echo
+                    else text
+                ),
                 "finish_reason": (
                     "stop" if matched else _finish_reason(service, r)
                 ),
@@ -1399,11 +1418,13 @@ def build_app(service: EngineService) -> web.Application:
                 choice["logprobs"] = {
                     "content": [
                         {
-                            "token": tok.decode([tid]),
+                            "token": tok.decode([tid], skip_special=False),
                             "logprob": lp,
                             "top_logprobs": [
                                 {
-                                    "token": tok.decode([aid]),
+                                    "token": tok.decode(
+                                        [aid], skip_special=False
+                                    ),
                                     "logprob": alp,
                                 }
                                 for aid, alp in alts[:top_n]

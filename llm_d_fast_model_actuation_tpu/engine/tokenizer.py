@@ -36,7 +36,7 @@ class ByteTokenizer:
     def encode(self, text: str, special: bool = True) -> List[int]:
         return list(text.encode("utf-8"))
 
-    def decode(self, tokens: Sequence[int]) -> str:
+    def decode(self, tokens: Sequence[int], skip_special: bool = True) -> str:
         return bytes(t % 256 for t in tokens).decode(
             "utf-8", errors="replace"
         )
@@ -64,8 +64,15 @@ class HFTokenizer:
     def encode(self, text: str, special: bool = True) -> List[int]:
         return list(self._tok.encode(text, add_special_tokens=special))
 
-    def decode(self, tokens: Sequence[int]) -> str:
-        return self._tok.decode(list(tokens), skip_special_tokens=True)
+    def decode(self, tokens: Sequence[int], skip_special: bool = True) -> str:
+        """skip_special=True (streamed/assembled response text) hides
+        BOS/EOS markers like vLLM's default detokenizer; callers that need
+        the literal text — echo of the original prompt, single-token
+        decodes for logprob alternative keys (distinct special ids must
+        not all merge into '') — pass skip_special=False."""
+        return self._tok.decode(
+            list(tokens), skip_special_tokens=skip_special
+        )
 
     def chat_tokens(self, messages: Sequence[Any]) -> List[int]:
         if getattr(self._tok, "chat_template", None):
@@ -146,19 +153,50 @@ class TextStopStream:
     String stops cannot be matched as token sequences: BPE does not
     round-trip decode→encode per token, and a stop string can start
     mid-token. This filter sits between the engine's token stream and the
-    SSE writer: `push` returns (text_safe_to_emit, matched). Text that
-    could be the start of a stop string is held back until disambiguated;
-    on a match, everything before the stop is returned and the stream is
-    over. `flush` releases held text when generation ends without a match.
-    """
+    SSE writer: `push` returns (text_safe_to_emit, ids, matched). Text
+    that could be the start of a stop string is held back until
+    disambiguated; on a match, everything before the stop is returned and
+    the stream is over. `flush` releases held text when generation ends
+    without a match.
+
+    `ids` are the token ids whose decoded text is FULLY contained in the
+    returned text, so streamed ids account for exactly the delivered text
+    at token granularity: each pushed token's chars are tracked through
+    the hold-back window, a token is delivered with the emission that
+    completes its text, and a token straddling a stop cut is suppressed
+    with the stop (the cut-before-the-matching-token rule of
+    truncate_at_text_stop)."""
 
     def __init__(self, tokenizer, stop_texts) -> None:
         self._dec = IncrementalDecoder(tokenizer)
         self._stops = [s for s in stop_texts if s]
         self._pending = ""
+        #: [token id, chars of _pending attributed to it] in arrival order;
+        #: invariant: sum of chars == len(_pending)
+        self._idq: List[list] = []
+
+    def _take_ids(self, k: int) -> List[int]:
+        """Pop the ids whose attributed chars lie within the first `k`
+        chars of the pending window (a token partially inside stays
+        queued, its remaining char count reduced)."""
+        out: List[int] = []
+        while self._idq and k >= 0:
+            tid, n = self._idq[0]
+            if n <= k:
+                k -= n
+                out.append(tid)
+                self._idq.pop(0)
+                if k == 0:
+                    break
+            else:
+                self._idq[0][1] = n - k
+                break
+        return out
 
     def push(self, token: int):
-        self._pending += self._dec.push(token)
+        new = self._dec.push(token)
+        self._pending += new
+        self._idq.append([int(token), len(new)])
         cut = -1
         for s in self._stops:
             j = self._pending.find(s)
@@ -166,8 +204,10 @@ class TextStopStream:
                 cut = j
         if cut >= 0:
             out = self._pending[:cut]
+            ids = self._take_ids(cut) if cut else []
             self._pending = ""
-            return out, True
+            self._idq = []
+            return out, ids, True
         hold = 0
         for s in self._stops:
             m = min(len(s) - 1, len(self._pending))
@@ -177,13 +217,18 @@ class TextStopStream:
                     break
         out = self._pending[: len(self._pending) - hold]
         self._pending = self._pending[len(out) :]
-        return out, False
+        return out, self._take_ids(len(out)) if out else [], False
 
     def flush(self):
         """End-of-generation: release held text, SCANNING it for stops
         first — a stop string can hide in a tail the decoder was holding
-        (split multi-byte sequence). Returns (text, matched)."""
-        tail = self._pending + self._dec.flush()
+        (split multi-byte sequence). Returns (text, ids, matched)."""
+        tail_new = self._dec.flush()
+        if tail_new and self._idq:
+            # decoder-held chars surfaced now; they came from the queued
+            # tokens — attribute to the newest (greedy, same as push)
+            self._idq[-1][1] += len(tail_new)
+        tail = self._pending + tail_new
         self._pending = ""
         cut = -1
         for s in self._stops:
@@ -191,8 +236,12 @@ class TextStopStream:
             if j >= 0 and (cut < 0 or j < cut):
                 cut = j
         if cut >= 0:
-            return tail[:cut], True
-        return tail, False
+            ids = self._take_ids(cut) if cut else []
+            self._idq = []
+            return tail[:cut], ids, True
+        ids = [tid for tid, _ in self._idq]
+        self._idq = []
+        return tail, ids, False
 
 
 def truncate_at_text_stop(tokenizer, tokens, logprobs, stop_texts):

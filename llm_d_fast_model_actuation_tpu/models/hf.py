@@ -175,6 +175,26 @@ def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
     st_files = sorted(
         f for f in os.listdir(path) if f.endswith(".safetensors")
     )
+    # A sharded checkpoint declares its shard set in the index file; a
+    # missing shard would otherwise just mean fewer tensors iterated (and
+    # silently zeroed layers, before load_params grew slice tracking).
+    # Fail up front with the exact files that are absent.
+    idx_path = os.path.join(path, "model.safetensors.index.json")
+    if os.path.isfile(idx_path):
+        with open(idx_path) as f:
+            declared = sorted(set(json.load(f).get("weight_map", {}).values()))
+        present = set(st_files)
+        absent = [s for s in declared if s not in present]
+        if absent:
+            raise FileNotFoundError(
+                f"checkpoint {path!r} index declares shard files that are "
+                f"not present: {absent}"
+            )
+        # iterate exactly the declared shard set: directories often carry
+        # extra safetensors (consolidated.*, partial downloads) that are
+        # not part of the indexed checkpoint
+        if declared:
+            st_files = declared
     if st_files:
         from safetensors import safe_open
 
@@ -266,6 +286,13 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
     )
     np_dtype = np.dtype(cfg.dtype)  # ml_dtypes registers bfloat16
     buffers: Dict[str, Any] = {}
+    # Stacked buffers start zeroed, so "the key exists" is not evidence the
+    # checkpoint supplied every layer/expert slice — a shard missing from an
+    # un-indexed checkpoint would serve zeroed layers. Track exactly which
+    # slices each staged tensor wrote; completeness is checked per slice
+    # below. (transformers/vLLM get this via the safetensors index; we also
+    # verify that in _iter_tensors when the index file exists.)
+    staged: Dict[str, set] = {}
 
     def stage(
         tree_key: Tuple[str, ...],
@@ -305,6 +332,12 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
                 f"{flat}: checkpoint shape {arr.shape} != model {tuple(want)}"
             )
         dst(buffers[flat])
+        if expert is not None:
+            staged.setdefault(flat, set()).add((layer, expert))
+        elif layer is not None:
+            staged.setdefault(flat, set()).add((layer,))
+        else:
+            staged.setdefault(flat, set()).add(("*",))
 
     for name, arr in _iter_tensors(path):
         if name in _TOP_MAP:
@@ -345,14 +378,39 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
         else:
             raise ValueError(f"unrecognized checkpoint tensor {name!r}")
 
-    expected = {
-        "/".join(p)
-        for p, _ in _flatten(shapes)
-    }
-    missing = expected - set(buffers)
-    if missing:
+    # Per-slice completeness: every (key, layer[, expert]) the model expects
+    # must have been written by some checkpoint tensor — whole-key presence
+    # is not enough (stacked buffers zero-init, so one staged layer would
+    # mask the rest being absent).
+    n_experts = int(getattr(cfg, "num_experts", 0) or 0)
+    problems = []
+    for p, node in _flatten(shapes):
+        flat = "/".join(p)
+        got = staged.get(flat, set())
+        if ("*",) in got:
+            continue
+        if p[0] == "layers":
+            n_layers = node.shape[0]
+            if n_experts and p[-1] in ("w_gate", "w_up", "w_down"):
+                want_slices = {
+                    (l, e)
+                    for l in range(n_layers)
+                    for e in range(n_experts)
+                }
+            else:
+                want_slices = {(l,) for l in range(n_layers)}
+        else:
+            want_slices = {("*",)}
+        absent = want_slices - got
+        if absent:
+            ex = sorted(absent)[:4]
+            problems.append(
+                f"{flat}: {len(absent)}/{len(want_slices)} slices never "
+                f"staged (e.g. {ex})"
+            )
+    if problems:
         raise ValueError(
-            f"checkpoint {path!r} is missing tensors for: {sorted(missing)}"
+            f"checkpoint {path!r} is incomplete: " + "; ".join(sorted(problems))
         )
     params = _unflatten(
         {k: jnp.asarray(v) for k, v in buffers.items()}

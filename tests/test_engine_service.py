@@ -823,3 +823,62 @@ def test_logit_bias_over_http(service):
         assert toks == [23, 23, 23]
 
     run_async(_client(service, scenario))
+
+
+def test_echo_text_prompt_is_verbatim(service):
+    """echo of a STRING prompt must return the exact text the client sent,
+    not a re-decode of its encoding — a real tokenizer auto-adds BOS on
+    encode, and rendering it (skip_special=False) or stripping legitimate
+    specials (skip_special=True) both corrupt the echo."""
+
+    class BosTokenizer:
+        """Wraps the service tokenizer, prepending a BOS id on encode the
+        way HF Llama-family tokenizers do."""
+
+        BOS = 199
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.eos_token_id = inner.eos_token_id
+
+        def encode(self, text, special=True):
+            return [self.BOS] + self._inner.encode(text, special)
+
+        def decode(self, tokens, skip_special=True):
+            toks = list(tokens)
+            if skip_special and toks and toks[0] == self.BOS:
+                toks = toks[1:]
+            prefix = "<s>" if not skip_special and toks[:1] == [self.BOS] else ""
+            if toks[:1] == [self.BOS]:
+                toks = toks[1:]
+            return prefix + self._inner.decode(toks)
+
+        def chat_tokens(self, messages):
+            return self._inner.chat_tokens(messages)
+
+    service.tokenizer = BosTokenizer(service.tokenizer)
+
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": "hi", "max_tokens": 2, "echo": True,
+                  "temperature": 0},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        text = body["choices"][0]["text"]
+        assert text.startswith("hi"), (
+            f"echoed text must start with the verbatim prompt, got {text!r}"
+        )
+
+        # token-id prompts echo their literal decode, specials included
+        r = await client.post(
+            "/v1/completions",
+            json={"prompt": [BosTokenizer.BOS, 104, 105], "max_tokens": 2,
+                  "echo": True, "temperature": 0},
+        )
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["choices"][0]["text"].startswith("<s>"), body
+
+    run_async(_client(service, scenario))

@@ -390,3 +390,45 @@ def test_prompt_logprobs_match_transformers(tmp_path):
         np.testing.assert_allclose(
             [g for g in got[1:]], [r for r in ref[1:]], rtol=2e-3, atol=2e-3,
         )
+
+
+def test_missing_layer_slice_rejected(tmp_path):
+    """A checkpoint that supplies some layers of a stacked weight but not
+    all must fail per-slice, not pass the whole-key check and serve
+    zero-initialized layers (ADVICE r4: whole-key-only completeness)."""
+    d, _ = _save(
+        tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM
+    )
+    import safetensors.torch as st
+    import os
+
+    fn = next(f for f in os.listdir(d) if f.endswith(".safetensors"))
+    sd = st.load_file(os.path.join(d, fn))
+    del sd["model.layers.1.mlp.gate_proj.weight"]
+    st.save_file(sd, os.path.join(d, fn))
+    with pytest.raises(ValueError, match="slices never staged"):
+        hf.load_params(d, hf.config_from_hf(d))
+
+
+def test_missing_declared_shard_rejected(tmp_path):
+    """When model.safetensors.index.json declares shard files, every one of
+    them must exist before loading starts (a missing shard would otherwise
+    just mean fewer tensors iterated)."""
+    d, _ = _save(
+        tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM
+    )
+    import json, os
+
+    fn = next(f for f in os.listdir(d) if f.endswith(".safetensors"))
+    with open(os.path.join(d, "model.safetensors.index.json"), "w") as f:
+        json.dump(
+            {
+                "weight_map": {
+                    "model.embed_tokens.weight": fn,
+                    "model.norm.weight": "model-00099-of-00099.safetensors",
+                }
+            },
+            f,
+        )
+    with pytest.raises(FileNotFoundError, match="00099"):
+        hf.load_params(d, hf.config_from_hf(d))

@@ -322,9 +322,51 @@ def test_text_stop_hidden_in_held_tail_matches_on_flush():
             return "".join(self.MAP[t] for t in toks)
 
     filt = TextStopStream(StubTok(), ("X",))
-    out, matched = filt.push(1)
-    assert (out, matched) == ("hello", False)
-    out, matched = filt.push(2)  # trailing U+FFFD: held by the decoder
-    assert (out, matched) == ("", False)
-    out, matched = filt.flush()
-    assert matched and out == ""  # the 'X' never reaches the client
+    out, ids, matched = filt.push(1)
+    assert (out, ids, matched) == ("hello", [1], False)
+    out, ids, matched = filt.push(2)  # trailing U+FFFD: held by the decoder
+    assert (out, ids, matched) == ("", [], False)
+    out, ids, matched = filt.flush()
+    # the 'X' never reaches the client — nor does token 2's id
+    assert matched and out == "" and ids == []
+
+
+def test_text_stop_id_attribution_is_exact():
+    """Streamed ids account for exactly the delivered text: a token whose
+    text is split across the stop cut is suppressed with the stop, and a
+    token whose text was delivered keeps its id even when a later chunk
+    completes the match (r4 review scenarios)."""
+    from llm_d_fast_model_actuation_tpu.engine.tokenizer import TextStopStream
+
+    class StubTok:
+        MAP = {1: "hi", 2: "x", 3: "cAB", 4: "xA", 5: "é"}
+
+        def decode(self, toks):
+            return "".join(self.MAP[t] for t in toks)
+
+    # (a) stop "é": ids of the stop content never delivered, "hi" keeps id 1
+    filt = TextStopStream(StubTok(), ("é",))
+    out, ids, matched = filt.push(1)
+    assert (out, ids, matched) == ("hi", [1], False)
+    out, ids, matched = filt.push(5)
+    assert matched and out == "" and ids == []
+
+    # (b) stop "AB": token 4 ("xA") first delivers only "x" (its "A" may
+    # start the stop, so id 4 is withheld with it); token 3 ("cAB")
+    # disambiguates — "Ac" flushes, completing token 4's text (id 4 now
+    # delivered), while token 3 straddles the cut ("c" delivered, "AB"
+    # suppressed) so its id is withheld with the stop
+    filt = TextStopStream(StubTok(), ("AB",))
+    out, ids, matched = filt.push(4)
+    assert (out, ids, matched) == ("x", [], False)
+    out, ids, matched = filt.push(3)
+    assert (out, ids, matched) == ("Ac", [4], True)
+
+    # (c) no stop ever matches: flush delivers every remaining id
+    filt = TextStopStream(StubTok(), ("ZZ",))
+    out, ids, matched = filt.push(1)
+    assert (out, ids, matched) == ("hi", [1], False)
+    out, ids, matched = filt.push(2)
+    assert (out, ids, matched) == ("x", [2], False)
+    out, ids, matched = filt.flush()
+    assert (out, ids, matched) == ("", [], False)
