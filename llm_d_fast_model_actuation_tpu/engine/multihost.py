@@ -33,11 +33,218 @@ compiles exactly once.
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+#: Heartbeat port = coordinator port + this offset. The gang coordinator
+#: draws per-gang coordinator ports from [base, base+4096) (controller/
+#: gang.py), so base+4096.. is collision-free against other gangs'
+#: coordinators on the same hostNetwork node.
+HEARTBEAT_PORT_OFFSET = 4096
+
+#: Exit code for "a gang peer died while the data plane may be blocked in
+#: a collective" — the launcher's sentinel sees the process exit and the
+#: crash chain (STOPPED -> notifier -> controller deletes the requester ->
+#: gang degrades -> re-forms) takes over, the same path a single-host
+#: engine crash takes (launcher/instance.py).
+EXIT_GANG_PEER_LOST = 13
+
+
+class GangWatchdog:
+    """Data-plane failure detector for a lockstep gang.
+
+    The lockstep protocol is built on collectives, and a collective whose
+    participant died never completes — a wedged gang serves nothing and
+    looks alive. The reference's failure chain is process-level (vLLM
+    crash -> launcher sentinel -> controller deletes the server pod); this
+    gives the gang's data plane the same property: any member death
+    converts, within `timeout` seconds, into every other member exiting
+    non-zero, which the per-member launchers' sentinels all see.
+
+    Star topology over the leader's host (every member already knows the
+    coordinator address; no extra discovery):
+
+      * the leader runs a tiny TCP responder on coordinator_port +
+        HEARTBEAT_PORT_OFFSET and tracks when each follower last pinged;
+        a follower silent for `timeout` seconds (or never arrived within
+        `join_grace`) kills the leader;
+      * followers ping every `interval` seconds; a leader unreachable for
+        `timeout` seconds kills the follower.
+
+    A follower death thus kills the leader directly, and the leader's
+    death cascades to the remaining followers — whole-gang teardown from
+    any single fault, without requiring full pairwise connectivity.
+
+    Heartbeats ride their own threads + sockets, never the collective
+    stream, so a gang blocked in a healthy long collective (big prefill)
+    keeps answering and is NOT torn down: timeouts fire only when a
+    process is actually gone (its responder/prober dies with it).
+    """
+
+    def __init__(
+        self,
+        process_id: int,
+        num_processes: int,
+        coordinator_address: str,
+        interval: float = 2.0,
+        timeout: float = 20.0,
+        join_grace: float = 60.0,
+        on_death: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        host, _, port = coordinator_address.rpartition(":")
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.leader_host = host
+        self.hb_port = int(port) + HEARTBEAT_PORT_OFFSET
+        # a timeout needs several missed pings' slack, or scheduler jitter
+        # on a single late ping reads as a death: keep >= 4 intervals per
+        # timeout window by shrinking the interval for small timeouts
+        self.interval = min(interval, max(0.05, timeout / 4.0))
+        self.timeout = timeout
+        self.join_grace = max(join_grace, timeout)
+        self._on_death = on_death or self._die
+        self._stop = threading.Event()
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+        self._threads: list = []
+        #: leader: follower pid -> monotonic last-heard
+        self._last_seen: Dict[int, float] = {}
+
+    @staticmethod
+    def _die(reason: str) -> None:
+        logger.critical(
+            "gang watchdog: %s — exiting %d so the launcher sentinel "
+            "tears this member down (the data plane may be wedged in a "
+            "collective and cannot unwind in-process)",
+            reason, EXIT_GANG_PEER_LOST,
+        )
+        # not sys.exit: the lockstep thread may be blocked inside a
+        # collective that will never return; only the process can die
+        os._exit(EXIT_GANG_PEER_LOST)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.num_processes <= 1:
+            return
+        if self.process_id == 0:
+            self._start_responder()
+            t = threading.Thread(
+                target=self._leader_monitor, daemon=True,
+                name="gang-hb-monitor",
+            )
+        else:
+            t = threading.Thread(
+                target=self._follower_prober, daemon=True,
+                name="gang-hb-prober",
+            )
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        """Clean shutdown (leader broadcast SHUTDOWN was delivered): stop
+        probing/monitoring so the orderly teardown isn't misread as a
+        death."""
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown()
+                self._server.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- leader side ---------------------------------------------------------
+
+    def _start_responder(self) -> None:
+        last_seen = self._last_seen
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self) -> None:
+                try:
+                    line = self.rfile.readline(64).decode().split()
+                    if len(line) == 2 and line[0] == "hb":
+                        last_seen[int(line[1])] = time.monotonic()
+                        self.wfile.write(b"ok\n")
+                except (ValueError, OSError):
+                    pass
+
+        class _HBServer(socketserver.ThreadingTCPServer):
+            # confined to the watchdog's server; mutating the stdlib class
+            # attribute would flip SO_REUSEADDR on for unrelated servers
+            allow_reuse_address = True
+
+        self._server = _HBServer(("0.0.0.0", self.hb_port), Handler)
+        self._server.daemon_threads = True
+        t = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="gang-hb-server",
+        )
+        t.start()
+        self._threads.append(t)
+
+    def _leader_monitor(self) -> None:
+        started = time.monotonic()
+        expected = set(range(1, self.num_processes))
+        while not self._stop.wait(self.interval):
+            now = time.monotonic()
+            for pid in expected:
+                seen = self._last_seen.get(pid)
+                if seen is None:
+                    # jax.distributed.initialize returned, so the member
+                    # process existed; its first ping should land within
+                    # an interval or two
+                    if now - started > self.join_grace:
+                        self._on_death(
+                            f"follower {pid} never sent a heartbeat "
+                            f"within {self.join_grace:.0f}s of gang start"
+                        )
+                        return
+                elif now - seen > self.timeout:
+                    self._on_death(
+                        f"follower {pid} heartbeat silent for "
+                        f"{now - seen:.1f}s (> {self.timeout:.0f}s)"
+                    )
+                    return
+
+    # -- follower side -------------------------------------------------------
+
+    def _ping(self) -> bool:
+        try:
+            with socket.create_connection(
+                (self.leader_host, self.hb_port), timeout=self.interval + 1
+            ) as s:
+                s.sendall(f"hb {self.process_id}\n".encode())
+                s.settimeout(self.interval + 1)
+                return s.recv(8).startswith(b"ok")
+        except OSError:
+            return False
+
+    def _follower_prober(self) -> None:
+        last_ok = time.monotonic()
+        reached = False  # leader responder answered at least once
+        while not self._stop.wait(self.interval):
+            if self._ping():
+                last_ok = time.monotonic()
+                reached = True
+                continue
+            silent = time.monotonic() - last_ok
+            # before first contact the leader may still be compiling /
+            # binding its responder: allow the same grace the leader gives
+            # followers, then the steady-state timeout applies
+            allowed = self.timeout if reached else self.join_grace
+            if silent > allowed:
+                self._on_death(
+                    f"leader heartbeat unreachable for {silent:.1f}s "
+                    f"(> {allowed:.0f}s)"
+                )
+                return
 
 KIND_IDLE = 0
 KIND_PREFILL = 1

@@ -435,6 +435,25 @@ class EngineService:
             from .multihost import LockstepLeader
 
             self.engine.lockstep = LockstepLeader(self.engine)
+        self.watchdog = None
+        hb_timeout = float(
+            os.environ.get("FMA_GANG_HEARTBEAT_TIMEOUT", "20") or 0
+        )
+        if dist is not None and hb_timeout > 0:
+            # Data-plane failure detection (engine/multihost.py): a dead
+            # gang member must become a non-zero exit on every other
+            # member within the timeout — collectives can't unwind a
+            # wedged lockstep in-process. FMA_GANG_HEARTBEAT_TIMEOUT=0
+            # disables (tests that kill members deliberately).
+            from .multihost import GangWatchdog
+
+            self.watchdog = GangWatchdog(
+                process_id=self.process_id,
+                num_processes=dist["num_processes"],
+                coordinator_address=dist["coordinator_address"],
+                timeout=hb_timeout,
+            )
+            self.watchdog.start()
         self._publisher = self._make_publisher()
         self._publish_usage()
         self._thread = threading.Thread(
@@ -560,6 +579,10 @@ class EngineService:
 
         try:
             follower_loop(self.engine, self.sleeper)
+            if self.watchdog is not None:
+                # clean SHUTDOWN received: the leader is about to exit on
+                # purpose; don't let its disappearance read as a death
+                self.watchdog.stop()
         except Exception as e:
             logger.exception("follower loop failed")
             self.failure = f"{type(e).__name__}: {e}"
@@ -738,6 +761,12 @@ class EngineService:
 
     def shutdown(self) -> None:
         self._stop = True
+        if self.watchdog is not None:
+            # orderly teardown must not be misread as a peer death — the
+            # SHUTDOWN frame below reaches followers before the leader
+            # exits (the broadcast is itself a collective), and followers
+            # stop their own watchdogs when their loop returns
+            self.watchdog.stop()
         self._new_work.set()
         if not self.is_follower:
             # follower threads block inside the broadcast collective and

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Lint GitHub workflow action refs (reference parity: the reference ships
+an action-ref hygiene check, hack/check-action-refs.py / DR-10; this is our
+own implementation of the same policy).
+
+Policy:
+  * every `uses:` must carry an explicit ref (`@<something>`);
+  * floating branch refs (`@main`, `@master`, `@latest`) are forbidden;
+  * with --strict, refs must be full-length commit SHAs (supply-chain
+    pinning — tags are movable).
+
+Local (`./…`) and docker (`docker://…@sha256:…`) refs are exempt from the
+SHA rule but docker refs must be digest-pinned under --strict.
+"""
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+USES_RE = re.compile(r"^\s*(?:-\s+)?uses:\s*([^\s#]+)", re.M)
+SHA_RE = re.compile(r"^[0-9a-f]{40}$")
+FLOATING = {"main", "master", "latest", "HEAD"}
+
+
+def check(path: Path, strict: bool) -> list:
+    errors = []
+    for ref in USES_RE.findall(path.read_text()):
+        ref = ref.strip("\"'")
+        if ref.startswith("./"):
+            continue  # local composite action: versioned with the repo
+        if ref.startswith("docker://"):
+            if strict and "@sha256:" not in ref:
+                errors.append(f"{path}: docker ref not digest-pinned: {ref}")
+            continue
+        if "@" not in ref:
+            errors.append(f"{path}: unpinned action ref: {ref}")
+            continue
+        _, tag = ref.rsplit("@", 1)
+        if tag in FLOATING:
+            errors.append(f"{path}: floating branch ref: {ref}")
+        elif strict and not SHA_RE.match(tag):
+            errors.append(f"{path}: not SHA-pinned (--strict): {ref}")
+    return errors
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--strict", action="store_true",
+                    help="require full commit SHAs")
+    ap.add_argument("--workflows", default=".github/workflows")
+    args = ap.parse_args()
+    errors = []
+    for p in sorted(Path(args.workflows).glob("*.yml")):
+        errors.extend(check(p, args.strict))
+    for e in errors:
+        print(e, file=sys.stderr)
+    if not errors:
+        print(f"action refs ok ({args.workflows})")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Dump engine-instance logs from every launcher pod in a namespace — the
+# first tool an operator reaches for when a node misbehaves.
+#
+# Reference parity: scripts/dump-launcher-vllm-logs.sh (same operator
+# workflow against our launcher's /v2/vllm/instances wire API:
+# launcher/rest.py serves the inventory and per-instance ranged logs).
+#
+# Usage: dump-launcher-instance-logs.sh [namespace]
+#   namespace: Kubernetes namespace (defaults to the current context's)
+
+set -euo pipefail
+
+NS_FLAG=()
+if [ -n "${1:-}" ]; then
+  NS_FLAG=(-n "$1")
+fi
+
+LOCAL_PORT="${FMA_DUMP_LOCAL_PORT:-18001}"
+
+echo "Fetching engine instance logs from launcher pods..."
+
+PODS=$(kubectl get pods "${NS_FLAG[@]}" \
+  -l "app.kubernetes.io/component=launcher" \
+  -o jsonpath='{.items[*].metadata.name}' 2>/dev/null || true)
+
+if [ -z "$PODS" ]; then
+  echo "No launcher pods found"
+  exit 0
+fi
+
+for POD in $PODS; do
+  echo "=========================================="
+  echo "=== Launcher pod: $POD ==="
+  echo "=========================================="
+
+  # per-pod port override (hostNetwork collision handling, dualpods.py)
+  PORT=$(kubectl get pod "${NS_FLAG[@]}" "$POD" -o jsonpath="{.metadata.annotations['dual-pods\.llm-d\.ai/launcher-port']}" 2>/dev/null || true)
+  PORT="${PORT:-8001}"
+
+  kubectl port-forward "${NS_FLAG[@]}" "pod/$POD" "$LOCAL_PORT:$PORT" &
+  PF_PID=$!
+  trap 'kill "$PF_PID" 2>/dev/null || true' EXIT
+  # wait for the forward to come up
+  for _ in $(seq 1 50); do
+    if curl -fsS "http://127.0.0.1:$LOCAL_PORT/health" >/dev/null 2>&1; then
+      break
+    fi
+    sleep 0.2
+  done
+
+  INSTANCES=$(curl -fsS "http://127.0.0.1:$LOCAL_PORT/v2/vllm/instances?detail=false" || echo '{}')
+  echo "$INSTANCES" | python3 -c 'import json,sys; [print(i) for i in json.load(sys.stdin).get("instance_ids", [])]' | while read -r ID; do
+    echo "--- instance $ID ---"
+    curl -fsS "http://127.0.0.1:$LOCAL_PORT/v2/vllm/instances/$ID" \
+      | python3 -m json.tool || true
+    echo "--- instance $ID log ---"
+    curl -fsS "http://127.0.0.1:$LOCAL_PORT/v2/vllm/instances/$ID/log" || true
+    echo
+  done
+
+  kill "$PF_PID" 2>/dev/null || true
+  trap - EXIT
+done
+
+echo "Done."

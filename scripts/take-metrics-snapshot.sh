@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Archive a metrics snapshot from a pod's observability endpoint (the
+# controllers' /metrics + /debug/vars, utils/observability.py) — or, when
+# pointed at a Prometheus pod, trigger a TSDB snapshot through the admin
+# API and copy it out.
+#
+# Reference parity: scripts/take-prom-snapshot.sh (same operator workflow;
+# our controllers self-serve Prometheus text so the direct-scrape mode
+# works without a Prometheus deployment).
+#
+# Usage: take-metrics-snapshot.sh <namespace> <pod> <port> <dest-dir>
+
+set -euo pipefail
+
+if [ $# != 4 ]; then
+    echo "Usage: $0 namespace podname port dest-dir" >&2
+    exit 1
+fi
+
+ns=$1; pod=$2; port=$3; dest=$4
+
+if [ -z "$ns" ] || [ -z "$pod" ] || [ -z "$port" ] || [ -z "$dest" ]; then
+    echo "All arguments must be non-empty" >&2
+    exit 1
+fi
+
+case "$dest" in
+    (/*|.|./|..|../*|*/../*|*/..|.git*)
+        echo "The destination must be a fresh subdirectory of the current working directory" >&2
+        exit 1;;
+    (-*)
+        echo "The destination can not start with a dash" >&2
+        exit 1;;
+esac
+
+mkdir -p "$dest"
+
+LOCAL_PORT="${FMA_SNAPSHOT_LOCAL_PORT:-19090}"
+kubectl -n "$ns" port-forward "pod/$pod" "$LOCAL_PORT:$port" &
+PF_PID=$!
+trap 'kill "$PF_PID" 2>/dev/null || true' EXIT
+for _ in $(seq 1 50); do
+    if curl -fsS "http://127.0.0.1:$LOCAL_PORT/" >/dev/null 2>&1; then
+        break
+    fi
+    sleep 0.2
+done
+
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+
+if curl -fsS -XPOST "http://127.0.0.1:$LOCAL_PORT/api/v1/admin/tsdb/snapshot" \
+    -o "$dest/prom-snapshot-$stamp.json" 2>/dev/null; then
+    # a real Prometheus: the snapshot now sits in the pod's data dir
+    snap=$(python3 -c "import json;print(json.load(open('$dest/prom-snapshot-$stamp.json'))['data']['name'])")
+    kubectl -n "$ns" cp "$pod:/prometheus/snapshots/$snap" "$dest/$snap"
+    echo "Prometheus TSDB snapshot: $dest/$snap"
+else
+    # one of our components: scrape the text endpoints directly
+    curl -fsS "http://127.0.0.1:$LOCAL_PORT/metrics" \
+        > "$dest/metrics-$ns-$pod-$stamp.prom"
+    curl -fsS "http://127.0.0.1:$LOCAL_PORT/debug/vars" \
+        > "$dest/vars-$ns-$pod-$stamp.json" 2>/dev/null || true
+    curl -fsS "http://127.0.0.1:$LOCAL_PORT/debug/stacks" \
+        > "$dest/stacks-$ns-$pod-$stamp.txt" 2>/dev/null || true
+    echo "Metrics snapshot: $dest/metrics-$ns-$pod-$stamp.prom"
+fi

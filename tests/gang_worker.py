@@ -14,6 +14,7 @@ import time
 
 def main() -> None:
     pid, n, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "normal"
     from llm_d_fast_model_actuation_tpu.engine.server import (
         EngineService,
         parse_engine_options,
@@ -28,6 +29,16 @@ def main() -> None:
     )
     svc = EngineService(args)
     print(f"READY {pid}", flush=True)
+
+    if mode == "serve-wait":
+        # watchdog e2e (test_multihost_e2e.py): prove the gang serves,
+        # then idle — the test kills a member and asserts the survivor
+        # exits EXIT_GANG_PEER_LOST via the watchdog
+        if pid == 0:
+            out = svc.submit([5, 6, 7], 4, 0.0).result(timeout=120)
+            print("SERVED", ",".join(map(str, out.out_tokens)), flush=True)
+        while True:
+            time.sleep(0.5)
 
     if pid == 0:
         prompt = [5, 6, 7]

@@ -221,18 +221,18 @@ def test_assign_launcher_port_hostnetwork_collision():
 
     # no hostNetwork: untouched regardless of neighbors
     pod = launcher_pod("l0", host_network=False)
-    ctl._assign_launcher_port(h.ns, pod, "n1")
+    ctl._assign_launcher_port(pod, "n1")
     assert C.LAUNCHER_PORT_ANNOTATION not in pod["metadata"]["annotations"]
 
     # first hostNetwork launcher on the node: default port, no annotation
     pod1 = launcher_pod("l1")
-    ctl._assign_launcher_port(h.ns, pod1, "n1")
+    ctl._assign_launcher_port(pod1, "n1")
     assert C.LAUNCHER_PORT_ANNOTATION not in pod1["metadata"]["annotations"]
     h.store.create(pod1)
 
     # second: first free port above the default + env for the process
     pod2 = launcher_pod("l2")
-    ctl._assign_launcher_port(h.ns, pod2, "n1")
+    ctl._assign_launcher_port(pod2, "n1")
     ann = pod2["metadata"]["annotations"]
     assert ann[C.LAUNCHER_PORT_ANNOTATION] == str(C.LAUNCHER_SERVICE_PORT + 1)
     env = pod2["spec"]["containers"][0]["env"]
@@ -242,12 +242,12 @@ def test_assign_launcher_port_hostnetwork_collision():
 
     # third skips both taken ports; another NODE starts at the default again
     pod3 = launcher_pod("l3")
-    ctl._assign_launcher_port(h.ns, pod3, "n1")
+    ctl._assign_launcher_port(pod3, "n1")
     assert pod3["metadata"]["annotations"][C.LAUNCHER_PORT_ANNOTATION] == str(
         C.LAUNCHER_SERVICE_PORT + 2
     )
     pod_other = launcher_pod("l4", node="n2")
-    ctl._assign_launcher_port(h.ns, pod_other, "n2")
+    ctl._assign_launcher_port(pod_other, "n2")
     assert (
         C.LAUNCHER_PORT_ANNOTATION
         not in pod_other["metadata"]["annotations"]

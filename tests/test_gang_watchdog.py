@@ -1,0 +1,132 @@
+"""GangWatchdog (engine/multihost.py): gang data-plane failure detection.
+
+The lockstep protocol wedges forever if a member dies mid-collective; the
+watchdog converts any member death into every other member exiting, which
+the launchers' sentinels turn into the normal crash chain. These tests
+drive the watchdog with real sockets and injected death callbacks — no
+jax, no gang."""
+
+import threading
+import time
+
+from llm_d_fast_model_actuation_tpu.engine.multihost import (
+    EXIT_GANG_PEER_LOST,
+    HEARTBEAT_PORT_OFFSET,
+    GangWatchdog,
+)
+
+from conftest import free_port
+
+
+def _mk(pid, port, deaths, **kw):
+    defaults = dict(interval=0.1, timeout=0.6, join_grace=1.0)
+    defaults.update(kw)
+    return GangWatchdog(
+        process_id=pid,
+        num_processes=2,
+        coordinator_address=f"127.0.0.1:{port}",
+        on_death=lambda reason: deaths.append((pid, reason)),
+        **defaults,
+    )
+
+
+def test_healthy_gang_stays_up():
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths)
+    follower = _mk(1, port, deaths)
+    leader.start()
+    follower.start()
+    try:
+        time.sleep(1.5)  # several timeout windows
+        assert deaths == []
+    finally:
+        follower.stop()
+        leader.stop()
+
+
+def test_follower_death_kills_leader():
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths)
+    follower = _mk(1, port, deaths)
+    leader.start()
+    follower.start()
+    try:
+        time.sleep(0.5)  # follower checks in
+        follower.stop()  # "dies": stops pinging
+        t0 = time.monotonic()
+        while not deaths and time.monotonic() - t0 < 3:
+            time.sleep(0.05)
+        assert deaths and deaths[0][0] == 0, deaths
+        assert "follower 1" in deaths[0][1]
+    finally:
+        leader.stop()
+
+
+def test_leader_death_kills_follower():
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths)
+    follower = _mk(1, port, deaths)
+    leader.start()
+    follower.start()
+    try:
+        time.sleep(0.4)
+        leader.stop()  # responder gone
+        t0 = time.monotonic()
+        while not deaths and time.monotonic() - t0 < 3:
+            time.sleep(0.05)
+        follower_deaths = [d for d in deaths if d[0] == 1]
+        assert follower_deaths, deaths
+        assert "leader" in follower_deaths[0][1]
+    finally:
+        follower.stop()
+
+
+def test_follower_that_never_joins_trips_join_grace():
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths, join_grace=0.5)
+    leader.start()
+    try:
+        t0 = time.monotonic()
+        while not deaths and time.monotonic() - t0 < 3:
+            time.sleep(0.05)
+        assert deaths and "never sent a heartbeat" in deaths[0][1], deaths
+    finally:
+        leader.stop()
+
+
+def test_stopped_watchdog_never_fires():
+    """Clean shutdown: stop() before the peer disappears -> no death."""
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths)
+    follower = _mk(1, port, deaths)
+    leader.start()
+    follower.start()
+    time.sleep(0.3)
+    follower.stop()
+    leader.stop()
+    time.sleep(1.0)
+    assert deaths == []
+
+
+def test_single_process_watchdog_is_noop():
+    deaths = []
+    w = GangWatchdog(
+        process_id=0, num_processes=1,
+        coordinator_address="127.0.0.1:9",
+        on_death=lambda r: deaths.append(r),
+    )
+    w.start()  # no threads, no sockets
+    assert w._threads == []
+    w.stop()
+    assert deaths == []
+
+
+def test_exit_code_is_distinct():
+    # the launcher sentinel treats any non-zero exit as a crash; the
+    # dedicated code makes gang teardowns recognizable in logs
+    assert EXIT_GANG_PEER_LOST not in (0, 1, 2)
