@@ -516,21 +516,26 @@ class InferenceEngine:
     # -- device scheduler state ---------------------------------------------
 
     def _upload_sched(self) -> None:
-        """Push host scheduler mirrors to device (one transfer per array)."""
-        self._dev = {
-            "lt": jax.device_put(self._last_tokens),
-            "pos": jax.device_put(self._positions),
-            "budget": jax.device_put(self._budgets),
-            "pt": jax.device_put(self._page_table),
-            "temps": jax.device_put(self._temps),
-            "topp": jax.device_put(self._topps),
-            "counts": jax.device_put(self._token_counts),
-            "pres": jax.device_put(self._pres),
-            "freq": jax.device_put(self._freqs),
-            "skeys": jax.device_put(self._slot_keys),
-            "eos_on": jax.device_put(self._eos_on),
-            "bias": jax.device_put(self._bias),
-        }
+        """Push host scheduler mirrors to device in ONE batched transfer —
+        twelve per-array device_puts are twelve round trips on a
+        high-latency link (the axon tunnel), and this runs on every
+        post-wake / post-admission chunk."""
+        self._dev = jax.device_put(
+            {
+                "lt": self._last_tokens,
+                "pos": self._positions,
+                "budget": self._budgets,
+                "pt": self._page_table,
+                "temps": self._temps,
+                "topp": self._topps,
+                "counts": self._token_counts,
+                "pres": self._pres,
+                "freq": self._freqs,
+                "skeys": self._slot_keys,
+                "eos_on": self._eos_on,
+                "bias": self._bias,
+            }
+        )
         self._dirty = False
 
     def drop_device_sched_state(self) -> None:

@@ -445,6 +445,9 @@ class EngineService:
             # member within the timeout — collectives can't unwind a
             # wedged lockstep in-process. FMA_GANG_HEARTBEAT_TIMEOUT=0
             # disables (tests that kill members deliberately).
+            # FMA_GANG_JOIN_GRACE covers startup skew: members heartbeat
+            # only after their full engine init, and a multi-GB checkpoint
+            # load can lag one host far behind another.
             from .multihost import GangWatchdog
 
             self.watchdog = GangWatchdog(
@@ -452,6 +455,9 @@ class EngineService:
                 num_processes=dist["num_processes"],
                 coordinator_address=dist["coordinator_address"],
                 timeout=hb_timeout,
+                join_grace=float(
+                    os.environ.get("FMA_GANG_JOIN_GRACE", "60") or 60
+                ),
             )
             self.watchdog.start()
         self._publisher = self._make_publisher()
@@ -761,12 +767,6 @@ class EngineService:
 
     def shutdown(self) -> None:
         self._stop = True
-        if self.watchdog is not None:
-            # orderly teardown must not be misread as a peer death — the
-            # SHUTDOWN frame below reaches followers before the leader
-            # exits (the broadcast is itself a collective), and followers
-            # stop their own watchdogs when their loop returns
-            self.watchdog.stop()
         self._new_work.set()
         if not self.is_follower:
             # follower threads block inside the broadcast collective and
@@ -781,6 +781,15 @@ class EngineService:
                     self.engine.lockstep.shutdown()
             except Exception:
                 logger.warning("lockstep shutdown broadcast failed", exc_info=True)
+        if self.watchdog is not None:
+            # only AFTER the SHUTDOWN broadcast: the broadcast is itself a
+            # collective, so returning from it means every follower has the
+            # frame — stopping the responder earlier would let a long
+            # in-flight step turn an orderly stop into follower probers
+            # reading the leader as dead. The leader's own monitor can't
+            # misfire meanwhile: followers keep pinging until they process
+            # SHUTDOWN and stop their watchdogs themselves.
+            self.watchdog.stop()
         if self._publisher is not None:
             self._publisher.clear()
 

@@ -178,11 +178,16 @@ class SleepManager:
             self._sharding_specs = None
             if level == SleepLevel.L1_HOST_OFFLOAD:
                 if self._use_memory_kind:
-                    host = jax.tree.map(
-                        lambda x: jax.device_put(
-                            x, x.sharding.with_memory_kind("pinned_host")
-                        ),
+                    # one batched transfer: per-leaf device_puts pay one
+                    # round trip per array on high-latency links
+                    host = jax.device_put(
                         state,
+                        jax.tree.map(
+                            lambda x: x.sharding.with_memory_kind(
+                                "pinned_host"
+                            ),
+                            state,
+                        ),
                     )
                     host = jax.block_until_ready(host)
                 else:
@@ -237,18 +242,15 @@ class SleepManager:
             if self._released:
                 assert self._sharding_specs is not None
                 leaves, treedef = jax.tree.flatten(self._host_state)
-                restored = [
-                    jax.device_put(h, rebuild_spec(spec))
-                    for h, spec in zip(leaves, self._sharding_specs)
-                ]
+                restored = jax.device_put(
+                    leaves,
+                    [rebuild_spec(spec) for spec in self._sharding_specs],
+                )
                 state = jax.tree.unflatten(treedef, restored)
                 state = jax.block_until_ready(state)
             else:
-                state = jax.tree.map(
-                    lambda h, sh: jax.device_put(h, sh),
-                    self._host_state,
-                    self._shardings,
-                )
+                # batched: one transfer call for the whole tree (see sleep)
+                state = jax.device_put(self._host_state, self._shardings)
                 state = jax.block_until_ready(state)
                 if self._use_memory_kind:
                     for leaf in jax.tree.leaves(self._host_state):

@@ -51,7 +51,10 @@ def main() -> int:
     ap.add_argument("--workflows", default=".github/workflows")
     args = ap.parse_args()
     errors = []
-    for p in sorted(Path(args.workflows).glob("*.yml")):
+    paths = sorted(Path(args.workflows).glob("*.yml")) + sorted(
+        Path(args.workflows).glob("*.yaml")
+    )
+    for p in paths:
         errors.extend(check(p, args.strict))
     for e in errors:
         print(e, file=sys.stderr)

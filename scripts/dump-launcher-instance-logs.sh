@@ -60,6 +60,9 @@ for POD in $PODS; do
   done
 
   kill "$PF_PID" 2>/dev/null || true
+  # reap before the next pod's forward: a lingering forward on the same
+  # local port would attribute this pod's instances to the next header
+  wait "$PF_PID" 2>/dev/null || true
   trap - EXIT
 done
 

@@ -44,21 +44,16 @@ def main() -> None:
         print(json.dumps({"exp": name, **kw}), flush=True)
 
     # --- raw tunnel bandwidth -------------------------------------------------
-    mb = 256
-    x_host = np.ones((mb, 1024, 1024 // 4), np.float32)  # mb MiB
-    t0 = time.monotonic()
-    x_dev = jax.device_put(x_host)
-    jax.block_until_ready(x_dev)
-    h2d = time.monotonic() - t0
-    t0 = time.monotonic()
-    _ = np.asarray(x_dev)
-    d2h = time.monotonic() - t0
-    x_dev.delete()
+    from llm_d_fast_model_actuation_tpu.utils.bandwidth import (
+        measure_tunnel_bandwidth,
+    )
+
+    h2d, d2h = measure_tunnel_bandwidth()
     report(
         "tunnel_bandwidth",
-        h2d_gibps=round(mb / 1024 / h2d, 3),
-        d2h_gibps=round(mb / 1024 / d2h, 3),
-        mib=mb,
+        h2d_gibps=round(h2d, 3),
+        d2h_gibps=round(d2h, 3),
+        mib=256,
     )
 
     model_name = "bench-1b" if on_tpu else "tiny"
