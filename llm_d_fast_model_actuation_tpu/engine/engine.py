@@ -169,15 +169,6 @@ class Request:
     stop_requested: bool = False
 
 
-def _alts_row(av, ai, row: int) -> list:
-    """Device [b, k] top-k arrays -> [(token_id, logprob), ...] for one row."""
-    av = np.asarray(av)
-    ai = np.asarray(ai)
-    return [
-        (int(ai[row, j]), float(av[row, j])) for j in range(av.shape[1])
-    ]
-
-
 def validate_logit_bias(lb, vocab_size: int) -> "Dict[int, float] | None":
     """OpenAI logit_bias validation, shared by the HTTP layer (-> 400)
     and add_request (-> per-request error): token ids must be in-vocab,
@@ -774,10 +765,9 @@ class InferenceEngine:
             self._slot_keys[req.slot],
             self._bias[req.slot : req.slot + 1],
         )
-        if final:
-            self._slot_keys[req.slot] = np.asarray(new_key)
         self.pool.replace(cache)
-        return tok, lp, av, ai, plp
+        # key sync is the caller's: it batches it with the other host reads
+        return tok, lp, av, ai, plp, new_key
 
     def _run_prefill(self, req: Request) -> None:
         n = len(req.prompt)
@@ -818,7 +808,6 @@ class InferenceEngine:
                 self._slot_keys[req.slot],
                 self._bias[req.slot : req.slot + 1],
             )
-            self._slot_keys[req.slot] = np.asarray(new_key)
             self.pool.replace(cache)
             if req.want_prompt_logprobs:
                 row = np.asarray(plp)[0]
@@ -834,10 +823,13 @@ class InferenceEngine:
                 req.prompt_logprobs = [None]  # nothing precedes token 0
             while pos < n:
                 seg = req.prompt[pos : min(n, pos + limit)]
-                tok, lp, av, ai, plp = self._run_suffix_segment(
+                final = pos + len(seg) >= n
+                tok, lp, av, ai, plp, seg_key = self._run_suffix_segment(
                     req, pos, seg, temp, topp, counts_row, pres, freq,
-                    final=pos + len(seg) >= n,
+                    final=final,
                 )
+                if final:
+                    new_key = seg_key
                 if req.want_prompt_logprobs:
                     row = np.asarray(plp)[0]
                     # entries predict prompt[pos+1 .. pos+len(seg)]; the
@@ -855,14 +847,24 @@ class InferenceEngine:
                 req.shared_pages,
                 known_hashes=getattr(req, "_prefix_hashes", ()),
             )
-        first = int(np.asarray(tok)[0])
+        # ONE batched host sync for everything the emit needs — separate
+        # np.asarray calls are separate round trips on high-latency links,
+        # and this is the tail of every TTFT measurement
+        if req.want_top_logprobs:
+            tok_h, lp_h, key_h, av_h, ai_h = jax.device_get(
+                (tok, lp, new_key, av, ai)
+            )
+            alts = [
+                (int(ai_h[0, j]), float(av_h[0, j]))
+                for j in range(av_h.shape[1])
+            ]
+        else:
+            tok_h, lp_h, key_h = jax.device_get((tok, lp, new_key))
+            alts = None
+        self._slot_keys[req.slot] = key_h
+        first = int(tok_h[0])
         req.pos = n
-        self._emit(
-            req,
-            first,
-            float(np.asarray(lp)[0]),
-            _alts_row(av, ai, 0) if req.want_top_logprobs else None,
-        )
+        self._emit(req, first, float(lp_h[0]), alts)
         self._positions[req.slot] = req.pos  # position of the token to place
         self._last_tokens[req.slot] = first
         self._temps[req.slot] = req.temperature
@@ -1051,10 +1053,10 @@ class InferenceEngine:
             self.params, tokens, start, window_len, self.pool.as_tuple(), table
         )
         self.pool.replace(cache)
-        o = np.asarray(toks)[0]
-        o_lp = np.asarray(lps_dev)[0]
-        o_av = np.asarray(avs_dev)[0]
-        o_ai = np.asarray(ais_dev)[0]
+        # one batched host sync (4 separate np.asarray = 4 round trips)
+        o, o_lp, o_av, o_ai = (
+            x[0] for x in jax.device_get((toks, lps_dev, avs_dev, ais_dev))
+        )
         self.spec_proposed += len(props)
         accepted = 0
         emitted: List[Tuple[int, float, list]] = []

@@ -48,12 +48,13 @@ def main() -> None:
         measure_tunnel_bandwidth,
     )
 
-    h2d, d2h = measure_tunnel_bandwidth()
+    probe_mib = 256
+    h2d, d2h = measure_tunnel_bandwidth(probe_mib)
     report(
         "tunnel_bandwidth",
         h2d_gibps=round(h2d, 3),
         d2h_gibps=round(d2h, 3),
-        mib=256,
+        mib=probe_mib,
     )
 
     model_name = "bench-1b" if on_tpu else "tiny"
