@@ -60,11 +60,14 @@ def _measure() -> None:
         model = MODEL_CONFIGS[model_name]()
         cfg = EngineConfig(
             model=model, max_batch=8, page_size=16, num_pages=512,
-            max_seq_len=1024, decode_chunk=16,
+            max_seq_len=1024, decode_chunk=32,
         )
-        # 1 prefill-sampled token + 64 chunked decode steps (4 x T=16, no
-        # single-step drain tail).
-        prompt_len, decode_steps = 128, 65
+        # 1 prefill-sampled token + 128 chunked decode steps (4 x T=32, no
+        # single-step drain tail; the first chunk runs inside the untimed
+        # admission drain, so the timed window covers 3 dispatches — never
+        # a one-sample measurement). Chunk length amortizes the per-dispatch
+        # round trip, the dominant decode cost over the tunnel (docs/perf.md).
+        prompt_len, decode_steps = 128, 129
     else:
         model_name = "tiny"
         model = llama.LlamaConfig.tiny()

@@ -810,17 +810,14 @@ class InferenceEngine:
             )
             self.pool.replace(cache)
             if req.want_prompt_logprobs:
-                row = np.asarray(plp)[0]
-                req.prompt_logprobs = [None] + [
-                    float(row[i]) for i in range(n - 1)
-                ]
+                # device refs only; fetched in the single batched sync below
+                plp_parts = [(plp, n - 1)]
         else:
             # prefix-cache hit and/or chunked prefill: run [k, n) through
             # the continue program in segments of <= limit tokens; only the
             # final segment's sample is consumed
             pos = k
-            if req.want_prompt_logprobs:
-                req.prompt_logprobs = [None]  # nothing precedes token 0
+            plp_parts = []
             while pos < n:
                 seg = req.prompt[pos : min(n, pos + limit)]
                 final = pos + len(seg) >= n
@@ -831,13 +828,10 @@ class InferenceEngine:
                 if final:
                     new_key = seg_key
                 if req.want_prompt_logprobs:
-                    row = np.asarray(plp)[0]
                     # entries predict prompt[pos+1 .. pos+len(seg)]; the
                     # final segment's last entry predicts nothing
-                    take = len(seg) if pos + len(seg) < n else len(seg) - 1
-                    req.prompt_logprobs.extend(
-                        float(row[i]) for i in range(take)
-                    )
+                    take = len(seg) if not final else len(seg) - 1
+                    plp_parts.append((plp, take))
                 pos += len(seg)
         if self.prefix_cache is not None:
             # the full prompt pages now hold prompt KV: make them reusable
@@ -849,18 +843,30 @@ class InferenceEngine:
             )
         # ONE batched host sync for everything the emit needs — separate
         # np.asarray calls are separate round trips on high-latency links,
-        # and this is the tail of every TTFT measurement
+        # and this is the tail of every TTFT measurement. Prompt-logprob
+        # rows (one per prefill segment) ride the same fetch.
+        fetch = [tok, lp, new_key]
         if req.want_top_logprobs:
-            tok_h, lp_h, key_h, av_h, ai_h = jax.device_get(
-                (tok, lp, new_key, av, ai)
-            )
+            fetch += [av, ai]
+        if req.want_prompt_logprobs:
+            fetch += [p for p, _ in plp_parts]
+        vals = list(jax.device_get(tuple(fetch)))
+        tok_h, lp_h, key_h = vals[:3]
+        vals = vals[3:]
+        alts = None
+        if req.want_top_logprobs:
+            av_h, ai_h = vals[:2]
+            vals = vals[2:]
             alts = [
                 (int(ai_h[0, j]), float(av_h[0, j]))
                 for j in range(av_h.shape[1])
             ]
-        else:
-            tok_h, lp_h, key_h = jax.device_get((tok, lp, new_key))
-            alts = None
+        if req.want_prompt_logprobs:
+            req.prompt_logprobs = [None]  # nothing precedes token 0
+            for row, (_, take) in zip(vals, plp_parts):
+                req.prompt_logprobs.extend(
+                    float(row[0][i]) for i in range(take)
+                )
         self._slot_keys[req.slot] = key_h
         first = int(tok_h[0])
         req.pos = n

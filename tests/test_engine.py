@@ -450,3 +450,21 @@ def test_logit_bias_forces_and_bans_tokens():
         eng.add_request([1], max_new_tokens=1, logit_bias={9999: 1.0})
     with _p.raises(ValueError, match="outside"):
         eng.add_request([1], max_new_tokens=1, logit_bias={1: 200.0})
+
+
+def test_decode_chunk_length_invariant():
+    """Chunk length is a pure scheduling knob: T=32 must produce the same
+    greedy tokens as T=4 (the bench serves chunk 32 on TPU)."""
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    prompt = [5, 6, 7, 8, 9]
+
+    def run(chunk):
+        cfg = EngineConfig(
+            model=llama.LlamaConfig.tiny(), max_batch=2, page_size=8,
+            num_pages=32, max_seq_len=64, decode_chunk=chunk,
+        )
+        eng = InferenceEngine(cfg, seed=0)
+        return eng.generate([prompt], max_new_tokens=40)[0]
+
+    assert run(4) == run(32)
