@@ -186,8 +186,10 @@ def make_arg_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--decode-chunk",
         type=int,
-        default=8,
-        help="max decode steps fused into one compiled dispatch",
+        default=0,
+        help="max decode steps fused into one compiled dispatch "
+        "(0 = auto: 32 on TPU where per-dispatch latency dominates, "
+        "8 elsewhere; docs/perf.md)",
     )
     p.add_argument(
         "--max-prefill-tokens",
@@ -281,8 +283,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         )
     if args.tensor_parallel_size < 1:
         raise ValueError("--tensor-parallel-size must be >= 1")
-    if args.decode_chunk < 1:
-        raise ValueError("--decode-chunk must be >= 1")
+    if args.decode_chunk < 0:
+        raise ValueError("--decode-chunk must be >= 1, or 0 for auto")
     if args.max_prefill_tokens < 0:
         raise ValueError("--max-prefill-tokens must be >= 0")
     if args.speculative_ngram < 0:
@@ -394,6 +396,8 @@ class EngineService:
 
             # host-side load; InferenceEngine shards onto the mesh
             params = hf_models.load_params(self.hf_dir, model_cfg)
+        import jax  # deliberately not module-level: parse-time must not touch a backend
+
         self.engine = InferenceEngine(
             EngineConfig(
                 model=model_cfg,
@@ -404,7 +408,8 @@ class EngineService:
                 eos_token_id=eos_token_id,
                 extra_eos_ids=extra_eos,
                 attention_impl=args.attention_impl,
-                decode_chunk=args.decode_chunk,
+                decode_chunk=args.decode_chunk
+                or (32 if jax.default_backend() == "tpu" else 8),
                 prefix_caching=args.prefix_caching == "on",
                 max_prefill_tokens=args.max_prefill_tokens,
                 speculative_ngram=args.speculative_ngram,

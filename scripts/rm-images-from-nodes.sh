@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Remove stale FMA container images from every node's container runtime —
+# after a round of image pushes, nodes hold old layers that mask tag
+# updates and eat disk. Reference parity: scripts/rm-images-from-ocp-nodes.sh
+# (same operator workflow, generic kubectl-debug/crictl instead of OCP oc).
+#
+# Usage: rm-images-from-nodes.sh [image-substring]
+#   image-substring: match against image repo names (default: fma-tpu)
+
+set -euo pipefail
+
+MATCH="${1:-fma-tpu}"
+
+NODES=$(kubectl get nodes -o jsonpath='{.items[*].metadata.name}')
+if [ -z "$NODES" ]; then
+    echo "No nodes found" >&2
+    exit 1
+fi
+
+for NODE in $NODES; do
+    echo "=== node $NODE ==="
+    # kubectl debug gives a host-namespace pod; crictl talks to the
+    # node's runtime regardless of containerd/cri-o. python3 parses the
+    # crictl JSON (grep/tr munging corrupts the first repoTag).
+    kubectl debug "node/$NODE" --image=busybox --profile=sysadmin -q -- \
+        chroot /host sh -c "
+            crictl images -o json 2>/dev/null | python3 -c '
+import json, sys
+for img in json.load(sys.stdin).get(\"images\", []):
+    for tag in img.get(\"repoTags\") or []:
+        if \"$MATCH\" in tag:
+            print(tag)
+' | while read -r IMG; do
+                [ -n \"\$IMG\" ] || continue
+                echo \"removing \$IMG\"
+                crictl rmi \"\$IMG\" || echo \"failed: \$IMG\" >&2
+            done
+        " || echo "node $NODE: debug pod failed (RBAC? runtime?)" >&2
+done
+
+# kubectl debug leaves one Completed node-debugger pod per node; reap them
+kubectl get pods -o name 2>/dev/null \
+    | grep -E '^pod/node-debugger-' \
+    | xargs -r kubectl delete --wait=false
+
+echo "Done."
