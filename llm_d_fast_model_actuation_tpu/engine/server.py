@@ -421,8 +421,6 @@ class EngineService:
         )
         self.sleeper = attach_sleep(self.engine)
         mode = getattr(args, "sleep_release_devices", "auto")
-        import jax
-
         self.release_on_sleep = (
             mode == "always"
             or (mode == "auto" and jax.default_backend() == "tpu")

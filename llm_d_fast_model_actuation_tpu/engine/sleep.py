@@ -153,7 +153,10 @@ class SleepManager:
             ]
             self._shardings = None
             if level == SleepLevel.L1_HOST_OFFLOAD:
-                self._host_state = jax.tree.map(np.asarray, state)
+                # one batched fetch (per-leaf np.asarray pays one round
+                # trip per array); returns plain numpy, which survives
+                # the client destruction below
+                self._host_state = jax.device_get(state)
             else:
                 self._host_state = None
         elif jax.process_count() > 1:
@@ -165,9 +168,14 @@ class SleepManager:
             self._sharding_specs = None
             if level == SleepLevel.L1_HOST_OFFLOAD:
                 leaves, self._treedef = jax.tree.flatten(state)
+                shard_lists = [list(x.addressable_shards) for x in leaves]
+                # one batched fetch across every leaf's local shards
+                datas = jax.device_get(
+                    [[s.data for s in shards] for shards in shard_lists]
+                )
                 self._staged = [
-                    [(s.device, np.asarray(s.data)) for s in x.addressable_shards]
-                    for x in leaves
+                    [(s.device, d) for s, d in zip(shards, ds)]
+                    for shards, ds in zip(shard_lists, datas)
                 ]
                 self._staged_meta = [(x.shape, x.sharding) for x in leaves]
             else:
@@ -226,9 +234,13 @@ class SleepManager:
             # process's staged shards (every gang process does the same)
             from jax import make_array_from_single_device_arrays
 
+            # one batched upload of every leaf's local shards
+            all_arrs = jax.device_put(
+                [[buf for _, buf in shards] for shards in self._staged],
+                [[d for d, _ in shards] for shards in self._staged],
+            )
             restored = []
-            for (shape, sharding), shards in zip(self._staged_meta, self._staged):
-                arrs = [jax.device_put(buf, d) for d, buf in shards]
+            for (shape, sharding), arrs in zip(self._staged_meta, all_arrs):
                 restored.append(
                     make_array_from_single_device_arrays(shape, sharding, arrs)
                 )

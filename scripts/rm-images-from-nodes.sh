@@ -19,28 +19,26 @@ fi
 
 for NODE in $NODES; do
     echo "=== node $NODE ==="
-    # kubectl debug gives a host-namespace pod; crictl talks to the
-    # node's runtime regardless of containerd/cri-o. python3 parses the
-    # crictl JSON (grep/tr munging corrupts the first repoTag).
-    kubectl debug "node/$NODE" --image=busybox --profile=sysadmin -q -- \
-        chroot /host sh -c "
-            crictl images -o json 2>/dev/null | python3 -c '
-import json, sys
-for img in json.load(sys.stdin).get(\"images\", []):
-    for tag in img.get(\"repoTags\") or []:
-        if \"$MATCH\" in tag:
-            print(tag)
-' | while read -r IMG; do
-                [ -n \"\$IMG\" ] || continue
-                echo \"removing \$IMG\"
-                crictl rmi \"\$IMG\" || echo \"failed: \$IMG\" >&2
-            done
+    # --attach streams the command and returns when it exits (without it,
+    # kubectl debug creates the pod and returns immediately — the work
+    # would race the reaper below). Everything node-side runs through
+    # `chroot /host crictl`; parsing is busybox awk over crictl's table
+    # output, so no interpreter is required on minimal node images.
+    kubectl debug "node/$NODE" --image=busybox --profile=sysadmin \
+        -q --attach=true -- sh -c "
+            chroot /host crictl images 2>/dev/null \
+              | awk -v m='$MATCH' 'NR>1 && index(\$1, m) && \$2 != \"<none>\" {print \$1\":\"\$2}' \
+              | while read -r IMG; do
+                    [ -n \"\$IMG\" ] || continue
+                    echo \"removing \$IMG\"
+                    chroot /host crictl rmi \"\$IMG\" || echo \"failed: \$IMG\" >&2
+                done
         " || echo "node $NODE: debug pod failed (RBAC? runtime?)" >&2
 done
 
 # kubectl debug leaves one Completed node-debugger pod per node; reap them
 kubectl get pods -o name 2>/dev/null \
-    | grep -E '^pod/node-debugger-' \
+    | { grep -E '^pod/node-debugger-' || true; } \
     | xargs -r kubectl delete --wait=false
 
 echo "Done."
