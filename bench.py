@@ -61,12 +61,18 @@ def _measure() -> None:
         cfg = EngineConfig(
             model=model, max_batch=8, page_size=16, num_pages=512,
             max_seq_len=1024, decode_chunk=32,
+            # overlap dispatch with fetch+emit (engine.pipeline_decode);
+            # FMA_BENCH_PIPELINE=0 measures the sequential path
+            pipeline_decode=os.environ.get("FMA_BENCH_PIPELINE", "1") != "0",
         )
         # 1 prefill-sampled token + 128 chunked decode steps (4 x T=32, no
-        # single-step drain tail; the first chunk runs inside the untimed
-        # admission drain, so the timed window covers 3 dispatches — never
-        # a one-sample measurement). Chunk length amortizes the per-dispatch
-        # round trip, the dominant decode cost over the tunnel (docs/perf.md).
+        # single-step drain tail). Pipelined (the default here): the
+        # untimed admission phase dispatches chunk 1 without draining it;
+        # the timed window then covers 4 drains / 3 fresh dispatches with
+        # fetch+emit overlapping compute. FMA_BENCH_PIPELINE=0 measures
+        # the sequential path (3 timed dispatch+drain pairs) for
+        # comparison with earlier rounds. Chunk length amortizes the
+        # per-dispatch round trip (docs/perf.md).
         prompt_len, decode_steps = 128, 129
     else:
         model_name = "tiny"

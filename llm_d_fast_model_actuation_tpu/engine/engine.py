@@ -84,6 +84,12 @@ class EngineConfig:
     #: programs reduce bf16 in different orders — the standard spec-decode
     #: caveat). Engages for single-sequence greedy decoding only; 0 = off.
     speculative_ngram: int = 0
+    #: Double-buffered decode: dispatch chunk k+1 before reading chunk k's
+    #: results, overlapping device compute with the host's fetch+emit —
+    #: wins when per-dispatch latency is comparable to chunk compute
+    #: (remote/tunneled TPU hosts; docs/perf.md). Token delivery lags one
+    #: chunk. Ignored under gang lockstep. Off by default.
+    pipeline_decode: bool = False
 
     @property
     def seq_len(self) -> int:
@@ -433,6 +439,13 @@ class InferenceEngine:
         self._spec_miss_streak = 0
         self._spec_cooldown = 0
         self._chunk_fns: Dict[int, Any] = {}
+        #: pipelined decode: the dispatched-but-unread chunk, and requests
+        #: whose retire awaits that chunk's completion (see _defer_retire)
+        self._inflight: Optional[tuple] = None
+        self._pending_retire: List[Request] = []
+        #: finished outside a step() call (drain_inflight before sleep):
+        #: handed back by the next step() so the service resolves futures
+        self._orphan_finished: List[Request] = []
 
     # -- compiled decode chunk ----------------------------------------------
 
@@ -1114,7 +1127,8 @@ class InferenceEngine:
         that finished."""
         if self.params is None:
             raise EngineAsleep("engine state is offloaded (sleeping)")
-        finished: List[Request] = []
+        finished: List[Request] = list(self._orphan_finished)
+        self._orphan_finished.clear()
 
         while self._waiting:
             req = self._waiting[0]
@@ -1126,90 +1140,194 @@ class InferenceEngine:
                 self._retire(req)
                 finished.append(req)
 
-        spec_req = self._spec_candidate()
+        # speculation never interleaves with an in-flight chunk: a verify
+        # forward would race the chunk's decode of the same slot
+        spec_req = self._spec_candidate() if self._inflight is None else None
         if spec_req is not None and self._spec_round(spec_req):
             if spec_req.done:
                 self._retire(spec_req)
                 finished.append(spec_req)
             return finished
 
-        running = {
+        pipelined = self.cfg.pipeline_decode and self.lockstep is None
+        if not pipelined:
+            running = self._running()
+            if running:
+                finished.extend(
+                    self._drain_chunk(self._dispatch_chunk(running))
+                )
+            return finished
+
+        # Pipelined (double-buffered) decode: dispatch chunk k+1 BEFORE
+        # reading chunk k's results, so the device computes k+1 while the
+        # host fetches and emits k — hiding the dispatch/fetch round trip
+        # that dominates decode on high-latency links (docs/perf.md).
+        # Page-safety invariant: a chunk dispatched after a request's
+        # finish became known never writes its slot (host finishes freeze
+        # the budget mirror and mark it dirty, and a dirty state forces
+        # drain-then-reupload ordering below), so a finished request's
+        # pages may be written only by the ONE chunk already in flight —
+        # its retire (page free / prefix-cache registration) is deferred
+        # until that chunk drains (_defer_retire).
+        if self._inflight is not None:
+            running = self._running()
+            nxt = None
+            if running and not self._dirty and not self._waiting:
+                nxt = self._dispatch_chunk(running)
+            inflight, self._inflight = self._inflight, None
+            ready, self._pending_retire = self._pending_retire, []
+            finished.extend(self._drain_chunk(inflight, defer_retire=True))
+            for r in ready:
+                # the chunk that could still write these slots has drained
+                self._retire(r)
+            self._inflight = nxt
+            if nxt is None:
+                for r in self._pending_retire:
+                    self._retire(r)
+                self._pending_retire = []
+            return finished
+        running = self._running()
+        if running:
+            self._inflight = self._dispatch_chunk(running)
+        return finished
+
+    def _running(self) -> Dict[int, Request]:
+        return {
             r.slot: r for r in self._slots if r is not None and not r.done
         }
-        if running:
-            max_remaining = max(
-                r.max_new_tokens - len(r.out_tokens) for r in running.values()
+
+    def _dispatch_chunk(self, running: Dict[int, Request]):
+        """Dispatch one compiled decode chunk (async — jax returns
+        futures); the matching _drain_chunk does the single host sync."""
+        max_remaining = max(
+            r.max_new_tokens - len(r.out_tokens) for r in running.values()
+        )
+        # Exactly two compiled chunk programs (T=decode_chunk and T=1):
+        # compiles are expensive on TPU, and a serving engine at steady
+        # state always has >= decode_chunk tokens of demand. The drain
+        # tail of a batch run falls back to single steps.
+        T = self.cfg.decode_chunk if max_remaining >= self.cfg.decode_chunk else 1
+        reupload = self._dirty or self._dev is None
+        if self.lockstep is not None:
+            self.lockstep.chunk(T, reupload)
+        if reupload:
+            self._upload_sched()
+        d = self._dev
+        (
+            toks_dev, lps_dev, avs_dev, ais_dev, lt, pos, budget, cache,
+            counts_dev, skeys_dev,
+        ) = self._chunk_fn(T)(
+            self.params,
+            d["lt"],
+            d["pos"],
+            d["budget"],
+            self.pool.as_tuple(),
+            d["pt"],
+            d["temps"],
+            d["topp"],
+            d["counts"],
+            d["pres"],
+            d["freq"],
+            d["skeys"],
+            d["eos_on"],
+            d["bias"],
+        )
+        self.pool.replace(cache)
+        self._dev = {
+            "lt": lt, "pos": pos, "budget": budget,
+            "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
+            "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
+            "skeys": skeys_dev, "eos_on": d["eos_on"], "bias": d["bias"],
+        }
+        return (toks_dev, lps_dev, avs_dev, ais_dev, skeys_dev, running, T)
+
+    def _drain_chunk(self, inflight, defer_retire: bool = False):
+        """Fetch one dispatched chunk's results (the single blocking host
+        sync per chunk) and emit its tokens."""
+        toks_dev, lps_dev, avs_dev, ais_dev, skeys_dev, running, T = inflight
+        finished: List[Request] = []
+        # The key mirror rides the batched device_get: a dirty re-upload
+        # must not rewind any slot's key stream to a pre-chunk state.
+        # Pipelined: a later chunk's dispatch DONATES this chunk's skeys
+        # output (is_deleted) — skip the stale sync; the later chunk's own
+        # drain supplies the fresh mirror, and a re-upload is always
+        # preceded by that drain (dirty state blocks pre-dispatch).
+        if skeys_dev.is_deleted():
+            toks, lps, avs, ais = jax.device_get(
+                (toks_dev, lps_dev, avs_dev, ais_dev)
             )
-            # Exactly two compiled chunk programs (T=decode_chunk and T=1):
-            # compiles are expensive on TPU, and a serving engine at steady
-            # state always has >= decode_chunk tokens of demand. The drain
-            # tail of a batch run falls back to single steps.
-            T = self.cfg.decode_chunk if max_remaining >= self.cfg.decode_chunk else 1
-            reupload = self._dirty or self._dev is None
-            if self.lockstep is not None:
-                self.lockstep.chunk(T, reupload)
-            if reupload:
-                self._upload_sched()
-            d = self._dev
-            (
-                toks_dev, lps_dev, avs_dev, ais_dev, lt, pos, budget, cache,
-                counts_dev, skeys_dev,
-            ) = self._chunk_fn(T)(
-                self.params,
-                d["lt"],
-                d["pos"],
-                d["budget"],
-                self.pool.as_tuple(),
-                d["pt"],
-                d["temps"],
-                d["topp"],
-                d["counts"],
-                d["pres"],
-                d["freq"],
-                d["skeys"],
-                d["eos_on"],
-                d["bias"],
-            )
-            self.pool.replace(cache)
-            self._dev = {
-                "lt": lt, "pos": pos, "budget": budget,
-                "pt": d["pt"], "temps": d["temps"], "topp": d["topp"],
-                "counts": counts_dev, "pres": d["pres"], "freq": d["freq"],
-                "skeys": skeys_dev, "eos_on": d["eos_on"], "bias": d["bias"],
-            }
-            # ONE host sync per chunk (batched device_get). The key
-            # mirror rides along: a dirty re-upload must not rewind any
-            # slot's key stream to a pre-chunk state.
+        else:
             toks, lps, avs, ais, skeys_host = jax.device_get(
                 (toks_dev, lps_dev, avs_dev, ais_dev, skeys_dev)
             )
-            self._slot_keys[:] = skeys_host
-            for t in range(T):
-                for slot, req in list(running.items()):
-                    tok = int(toks[t, slot])
-                    req.pos += 1
-                    self._positions[slot] = req.pos
-                    self._last_tokens[slot] = tok
-                    self._emit(
-                        req, tok, float(lps[t, slot]),
-                        [
-                            (int(ais[t, slot, j]), float(avs[t, slot, j]))
-                            for j in range(avs.shape[2])
-                        ]
-                        if req.want_top_logprobs
-                        else None,
-                    )
-                    # keep the budget mirror exact: a dirty re-upload with a
-                    # stale budget would un-freeze finished slots on device
-                    self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)
-                    if req.done:
+            # only the rows this chunk actually advanced: a request
+            # admitted while the chunk was in flight had its key written
+            # by prefill AFTER dispatch, and a wholesale copy would rewind
+            # it to the pre-admission (zero) snapshot
+            for slot in running:
+                self._slot_keys[slot] = skeys_host[slot]
+        running = dict(running)
+        for slot in list(running):
+            # aborted between dispatch and drain: its tokens are frozen
+            # repeats, and abort already handled the retire
+            if running[slot].done:
+                del running[slot]
+        for t in range(T):
+            for slot, req in list(running.items()):
+                tok = int(toks[t, slot])
+                req.pos += 1
+                self._positions[slot] = req.pos
+                self._last_tokens[slot] = tok
+                self._emit(
+                    req, tok, float(lps[t, slot]),
+                    [
+                        (int(ais[t, slot, j]), float(avs[t, slot, j]))
+                        for j in range(avs.shape[2])
+                    ]
+                    if req.want_top_logprobs
+                    else None,
+                )
+                # keep the budget mirror exact: a dirty re-upload with a
+                # stale budget would un-freeze finished slots on device
+                self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)
+                if req.done:
+                    if defer_retire:
+                        self._defer_retire(req)
+                    else:
                         self._retire(req)
-                        finished.append(req)
-                        del running[slot]
+                    finished.append(req)
+                    del running[slot]
         return finished
 
+    def _defer_retire(self, req: Request) -> None:
+        """A finished request whose pages a still-in-flight chunk may yet
+        write: freeze its slot on the next reupload and postpone the page
+        free / prefix-cache registration until that chunk drains."""
+        self._budgets[req.slot] = 0
+        self._dirty = True
+        self._pending_retire.append(req)
+
+    def drain_inflight(self) -> None:
+        """Complete any dispatched-but-unread decode chunk and flush
+        deferred retires. Called before sleep/offload (the results would
+        otherwise be lost with the device state). Finished requests are
+        NOT returned — they are handed to the next step() call via the
+        orphan list, so exactly one consumer (the service loop) resolves
+        them."""
+        if self._inflight is not None:
+            inflight, self._inflight = self._inflight, None
+            self._orphan_finished.extend(self._drain_chunk(inflight))
+        for r in self._pending_retire:
+            self._retire(r)
+        self._pending_retire = []
+
     def has_work(self) -> bool:
-        return bool(self._waiting) or any(s is not None for s in self._slots)
+        return (
+            bool(self._waiting)
+            or any(s is not None for s in self._slots)
+            or self._inflight is not None
+            or bool(self._orphan_finished)
+        )
 
     def abort(self, seq_id: int, reason: str = "aborted") -> bool:
         """Abort one request (client disconnect): waiting requests are
@@ -1223,7 +1341,16 @@ class InferenceEngine:
                 return True
         for req in self._slots:
             if req is not None and req.seq_id == seq_id:
-                self._retire(req)
+                if req.done:
+                    # finished on its own terms, retire merely deferred
+                    # (pipelined); deferring again would double-free its
+                    # pages — and the legitimate finish must stand
+                    return False
+                if self._inflight is not None:
+                    # an in-flight chunk may still write this slot's pages
+                    self._defer_retire(req)
+                else:
+                    self._retire(req)
                 req.done = True
                 req.error = reason
                 return True
@@ -1234,11 +1361,19 @@ class InferenceEngine:
         (slots, page tables, allocator, prefix cache). Used when continuity
         of generation cannot be preserved — e.g. a level-2 sleep discarded
         the KV cache, which also invalidates every cached prefix page."""
+        # a dispatched chunk's results are irrelevant (everything aborts);
+        # deferred-retire requests still occupy _slots, so the loop below
+        # retires them with everyone else
+        self._inflight = None
+        self._pending_retire = []
         aborted = list(self._waiting)
         self._waiting.clear()
         for req in list(self._slots):
             if req is not None:
-                aborted.append(req)
+                if not req.done:
+                    # deferred-retire requests finished on their own terms;
+                    # only genuinely in-flight ones get the abort error
+                    aborted.append(req)
                 self._retire(req)
         for req in aborted:
             req.done = True

@@ -192,6 +192,14 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "8 elsewhere; docs/perf.md)",
     )
     p.add_argument(
+        "--pipeline-decode",
+        choices=["on", "off"],
+        default="off",
+        help="double-buffer decode chunks: dispatch chunk k+1 before "
+        "reading chunk k (overlaps device compute with host fetch+emit; "
+        "token delivery lags one chunk; ignored in gangs)",
+    )
+    p.add_argument(
         "--max-prefill-tokens",
         type=int,
         default=0,
@@ -410,6 +418,9 @@ class EngineService:
                 attention_impl=args.attention_impl,
                 decode_chunk=args.decode_chunk
                 or (32 if jax.default_backend() == "tpu" else 8),
+                pipeline_decode=(
+                    getattr(args, "pipeline_decode", "off") == "on"
+                ),
                 prefix_caching=args.prefix_caching == "on",
                 max_prefill_tokens=args.max_prefill_tokens,
                 speculative_ngram=args.speculative_ngram,

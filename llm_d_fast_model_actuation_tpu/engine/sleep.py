@@ -299,6 +299,9 @@ def attach_sleep(engine) -> SleepManager:
     wake fast path resumes in-flight sequences."""
 
     def get_state():
+        # a dispatched-but-unread decode chunk would be lost with the
+        # device state: complete it (emitting its tokens) before offload
+        engine.drain_inflight()
         return {"params": engine.params, "kv": engine.pool.as_tuple()}
 
     def set_state(state):
