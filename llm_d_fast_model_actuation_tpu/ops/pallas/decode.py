@@ -272,8 +272,8 @@ def paged_decode_attention_inline_pallas(
             row_spec((1, num_heads, head_dim)),
             row_spec((1, num_kv_heads, head_dim)),
             row_spec((1, num_kv_heads, head_dim)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=row_spec((1, num_heads, head_dim)),
         scratch_shapes=[
@@ -326,8 +326,8 @@ def paged_decode_attention_pallas(
                 lambda b, *_: (b, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
             (1, num_heads, head_dim),
