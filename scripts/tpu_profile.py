@@ -102,7 +102,7 @@ def main() -> None:
 
     import dataclasses
 
-    def make_engine(batch, chunk, attn="auto", quant=""):
+    def make_engine(batch, chunk, attn="auto", quant="", pipeline=False):
         m = model
         if quant:
             from llm_d_fast_model_actuation_tpu.models.registry import (
@@ -118,11 +118,16 @@ def main() -> None:
         cfg = EngineConfig(
             model=m, max_batch=batch, page_size=16,
             num_pages=max(512, batch * 16), max_seq_len=1024,
-            decode_chunk=chunk,
+            decode_chunk=chunk, pipeline_decode=pipeline,
         )
         return InferenceEngine(cfg, params=p, seed=0)
 
-    steps = 33 if quick else 65
+    # decode budget per request: enough chunks that several full
+    # dispatches land INSIDE the timed window (the admission drain runs
+    # the first chunk untimed; a budget <= one chunk would time nothing)
+    def steps_for(chunk):
+        return (2 if quick else 4) * chunk + 1
+
     # --- decode sweep: chunk x batch -----------------------------------------
     sweep = [(8, 16), (8, 32), (8, 64), (16, 32), (16, 64), (32, 64)]
     if quick:
@@ -136,7 +141,7 @@ def main() -> None:
                 max_new_tokens=4,
             )[0]
             compile_s = time.monotonic() - t0
-            toks = measure_decode(eng, steps)
+            toks = measure_decode(eng, steps_for(chunk))
             report(
                 f"decode_b{batch}_c{chunk}",
                 tok_s=round(toks, 1),
@@ -145,6 +150,20 @@ def main() -> None:
             del eng
         except Exception as e:  # noqa: BLE001
             report(f"decode_b{batch}_c{chunk}", error=str(e)[:200])
+
+    # --- pipelined decode at representative configs ---------------------------
+    for batch, chunk in ([(8, 32)] if quick else [(8, 16), (8, 32), (8, 64)]):
+        try:
+            eng = make_engine(batch, chunk, pipeline=True)
+            eng.generate(
+                [rng.integers(1, model.vocab_size, prompt_len).tolist()],
+                max_new_tokens=4,
+            )
+            toks = measure_decode(eng, steps_for(chunk))
+            report(f"decode_b{batch}_c{chunk}_pipelined", tok_s=round(toks, 1))
+            del eng
+        except Exception as e:  # noqa: BLE001
+            report(f"decode_b{batch}_c{chunk}_pipelined", error=str(e)[:200])
 
     # --- attention impl shootout (prefill-heavy + decode) --------------------
     for attn in ("grouped", "pallas"):
@@ -155,7 +174,7 @@ def main() -> None:
             t0 = time.monotonic()
             out = eng.generate([long_prompt], max_new_tokens=2)[0]
             prefill_s = time.monotonic() - t0
-            toks = measure_decode(eng, steps)
+            toks = measure_decode(eng, steps_for(32))
             report(
                 f"attn_{attn}",
                 decode_tok_s=round(toks, 1),
@@ -173,7 +192,7 @@ def main() -> None:
             [rng.integers(1, model.vocab_size, prompt_len).tolist()],
             max_new_tokens=4,
         )
-        toks = measure_decode(eng, steps)
+        toks = measure_decode(eng, steps_for(32))
         report("decode_int8_b8_c32", tok_s=round(toks, 1))
         del eng
     except Exception as e:  # noqa: BLE001
