@@ -115,18 +115,23 @@ def main() -> None:
             p = params
         if attn != "auto":
             m = dataclasses.replace(m, attention_impl=attn)
+        # KV capacity must cover the full admitted batch at this
+        # config's decode budget, or _admit defers requests and the
+        # timed window measures a shrinking batch instead of steady state
+        per_req = -(-(prompt_len + steps_for(chunk)) // 16)
         cfg = EngineConfig(
             model=m, max_batch=batch, page_size=16,
-            num_pages=max(512, batch * 16), max_seq_len=1024,
+            num_pages=max(512, batch * per_req + 8), max_seq_len=1024,
             decode_chunk=chunk, pipeline_decode=pipeline,
         )
         return InferenceEngine(cfg, params=p, seed=0)
 
     # decode budget per request: enough chunks that several full
-    # dispatches land INSIDE the timed window (the admission drain runs
-    # the first chunk untimed; a budget <= one chunk would time nothing)
+    # dispatches land INSIDE the timed window. The untimed admission
+    # drain consumes the prefill token plus one chunk, so a budget of
+    # N*chunk+1 leaves N-1 timed dispatches (2 in --quick, 3 otherwise).
     def steps_for(chunk):
-        return (2 if quick else 4) * chunk + 1
+        return (3 if quick else 4) * chunk + 1
 
     # --- decode sweep: chunk x batch -----------------------------------------
     sweep = [(8, 16), (8, 32), (8, 64), (16, 32), (16, 64), (32, 64)]

@@ -14,13 +14,14 @@ def tp2_mesh(devices8):
     return make_mesh(MeshPlan(dp=1, tp=2), devices8[:2])
 
 
-def make_engine(mesh=None):
+def make_engine(mesh=None, **overrides):
     cfg = EngineConfig(
         model=llama.LlamaConfig.tiny(),
         max_batch=2,
         page_size=8,
         num_pages=32,
         max_seq_len=64,
+        **overrides,
     )
     return InferenceEngine(cfg, mesh=mesh, seed=0)
 
@@ -54,3 +55,16 @@ def test_sharded_sleep_wake(tp2_mesh):
     wq = eng.params["layers"]["wq"]
     assert wq.sharding.shard_shape(wq.shape)[-1] == wq.shape[-1] // 2
     assert eng.generate([[3, 1, 4]], max_new_tokens=4)[0] == gold
+
+
+def test_pipeline_decode_matches_on_tp_mesh(tp2_mesh):
+    """Pipelined decode under a TP mesh: identical outputs to sequential
+    (the double-buffer must not disturb sharded scheduler state)."""
+    prompts = [[5, 6, 7, 8], [2, 4]]
+    gold = make_engine(tp2_mesh, decode_chunk=4).generate(
+        prompts, max_new_tokens=12
+    )
+    got = make_engine(
+        tp2_mesh, decode_chunk=4, pipeline_decode=True
+    ).generate(prompts, max_new_tokens=12)
+    assert got == gold
