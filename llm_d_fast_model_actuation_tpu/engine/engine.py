@@ -90,6 +90,15 @@ class EngineConfig:
     #: (remote/tunneled TPU hosts; docs/perf.md). Token delivery lags one
     #: chunk. Ignored under gang lockstep. Off by default.
     pipeline_decode: bool = False
+    #: Drain-tail policy when the batch's max remaining budget is below
+    #: decode_chunk: "single" dispatches T=1 steps (minimal wasted
+    #: compute — right when dispatch is cheap), "chunk" runs the full
+    #: chunk program once (finished slots freeze in-program, so up to
+    #: chunk-1 steps idle but up to chunk-1 dispatch round trips are
+    #: saved — right on high-latency links, and the T=1 program never
+    #: compiles). "auto" = chunk on TPU, single elsewhere. Outputs are
+    #: identical either way (chunk-length invariance).
+    drain_tail: str = "auto"
 
     @property
     def seq_len(self) -> int:
@@ -439,6 +448,17 @@ class InferenceEngine:
         self._spec_miss_streak = 0
         self._spec_cooldown = 0
         self._chunk_fns: Dict[int, Any] = {}
+        # resolve the drain-tail policy once (mirrors
+        # resolve_attention_impl): a typo must fail loudly, not silently
+        # behave as "single"
+        dt = cfg.drain_tail
+        if dt == "auto":
+            dt = "chunk" if jax.default_backend() == "tpu" else "single"
+        if dt not in ("single", "chunk"):
+            raise ValueError(
+                f"drain_tail must be auto|single|chunk, got {dt!r}"
+            )
+        self._drain_tail_chunk = dt == "chunk"
         #: pipelined decode: the dispatched-but-unread chunk, and requests
         #: whose retire awaits that chunk's completion (see _defer_retire)
         self._inflight: Optional[tuple] = None
@@ -1202,11 +1222,15 @@ class InferenceEngine:
         max_remaining = max(
             r.max_new_tokens - len(r.out_tokens) for r in running.values()
         )
-        # Exactly two compiled chunk programs (T=decode_chunk and T=1):
+        # At most two compiled chunk programs (T=decode_chunk and T=1):
         # compiles are expensive on TPU, and a serving engine at steady
         # state always has >= decode_chunk tokens of demand. The drain
-        # tail of a batch run falls back to single steps.
-        T = self.cfg.decode_chunk if max_remaining >= self.cfg.decode_chunk else 1
+        # tail of a batch run follows cfg.drain_tail (single steps, or
+        # one full chunk with the surplus steps frozen in-program).
+        if max_remaining >= self.cfg.decode_chunk or self._drain_tail_chunk:
+            T = self.cfg.decode_chunk
+        else:
+            T = 1
         reupload = self._dirty or self._dev is None
         if self.lockstep is not None:
             self.lockstep.chunk(T, reupload)

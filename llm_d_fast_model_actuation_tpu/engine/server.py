@@ -200,6 +200,14 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "token delivery lags one chunk; ignored in gangs)",
     )
     p.add_argument(
+        "--drain-tail",
+        choices=["auto", "single", "chunk"],
+        default="auto",
+        help="batch drain tail: single T=1 steps, or one full chunk with "
+        "surplus steps frozen in-program (saves up to chunk-1 dispatch "
+        "round trips; auto = chunk on TPU, single elsewhere)",
+    )
+    p.add_argument(
         "--max-prefill-tokens",
         type=int,
         default=0,
@@ -421,6 +429,7 @@ class EngineService:
                 pipeline_decode=(
                     getattr(args, "pipeline_decode", "off") == "on"
                 ),
+                drain_tail=getattr(args, "drain_tail", "auto"),
                 prefix_caching=args.prefix_caching == "on",
                 max_prefill_tokens=args.max_prefill_tokens,
                 speculative_ngram=args.speculative_ngram,

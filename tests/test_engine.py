@@ -562,3 +562,28 @@ def test_pipeline_decode_abort_mid_flight():
     if pipe.prefix_cache is not None:
         pipe.allocator.free(pipe.prefix_cache.clear())
     assert pipe.allocator.available == free0
+
+
+def test_drain_tail_chunk_matches_single():
+    """drain_tail='chunk' runs the full chunk program for the tail with
+    surplus steps frozen in-program — outputs identical to T=1 tails,
+    for mixed budgets (tails of different lengths per slot)."""
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    def run(tail):
+        cfg = EngineConfig(
+            model=llama.LlamaConfig.tiny(), max_batch=3, page_size=8,
+            num_pages=48, max_seq_len=64, decode_chunk=8, drain_tail=tail,
+        )
+        eng = InferenceEngine(cfg, seed=0)
+        # budgets 5/11/14: every request ends inside a tail, at different
+        # offsets; one sampled+seeded to cover RNG-stream identity
+        eng.add_request([5, 6, 7], max_new_tokens=5)
+        eng.add_request([9, 8], max_new_tokens=11, temperature=0.9, seed=3)
+        eng.add_request([1, 2, 3], max_new_tokens=14)
+        done = []
+        while eng.has_work():
+            done.extend(eng.step())
+        return sorted(tuple(r.out_tokens) for r in done)
+
+    assert run("single") == run("chunk")
