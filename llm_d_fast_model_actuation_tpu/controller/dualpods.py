@@ -160,6 +160,10 @@ class DualPodsController:
         self._count_keys: Tuple[Set[str], Set[str]] = (set(), set())
         self._unsub: Optional[Callable[[], None]] = None
         self._stopping = False
+        #: one-shot operator warning: namespace-scoped controller +
+        #: hostNetwork launchers = port-collision protection weaker than
+        #: the code path suggests (see _assign_launcher_port)
+        self._warned_hostnet_ns_scope = False
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._idle_event = asyncio.Event()
         self._inflight = 0
@@ -867,6 +871,21 @@ class DualPodsController:
         spec = pod.get("spec") or {}
         if not spec.get("hostNetwork"):
             return
+        if self.cfg.namespace and not self._warned_hostnet_ns_scope:
+            # Surface the scope caveat below as an operator-visible warning
+            # instead of a code comment: a namespace-scoped informer cannot
+            # provide the node-wide collision protection this scan implies.
+            self._warned_hostnet_ns_scope = True
+            logger.warning(
+                "hostNetwork launchers with a namespace-scoped controller "
+                "(namespace %r): the launcher-port collision scan only "
+                "sees this namespace's informer cache, so controller "
+                "instances watching OTHER namespaces can assign colliding "
+                "ports on shared nodes. Deploy the controller "
+                "cluster-scoped, or give each namespace a disjoint "
+                "launcher port range.",
+                self.cfg.namespace,
+            )
         used = set()
         # hostNetwork port space is node-wide, not namespace-wide: scan
         # every launcher pod the store knows about regardless of namespace

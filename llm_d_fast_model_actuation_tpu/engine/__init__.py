@@ -9,4 +9,5 @@ dual-pods controller speaks.
 
 from .kv_cache import PageAllocator, PagePool  # noqa: F401
 from .engine import EngineConfig, InferenceEngine  # noqa: F401
-from .sleep import SleepLevel, SleepManager  # noqa: F401
+from .model_pool import HostModelPool  # noqa: F401
+from .sleep import SleepLevel, SleepManager, swap_states  # noqa: F401

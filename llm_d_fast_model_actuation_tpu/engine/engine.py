@@ -601,6 +601,11 @@ class InferenceEngine:
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
+        if min(prompt) < 0 or max(prompt) >= self.cfg.model.vocab_size:
+            # out-of-range ids would be silently clamped by the embedding
+            # gather into garbage output; the HTTP layer pre-clamps, but a
+            # request racing a model hot-swap can carry the OLD vocab
+            raise ValueError("prompt token id outside vocab")
         if seed is not None and not (-(2**63) <= int(seed) < 2**63):
             # would overflow jax.random.key at admission, inside the
             # engine loop where it can't be attributed to this request
@@ -1193,7 +1198,19 @@ class InferenceEngine:
             running = self._running()
             nxt = None
             if running and not self._dirty and not self._waiting:
-                nxt = self._dispatch_chunk(running)
+                # End-of-batch tail: when every running request's remaining
+                # budget fits inside the chunk already in flight, that chunk
+                # finishes them all (budget exhaustion is unconditional, eos
+                # can only finish earlier) and a speculative chunk k+1 would
+                # be fully frozen — skip it and drain-then-dispatch at this
+                # boundary instead of burning a wasted chunk of device work
+                # plus one chunk of tail latency.
+                t_inflight = self._inflight[6]
+                if any(
+                    r.max_new_tokens - len(r.out_tokens) > t_inflight
+                    for r in running.values()
+                ):
+                    nxt = self._dispatch_chunk(running)
             inflight, self._inflight = self._inflight, None
             ready, self._pending_retire = self._pending_retire, []
             finished.extend(self._drain_chunk(inflight, defer_retire=True))

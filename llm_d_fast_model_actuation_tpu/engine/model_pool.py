@@ -1,0 +1,140 @@
+"""Host-side model pool: LRU registry of slept model runtimes.
+
+The hot-swap path (docs/engine.md "Model hot-swap") lets N models time-share
+one chip: the model being swapped out goes to sleep (level 1, host-resident
+state) and is *pooled* here instead of discarded, keyed by model id and
+bounded by a pinned-host byte budget. A later swap back is then a pure
+host->HBM restore — no checkpoint re-read, no recompile (the runtime keeps
+its compiled programs, which are host-resident and survive sleep).
+
+The pool stores opaque runtime entries (the engine server's model-runtime
+bundle); the only contract is that an evicted entry's host bytes are freed
+by the caller (the server escalates the evicted sleeper to level 2). LRU
+order is by swap-out recency: the model least recently *parked* is the
+first to lose its host residency under budget pressure — mirroring the
+multi-model scheduler policy in "Towards Multi-Model LLM Schedulers"
+(PAPERS.md) where victim selection is recency-driven.
+
+Mutations happen under the engine server's step lock, but observability
+reads (/metrics) come from other threads — an internal mutex makes every
+operation safe to call concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class PoolEntry:
+    model_id: str
+    runtime: Any  #: opaque bundle (engine + sleeper + tokenizer + ...)
+    nbytes: int  #: pinned-host bytes the slept state occupies
+    stored_at: float = field(default_factory=time.monotonic)
+
+
+class HostModelPool:
+    """LRU-evicted registry of slept models under a host byte budget.
+
+    ``budget_bytes <= 0`` disables pooling: every ``put`` immediately
+    returns its own entry as evicted, so the caller frees it and the next
+    swap-in is a cold build — the same code path, just with a zero cache.
+    """
+
+    def __init__(self, budget_bytes: int = 0) -> None:
+        self.budget_bytes = int(budget_bytes)
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[str, PoolEntry]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, model_id: str) -> bool:
+        return model_id in self._entries
+
+    @property
+    def bytes_used(self) -> int:
+        with self._mu:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def models(self) -> List[str]:
+        """Pooled model ids, LRU first."""
+        with self._mu:
+            return list(self._entries)
+
+    def take(self, model_id: str) -> Optional[PoolEntry]:
+        """Remove and return the entry for ``model_id`` (a pool hit — the
+        caller wakes it, so it leaves the pool), or None (miss)."""
+        with self._mu:
+            entry = self._entries.pop(model_id, None)
+            if entry is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return entry
+
+    def take_match(self, model_id: str) -> Optional[PoolEntry]:
+        """Remove and return the most-recently-parked entry pooled under
+        this model name regardless of checkpoint qualifier (keys are
+        ``name`` or ``name@checkpoint_dir``): a swap request that omits
+        checkpoint_dir means "this model, whatever source it came from"."""
+        with self._mu:
+            for key in reversed(self._entries):
+                if key == model_id or key.startswith(model_id + "@"):
+                    self.hits += 1
+                    return self._entries.pop(key)
+            self.misses += 1
+            return None
+
+    def put(self, model_id: str, runtime: Any, nbytes: int) -> List[PoolEntry]:
+        """Register a just-slept model as most-recently-used and evict LRU
+        entries until the byte budget holds. Returns the evicted entries
+        (possibly including the new one, when it alone exceeds the budget
+        or pooling is disabled); the caller must free their host state."""
+        entry = PoolEntry(model_id=model_id, runtime=runtime, nbytes=int(nbytes))
+        with self._mu:
+            # replacing an id re-registers it as most recent
+            old = self._entries.pop(model_id, None)
+            evicted: List[PoolEntry] = [old] if old is not None else []
+            if entry.nbytes > self.budget_bytes:
+                # the newcomer alone can never fit: evict IT, not the
+                # resident models that still can be hit
+                self.evictions += 1 + len(evicted)
+                return evicted + [entry]
+            self._entries[model_id] = entry
+            while (
+                sum(e.nbytes for e in self._entries.values())
+                > self.budget_bytes
+            ):
+                _, victim = self._entries.popitem(last=False)
+                evicted.append(victim)
+                self.evictions += 1
+            return evicted
+
+    def drain(self) -> List[PoolEntry]:
+        """Remove and return every entry (counted as evictions): the caller
+        is invalidating the pool wholesale — e.g. a device-releasing sleep
+        is about to destroy the client that owns the pooled states' pinned
+        host buffers and compiled programs."""
+        with self._mu:
+            out = list(self._entries.values())
+            self._entries.clear()
+            self.evictions += len(out)
+            return out
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "models": self.models(),
+            "bytes_used": self.bytes_used,
+            "budget_bytes": self.budget_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }

@@ -30,12 +30,14 @@ from __future__ import annotations
 import argparse
 import asyncio
 import concurrent.futures
+import contextlib
 import json
 import logging
 import os
 import shlex
 import threading
 import time
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 from aiohttp import web
@@ -44,7 +46,8 @@ from prometheus_client import Counter, Gauge, Histogram
 from ..models import llama
 from ..models.moe import MoeConfig
 from .engine import EngineConfig, InferenceEngine
-from .sleep import attach_sleep
+from .model_pool import HostModelPool
+from .sleep import attach_sleep, swap_states
 
 logger = logging.getLogger(__name__)
 
@@ -101,6 +104,47 @@ ENGINE_SPEC_ACCEPTED = Gauge(
     "fma_engine_spec_accepted_tokens",
     "Proposed tokens accepted by the verify forward",
     ["model"],
+)
+
+# Model hot-swap observability (docs/engine.md "Model hot-swap"): the swap
+# is the actuation hot path, so its latency, how much of it overlapped, and
+# the transfer window it held are all first-class operator signals.
+ENGINE_SWAP_SECONDS = Histogram(
+    "fma_engine_swap_seconds",
+    "Model hot-swap wall time (labeled by the incoming model)",
+    ["model"],
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60),
+)
+ENGINE_SWAPS = Counter(
+    "fma_engine_swaps_total",
+    "Completed model hot-swaps by source of the incoming state",
+    ["model", "source"],  # source: pool | cold
+)
+ENGINE_SWAP_OVERLAP_FRAC = Gauge(
+    "fma_engine_swap_overlap_fraction",
+    "Fraction of the last swap spent with both DMA directions in flight",
+    ["model"],
+)
+ENGINE_SWAP_INFLIGHT_BYTES = Gauge(
+    "fma_engine_swap_peak_bytes_in_flight",
+    "Peak transfer bytes in flight during the last swap",
+    ["model"],
+)
+ENGINE_POOL_BYTES = Gauge(
+    "fma_engine_model_pool_bytes",
+    "Pinned-host bytes held by pooled (slept) models",
+)
+ENGINE_POOL_MODELS = Gauge(
+    "fma_engine_model_pool_models",
+    "Models resident in the host model pool",
+)
+ENGINE_POOL_HITS = Counter(
+    "fma_engine_model_pool_hits",
+    "Swap-ins served from the host model pool (no checkpoint re-read)",
+)
+ENGINE_POOL_EVICTIONS = Counter(
+    "fma_engine_model_pool_evictions",
+    "Pooled models evicted (budget pressure or device release)",
 )
 
 MODEL_CONFIGS = {
@@ -237,6 +281,23 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "the chip (auto = on for TPU, off elsewhere)",
     )
     p.add_argument(
+        "--model-pool-mib",
+        type=int,
+        default=4096,
+        help="pinned-host byte budget (MiB) for the slept-model pool "
+        "backing POST /v1/swap: models swapped out stay host-resident up "
+        "to this budget so swapping back re-reads no checkpoint; 0 "
+        "disables pooling (every swap-in is a cold build)",
+    )
+    p.add_argument(
+        "--swap-bucket-mib",
+        type=int,
+        default=256,
+        help="transfer bucket size (MiB) for chunked sleep/wake and "
+        "overlapped hot-swap: bounds peak extra HBM and the in-flight "
+        "DMA window to ~one bucket per direction",
+    )
+    p.add_argument(
         "--tokenizer",
         default="",
         help="HF tokenizer directory (text prompts, chat templates, stop "
@@ -305,6 +366,10 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--max-prefill-tokens must be >= 0")
     if args.speculative_ngram < 0:
         raise ValueError("--speculative-ngram must be >= 0")
+    if getattr(args, "model_pool_mib", 0) < 0:
+        raise ValueError("--model-pool-mib must be >= 0")
+    if getattr(args, "swap_bucket_mib", 1) < 1:
+        raise ValueError("--swap-bucket-mib must be >= 1")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -317,12 +382,41 @@ def parse_engine_options(options: str) -> argparse.Namespace:
     return args
 
 
+def _pool_key(model: str, checkpoint_dir: str) -> str:
+    """Identity of a pooled model: the same model name restored from a
+    different checkpoint is a different set of weights."""
+    return f"{model}@{checkpoint_dir}" if checkpoint_dir else model
+
+
+@dataclass
+class _ModelRuntime:
+    """Everything model-specific the service owns: swapping models means
+    swapping this bundle. A pooled (slept) runtime keeps its engine object
+    — and with it the compiled programs, which are host-resident — so a
+    swap-back recompiles nothing and re-reads no checkpoint."""
+
+    model_id: str
+    engine: InferenceEngine
+    sleeper: Any
+    tokenizer: Any
+    hf_dir: str
+    checkpoint_dir: str
+
+
 class EngineService:
-    """Thread-hosted engine with an async-facing submit/sleep API."""
+    """Thread-hosted engine with an async-facing submit/sleep/swap API."""
 
     def __init__(self, args: argparse.Namespace) -> None:
         self.args = args
         self._lock = threading.Lock()  # serializes device work vs sleep edges
+        #: admin calls (sleep/wake/swap) waiting on the step lock: the
+        #: engine loop re-acquires it hot (back-to-back steps), which can
+        #: starve a parked waiter for a whole generation — the loop yields
+        #: briefly when this is non-zero so the admin op lands promptly.
+        #: Counter updates are guarded: a lost update from two racing
+        #: admin calls would leave it non-zero (or negative) forever.
+        self._admin_waiting = 0
+        self._admin_count_lock = threading.Lock()
         self._new_work = threading.Event()
         self._stop = False
         self._futures: Dict[int, concurrent.futures.Future] = {}
@@ -351,25 +445,153 @@ class EngineService:
             import jax
 
             jax.distributed.initialize(**dist)
-        self.hf_dir = ""
+        # Multi-host lockstep roles (engine/multihost.py): process 0 leads
+        # (serves + broadcasts control frames); others follow (replay).
+        self.process_id = dist["process_id"] if dist else 0
+        self.is_follower = dist is not None and self.process_id > 0
+        self.watchdog = None
+        hb_timeout = float(
+            os.environ.get("FMA_GANG_HEARTBEAT_TIMEOUT", "20") or 0
+        )
+        if dist is not None and hb_timeout > 0:
+            # Data-plane failure detection (engine/multihost.py): a dead
+            # gang member must become a non-zero exit on every other
+            # member within the timeout — collectives can't unwind a
+            # wedged lockstep in-process. FMA_GANG_HEARTBEAT_TIMEOUT=0
+            # disables (tests that kill members deliberately).
+            # Started HERE — right after jax.distributed.initialize,
+            # before any checkpoint load — so members heartbeat (and
+            # answer probes) through the whole engine init: cross-host
+            # init skew from one host cold-loading a multi-GB checkpoint
+            # no longer burns FMA_GANG_JOIN_GRACE and tears down a
+            # healthy forming gang. The grace now only has to cover the
+            # distributed client forming itself.
+            from .multihost import GangWatchdog
+
+            self.watchdog = GangWatchdog(
+                process_id=self.process_id,
+                num_processes=dist["num_processes"],
+                coordinator_address=dist["coordinator_address"],
+                timeout=hb_timeout,
+                join_grace=float(
+                    os.environ.get("FMA_GANG_JOIN_GRACE", "60") or 60
+                ),
+            )
+            self.watchdog.start()
+        # Host model pool + chunked-transfer sizing (docs/engine.md
+        # "Model hot-swap"): models swapped out stay host-resident up to
+        # the budget, so swapping back re-reads no checkpoint.
+        self.model_pool = HostModelPool(
+            budget_bytes=max(0, getattr(args, "model_pool_mib", 4096)) << 20
+        )
+        self._swap_bucket_bytes = (
+            max(1, getattr(args, "swap_bucket_mib", 256)) << 20
+        )
+        #: cold runtime builds (checkpoint / HF read or random init); a
+        #: pool hit on swap does NOT increment it — the zero-re-read
+        #: contract the swap e2e test pins
+        self.builds_total = 0
+        self.last_swap: Dict[str, Any] = {}
+        self._install_runtime(
+            self._build_runtime(
+                args.model, getattr(args, "checkpoint_dir", "") or ""
+            )
+        )
+        import jax  # deliberately not module-level: parse-time must not touch a backend
+
+        mode = getattr(args, "sleep_release_devices", "auto")
+        self.release_on_sleep = (
+            mode == "always"
+            or (mode == "auto" and jax.default_backend() == "tpu")
+        )
+        if dist is not None:
+            # gang sleep is offload-only: device release would require
+            # every process to drop and re-join the distributed client in
+            # lockstep (engine/sleep.py raises on it)
+            self.release_on_sleep = False
+        if dist is not None and not self.is_follower:
+            from .multihost import LockstepLeader
+
+            self.engine.lockstep = LockstepLeader(self.engine)
+        self._publisher = self._make_publisher()
+        self._publish_usage()
+        self._thread = threading.Thread(
+            target=self._run_follower if self.is_follower else self._run,
+            daemon=True,
+            name="engine-loop",
+        )
+        self._thread.start()
+
+    def _abort_engine_work(self, reason: str, exc: Exception) -> int:
+        """Abort everything waiting or in flight in the engine and fail the
+        matching futures (state-loss edges: level-2 wake, model swap).
+        Caller holds the step lock."""
+        aborted = self.engine.abort_all(reason)
+        ENGINE_ABORTS.labels(model=self.args.model).inc(len(aborted))
+        for req in aborted:
+            fut = self._futures.pop(req.seq_id, None)
+            if fut is not None:
+                self._fut_seq.pop(id(fut), None)
+                if not fut.done():
+                    fut.set_exception(exc)
+        return len(aborted)
+
+    def _free_pooled(self, victims, why: str) -> None:
+        """Release evicted pool entries' pinned-host bytes: escalating the
+        slept runtime to level 2 is exactly 'drop the host copy'."""
+        ENGINE_POOL_EVICTIONS.inc(len(victims))
+        for victim in victims:
+            try:
+                victim.runtime.sleeper.sleep(2)
+            except Exception:
+                logger.warning(
+                    "failed to free pooled model %s (%s)",
+                    victim.model_id, why, exc_info=True,
+                )
+
+    @contextlib.contextmanager
+    def _admin_lock(self):
+        """The step lock, for admin edges (sleep/wake/swap): registers as a
+        waiter so the engine loop hands the lock over between steps instead
+        of re-acquiring it hot (an unfair lock can otherwise starve the
+        admin call until the whole running generation finishes)."""
+        with self._admin_count_lock:
+            self._admin_waiting += 1
+        try:
+            with self._lock:
+                yield
+        finally:
+            with self._admin_count_lock:
+                self._admin_waiting -= 1
+
+    # -- model runtimes (build / install / hot-swap) -------------------------
+
+    def _build_runtime(
+        self, model_id: str, checkpoint_dir: str = ""
+    ) -> _ModelRuntime:
+        """Cold-build an awake runtime for `model_id`: config -> tokenizer
+        -> params (checkpoint / HF read, or random init) -> engine ->
+        sleeper. Pool hits on swap bypass this entirely."""
+        args = self.args
+        hf_dir = ""
         eos_token_id = args.eos_token_id
         extra_eos: tuple = ()
-        if args.model.startswith("hf:"):
+        if model_id.startswith("hf:"):
             from ..models import hf as hf_models
 
-            self.hf_dir = args.model[3:]
+            hf_dir = model_id[3:]
             model_cfg = hf_models.config_from_hf(
-                self.hf_dir, quantization=args.quantization or ""
+                hf_dir, quantization=args.quantization or ""
             )
             if eos_token_id < 0:
-                all_eos = hf_models.eos_token_ids_from_hf(self.hf_dir)
+                all_eos = hf_models.eos_token_ids_from_hf(hf_dir)
                 if all_eos:
                     # Llama-3-Instruct style multi-eos: chat turns end
                     # with <|eot_id|>, not the primary eos
                     eos_token_id = all_eos[0]
                     extra_eos = tuple(all_eos[1:])
         else:
-            model_cfg = MODEL_CONFIGS[args.model]()
+            model_cfg = MODEL_CONFIGS[model_id]()
             if args.quantization and model_cfg.quantization != args.quantization:
                 import dataclasses
 
@@ -381,17 +603,17 @@ class EngineService:
         tok_path = getattr(args, "tokenizer", "") or ""
         if (
             not tok_path
-            and self.hf_dir
-            and tokenizer_mod.has_tokenizer_files(self.hf_dir)
+            and hf_dir
+            and tokenizer_mod.has_tokenizer_files(hf_dir)
         ):
-            tok_path = self.hf_dir
-        self.tokenizer = tokenizer_mod.load_tokenizer(tok_path)
-        if eos_token_id < 0 and self.hf_dir:
+            tok_path = hf_dir
+        tokenizer = tokenizer_mod.load_tokenizer(tok_path)
+        if eos_token_id < 0 and hf_dir:
             # last resort: the tokenizer knows its eos even when neither
             # config.json nor generation_config.json declares one
             eos_token_id = (
-                self.tokenizer.eos_token_id
-                if self.tokenizer.eos_token_id is not None
+                tokenizer.eos_token_id
+                if tokenizer.eos_token_id is not None
                 else -1
             )
         mesh = None
@@ -400,21 +622,20 @@ class EngineService:
 
             mesh = make_mesh(MeshPlan(tp=args.tensor_parallel_size))
         params = None
-        self.checkpoint_dir = getattr(args, "checkpoint_dir", "") or ""
-        if self.checkpoint_dir:
+        if checkpoint_dir:
             from ..models import checkpoint
 
             params = checkpoint.load_params(
-                self.checkpoint_dir, model_cfg, mesh=mesh
+                checkpoint_dir, model_cfg, mesh=mesh
             )
-        elif self.hf_dir:
+        elif hf_dir:
             from ..models import hf as hf_models
 
             # host-side load; InferenceEngine shards onto the mesh
-            params = hf_models.load_params(self.hf_dir, model_cfg)
+            params = hf_models.load_params(hf_dir, model_cfg)
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
-        self.engine = InferenceEngine(
+        engine = InferenceEngine(
             EngineConfig(
                 model=model_cfg,
                 max_batch=args.max_batch,
@@ -439,58 +660,202 @@ class EngineService:
             mesh=mesh,
             seed=args.seed,
         )
-        self.sleeper = attach_sleep(self.engine)
-        mode = getattr(args, "sleep_release_devices", "auto")
-        self.release_on_sleep = (
-            mode == "always"
-            or (mode == "auto" and jax.default_backend() == "tpu")
+        sleeper = attach_sleep(engine, bucket_bytes=self._swap_bucket_bytes)
+        self.builds_total += 1
+        return _ModelRuntime(
+            model_id=model_id,
+            engine=engine,
+            sleeper=sleeper,
+            tokenizer=tokenizer,
+            hf_dir=hf_dir,
+            checkpoint_dir=checkpoint_dir,
         )
-        if dist is not None:
-            # gang sleep is offload-only: device release would require
-            # every process to drop and re-join the distributed client in
-            # lockstep (engine/sleep.py raises on it)
-            self.release_on_sleep = False
-        # Multi-host lockstep roles (engine/multihost.py): process 0 leads
-        # (serves + broadcasts control frames); others follow (replay).
-        self.process_id = dist["process_id"] if dist else 0
-        self.is_follower = dist is not None and self.process_id > 0
-        if dist is not None and not self.is_follower:
-            from .multihost import LockstepLeader
 
-            self.engine.lockstep = LockstepLeader(self.engine)
-        self.watchdog = None
-        hb_timeout = float(
-            os.environ.get("FMA_GANG_HEARTBEAT_TIMEOUT", "20") or 0
-        )
-        if dist is not None and hb_timeout > 0:
-            # Data-plane failure detection (engine/multihost.py): a dead
-            # gang member must become a non-zero exit on every other
-            # member within the timeout — collectives can't unwind a
-            # wedged lockstep in-process. FMA_GANG_HEARTBEAT_TIMEOUT=0
-            # disables (tests that kill members deliberately).
-            # FMA_GANG_JOIN_GRACE covers startup skew: members heartbeat
-            # only after their full engine init, and a multi-GB checkpoint
-            # load can lag one host far behind another.
-            from .multihost import GangWatchdog
+    def _install_runtime(self, rt: _ModelRuntime) -> None:
+        """Point the service at a runtime (initial build or swap). The
+        bundle is kept whole in `_runtime` (what a swap-out pools); the
+        flat attributes mirror it for the many existing access sites, and
+        `args.model` is the single source of the current model name —
+        metrics labels, /v1/models, and launcher status all follow it."""
+        self._runtime = rt
+        self.engine = rt.engine
+        self.sleeper = rt.sleeper
+        self.tokenizer = rt.tokenizer
+        self.hf_dir = rt.hf_dir
+        self.checkpoint_dir = rt.checkpoint_dir
+        self.args.model = rt.model_id
 
-            self.watchdog = GangWatchdog(
-                process_id=self.process_id,
-                num_processes=dist["num_processes"],
-                coordinator_address=dist["coordinator_address"],
-                timeout=hb_timeout,
-                join_grace=float(
-                    os.environ.get("FMA_GANG_JOIN_GRACE", "60") or 60
-                ),
+    def _current_runtime(self) -> _ModelRuntime:
+        return self._runtime
+
+    def swap(self, model: str, checkpoint_dir: str = "") -> Dict[str, Any]:
+        """Hot-swap the model this chip serves (POST /v1/swap): stream the
+        current model's state to the host pool while the target's
+        host-resident state streams back in, chunked and double-buffered
+        (engine/sleep.py swap_states) so the two DMA directions overlap.
+        Pool miss = cold build (checkpoint / HF / random init) after a
+        chunked offload. No process restart, no chip release: the
+        launcher's ChipLedger holder is unchanged."""
+        if self.is_follower or self.engine.lockstep is not None:
+            raise ValueError(
+                "model hot-swap is not supported for multi-host gangs"
             )
-            self.watchdog.start()
-        self._publisher = self._make_publisher()
+        if model.startswith("hf:"):
+            if not model[3:]:
+                raise ValueError("swap model hf: needs a directory path")
+        elif model not in MODEL_CONFIGS:
+            raise ValueError(
+                f"unknown model {model!r}; known: {sorted(MODEL_CONFIGS)} "
+                "or hf:<model-dir>"
+            )
+        with self._admin_lock():
+            previous = self.args.model
+            if model == previous and (
+                not checkpoint_dir or checkpoint_dir == self.checkpoint_dir
+            ):
+                return {
+                    "model": model,
+                    "previous_model": previous,
+                    "checkpoint_dir": self.checkpoint_dir,
+                    "swapped": False,
+                    "pool": self.model_pool.describe(),
+                }
+            if self.sleeper.is_sleeping:
+                raise ValueError(
+                    "engine is sleeping; wake_up before swapping models"
+                )
+            t0 = time.monotonic()
+            # In-flight AND still-queued work targets the outgoing model
+            # (queued prompts were validated against its vocab): fail it
+            # now. An otherwise-idle engine keeps its prefix cache — pages
+            # move bit-exact, so a swap-back resumes with a warm cache.
+            exc = RuntimeError(
+                f"aborted by model swap ({previous} -> {model})"
+            )
+            # drain one entry at a time: submit() appends lock-free from
+            # other threads, and an iterate+clear would drop (and never
+            # resolve) an entry appended mid-loop; pop/append on a list
+            # are individually atomic
+            while self._pending:
+                fut = self._pending.pop(0)[3]
+                if not fut.done():
+                    fut.set_exception(exc)
+            if self.engine.has_work():
+                self._abort_engine_work(
+                    f"model swapped out for {model}", exc
+                )
+            outgoing = self._current_runtime()
+            # the pool key carries the checkpoint identity: the same model
+            # name from a different checkpoint is a different model. A
+            # request WITHOUT a checkpoint_dir means "this model, whatever
+            # source it came from" — otherwise the natural swap-back
+            # {"model": X} would miss a pooled X@/ckpt and silently
+            # cold-build random weights under the same name.
+            if checkpoint_dir:
+                entry = self.model_pool.take(
+                    _pool_key(model, checkpoint_dir)
+                )
+            else:
+                entry = self.model_pool.take_match(model)
+            pool_hit = entry is not None
+            if pool_hit:
+                rt = entry.runtime
+                try:
+                    metrics = swap_states(
+                        outgoing.sleeper,
+                        rt.sleeper,
+                        bucket_bytes=self._swap_bucket_bytes,
+                    )
+                except ValueError:
+                    # precondition rejections fire before any transfer:
+                    # the pooled entry is still intact — put it back under
+                    # ITS key (a checkpoint-less request may have matched
+                    # a checkpoint-qualified entry)
+                    self.model_pool.put(entry.model_id, rt, entry.nbytes)
+                    raise
+                except Exception as e:
+                    # mid-transfer failure (e.g. HBM OOM streaming in a
+                    # larger model): both models' state is partially moved
+                    # and unrecoverable in-process — fail the service
+                    # loudly so /health flips and the controller heals us,
+                    # instead of serving from half-deleted arrays
+                    self.failure = (
+                        f"hot-swap {previous}->{model} failed "
+                        f"mid-transfer: {type(e).__name__}: {e}"
+                    )
+                    self._fail_all(RuntimeError(self.failure))
+                    raise
+            else:
+                # Cold: stream the old model out first (HBM bounded by the
+                # sleeper's bucket size), then build the new one into the
+                # freed space.
+                self.sleeper.sleep(1)
+                try:
+                    rt = self._build_runtime(model, checkpoint_dir)
+                except Exception:
+                    # a failed build must not leave the chip serving nothing
+                    self.sleeper.wake_up()
+                    raise
+                metrics = {
+                    "swap_total_s": 0.0,  # finalized below
+                    "d2h_s": outgoing.sleeper.stats.last_sleep_seconds,
+                    "h2d_s": 0.0,
+                    "overlap_s": 0.0,
+                    "overlap_frac": 0.0,
+                    "bytes_out": outgoing.sleeper.stats.bytes_offloaded,
+                    "bytes_in": 0,
+                    "buckets_out": 0,
+                    "buckets_in": 0,
+                    "bucket_bytes": self._swap_bucket_bytes,
+                    "peak_bytes_in_flight": 0,
+                }
+            evicted = self.model_pool.put(
+                _pool_key(previous, outgoing.checkpoint_dir),
+                outgoing,
+                nbytes=outgoing.sleeper.stats.bytes_offloaded,
+            )
+            self._free_pooled(evicted, "evicted over pool budget")
+            self._install_runtime(rt)
+            total = time.monotonic() - t0
+            metrics["swap_total_s"] = total
+            ENGINE_SWAP_SECONDS.labels(model=model).observe(total)
+            ENGINE_SWAPS.labels(
+                model=model, source="pool" if pool_hit else "cold"
+            ).inc()
+            if pool_hit:
+                ENGINE_POOL_HITS.inc()
+            ENGINE_SWAP_OVERLAP_FRAC.labels(model=model).set(
+                metrics.get("overlap_frac", 0.0)
+            )
+            ENGINE_SWAP_INFLIGHT_BYTES.labels(model=model).set(
+                metrics.get("peak_bytes_in_flight", 0)
+            )
+            self.last_swap = {
+                "model": model,
+                "previous_model": previous,
+                # the installed runtime's checkpoint identity (pooled
+                # runtimes remember theirs): the launcher rewrites its
+                # stored options from THIS, not from the request, so a
+                # restart rebuilds what the chip actually serves
+                "checkpoint_dir": rt.checkpoint_dir,
+                "swapped": True,
+                "pool_hit": pool_hit,
+                **{
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in metrics.items()
+                },
+                "builds_total": self.builds_total,
+                "pool": self.model_pool.describe(),
+            }
+            out = dict(self.last_swap)
         self._publish_usage()
-        self._thread = threading.Thread(
-            target=self._run_follower if self.is_follower else self._run,
-            daemon=True,
-            name="engine-loop",
+        self._new_work.set()
+        logger.info(
+            "hot-swapped model %s -> %s (pool_hit=%s, %.3fs, overlap %.0f%%)",
+            previous, model, pool_hit, total,
+            100 * metrics.get("overlap_frac", 0.0),
         )
-        self._thread.start()
+        return out
 
     def _make_publisher(self):
         chip_ids = [c for c in os.environ.get("FMA_CHIP_IDS", "").split(",") if c]
@@ -537,6 +902,7 @@ class EngineService:
 
     def _run(self) -> None:
         while not self._stop:
+            stepped = False
             try:
                 with self._lock:
                     self._drain_aborts()
@@ -574,12 +940,20 @@ class EngineService:
                                         fut.set_result(req)
                                 self._observe_finished(req)
                             self._observe_kv_usage()
-                            continue
+                            stepped = True
             except Exception as e:  # device/runtime failure: fail loudly
                 logger.exception("engine loop failed")
                 self.failure = f"{type(e).__name__}: {e}"
                 self._fail_all(RuntimeError(self.failure))
                 return
+            if stepped:
+                if self._admin_waiting:
+                    # hand the just-released lock to the waiting
+                    # sleep/wake/swap instead of re-grabbing it hot — an
+                    # unfair lock can starve the admin call for a whole
+                    # generation
+                    time.sleep(0.002)
+                continue
             self._new_work.wait(timeout=0.05)
             self._new_work.clear()
 
@@ -696,7 +1070,7 @@ class EngineService:
             # never reach followers (their replay would raise and kill the
             # follower loop, deadlocking the gang's next collective)
             raise ValueError("sleep level must be 1 or 2")
-        with self._lock:
+        with self._admin_lock():
             if self.engine.lockstep is not None:
                 if level >= 2:
                     raise ValueError(
@@ -704,6 +1078,16 @@ class EngineService:
                         "gangs (followers cannot replay the reinit)"
                     )
                 self.engine.lockstep.sleep(level, self.release_on_sleep)
+            if self.release_on_sleep and len(self.model_pool):
+                # Device release destroys the PJRT client that owns the
+                # pooled models' pinned-host state and host-resident
+                # executables — a later pool hit would stream from dead
+                # buffers. Drop the pool first (their next swap-in
+                # cold-builds), freeing the host copies while the client
+                # is still alive.
+                self._free_pooled(
+                    self.model_pool.drain(), "device release"
+                )
             out = self.sleeper.sleep(level, release=self.release_on_sleep)
         self._publish_usage()
         return out
@@ -714,21 +1098,16 @@ class EngineService:
                 "deferred": True,
                 "reason": "gang follower; wake is driven by the leader",
             }
-        with self._lock:
+        with self._admin_lock():
             if self.engine.lockstep is not None and self.sleeper.is_sleeping:
                 self.engine.lockstep.wake()
             if self.sleeper.level == 2:
                 # KV state is gone: abort anything mid-generation before the
                 # fresh state arrives, then rebuild params+pool in place.
-                aborted = self.engine.abort_all("level-2 sleep discarded state")
-                ENGINE_ABORTS.labels(model=self.args.model).inc(len(aborted))
-                exc = RuntimeError("aborted by level-2 sleep (KV discarded)")
-                for req in aborted:
-                    fut = self._futures.pop(req.seq_id, None)
-                    if fut is not None:
-                        self._fut_seq.pop(id(fut), None)
-                        if not fut.done():
-                            fut.set_exception(exc)
+                self._abort_engine_work(
+                    "level-2 sleep discarded state",
+                    RuntimeError("aborted by level-2 sleep (KV discarded)"),
+                )
                 eng = self.engine
                 m = eng.cfg.model
 
@@ -841,10 +1220,25 @@ def _finish_reason(service: "EngineService", req: Any) -> str:
     )
 
 
+class _CurrentTokenizer:
+    """Tokenizer handle that always delegates to the service's *current*
+    tokenizer, so handler closures built once at app construction follow
+    model hot-swaps."""
+
+    def __init__(self, service: EngineService) -> None:
+        self._service = service
+
+    def __getattr__(self, name: str):
+        return getattr(self._service.tokenizer, name)
+
+
 def build_app(service: EngineService) -> web.Application:
     app = web.Application()
-    vocab = service.engine.cfg.model.vocab_size
-    tok = service.tokenizer
+    # read per-request, never captured: both change on a model hot-swap
+    tok = _CurrentTokenizer(service)
+
+    def _vocab() -> int:
+        return service.engine.cfg.model.vocab_size
 
     def _encode_prompt(prompt: Any) -> List[int]:
         if isinstance(prompt, list):
@@ -899,6 +1293,25 @@ def build_app(service: EngineService) -> web.Application:
         )
         return web.json_response(info)
 
+    async def swap(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            raise web.HTTPBadRequest(text="swap requires a 'model' string")
+        ckpt = body.get("checkpoint_dir") or ""
+        if not isinstance(ckpt, str):
+            raise web.HTTPBadRequest(text="checkpoint_dir must be a string")
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: service.swap(model, ckpt)
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(info)
+
     async def models(request: web.Request) -> web.Response:
         return web.json_response(
             {"object": "list", "data": [{"id": service.args.model, "object": "model"}]}
@@ -921,6 +1334,9 @@ def build_app(service: EngineService) -> web.Application:
             ENGINE_SPEC_ACCEPTED.labels(model=service.args.model).set(
                 service.engine.spec_accepted
             )
+        pool = service.model_pool
+        ENGINE_POOL_BYTES.set(pool.bytes_used)
+        ENGINE_POOL_MODELS.set(len(pool))
         return web.Response(
             body=generate_latest(),
             content_type="text/plain",
@@ -937,6 +1353,7 @@ def build_app(service: EngineService) -> web.Application:
         so re-encoding strings into token sequences would miss matches."""
         if stop is None:
             return (), ()
+        vocab = _vocab()
         if isinstance(stop, str):
             stop = [stop]
         if not isinstance(stop, list):
@@ -958,6 +1375,7 @@ def build_app(service: EngineService) -> web.Application:
         return tuple(s for s in seqs if s), tuple(t for t in texts if t)
 
     def _parse_generation(body: Dict[str, Any], tokens: List[int]):
+        vocab = _vocab()
         tokens = [t % vocab for t in tokens]
         if not tokens:
             raise ValueError("empty prompt")
@@ -1513,6 +1931,7 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
+    app.router.add_post("/v1/swap", swap)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)

@@ -26,14 +26,26 @@ Backends without host memory-space support (CPU tests) fall back to
 numpy staging buffers — same state machine, same API. Release mode works on
 every backend (CPU client re-init is supported), so the full release state
 machine is exercised by the CPU suite.
+
+**Chunked transfers** (``bucket_bytes``): the offloadable pytree is split
+into size-bounded buckets of whole leaves and moved bucket-by-bucket, each
+bucket's HBM freed (offload) or host copy released (wake) as soon as it
+lands. This bounds the peak duplicated state to ~one bucket instead of a
+whole model tree, and bounds the in-flight transfer window (the
+SLO-guarantee lever from "Memory Offloading for LLM Inference with Latency
+SLO Guarantees", PAPERS.md). ``bucket_bytes=None`` keeps the legacy
+whole-tree single batched transfer. ``swap_states`` builds on the same
+buckets to overlap one model's offload with another's restore — the
+hot-swap fast path (docs/engine.md "Model hot-swap").
 """
 
 from __future__ import annotations
 
 import enum
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -44,6 +56,37 @@ from .device import (
     release_devices,
     sharding_spec,
 )
+
+#: Default transfer bucket for chunked/overlapped swaps: large enough to
+#: amortize per-transfer dispatch, small enough that peak extra HBM and the
+#: in-flight window stay a fraction of any serving-size model.
+DEFAULT_SWAP_BUCKET_BYTES = 256 << 20
+
+
+def partition_buckets(
+    nbytes: Sequence[int], bucket_bytes: Optional[int]
+) -> List[List[int]]:
+    """Greedy contiguous partition of leaf indices into buckets of at most
+    ``bucket_bytes`` each. Leaves are never split (bit-exactness is then
+    structural), so a single leaf larger than the bound forms its own
+    bucket. ``bucket_bytes=None`` (or <= 0) returns one bucket holding
+    everything — the whole-tree legacy path."""
+    if not nbytes:
+        return []
+    if not bucket_bytes or bucket_bytes <= 0:
+        return [list(range(len(nbytes)))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, nb in enumerate(nbytes):
+        if cur and cur_bytes + nb > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 class SleepLevel(enum.IntEnum):
@@ -81,6 +124,9 @@ class SleepManager:
     ``on_reacquire`` (optional) runs after a released client is re-created,
     before state restore — the engine uses it to rebuild device-bound
     objects (its mesh).
+
+    ``bucket_bytes`` (optional) chunks offload and restore into size-bounded
+    transfer buckets (see module docstring); None = whole-tree transfers.
     """
 
     def __init__(
@@ -88,10 +134,12 @@ class SleepManager:
         get_state,
         set_state,
         on_reacquire: Optional[Callable[[], None]] = None,
+        bucket_bytes: Optional[int] = None,
     ) -> None:
         self._get_state = get_state
         self._set_state = set_state
         self._on_reacquire = on_reacquire
+        self.bucket_bytes = bucket_bytes
         self._level = SleepLevel.AWAKE
         self._host_state: Optional[Any] = None
         self._shardings: Optional[Any] = None  # sharding objects (no release)
@@ -118,6 +166,67 @@ class SleepManager:
     def devices_released(self) -> bool:
         return self._released
 
+    # -- chunked transfer primitives -----------------------------------------
+
+    def _offload_leaves(self, leaves: list, to_numpy: bool) -> list:
+        """Device -> host, bucket by bucket: each bucket's device HBM is
+        freed as soon as its host copy lands, so peak duplicated state is
+        ~one bucket (whole tree when bucket_bytes is None — one batched
+        transfer, the round-trip-optimal default on high-latency links).
+
+        ``to_numpy`` stages into plain numpy (release path / no
+        memory-kind backend); otherwise into pinned_host jax arrays."""
+        host: list = [None] * len(leaves)
+        buckets = partition_buckets(
+            [x.nbytes for x in leaves], self.bucket_bytes
+        )
+        for bucket in buckets:
+            if to_numpy:
+                # force materialized copies: device_get can return views
+                # aliasing the device buffer on CPU-family backends, and a
+                # staging buffer must survive the buffer delete below (and
+                # client destruction on the release path) on its own
+                copies = [
+                    np.array(h, copy=True)
+                    for h in jax.device_get([leaves[i] for i in bucket])
+                ]
+            else:
+                copies = jax.device_put(
+                    [leaves[i] for i in bucket],
+                    [
+                        leaves[i].sharding.with_memory_kind("pinned_host")
+                        for i in bucket
+                    ],
+                )
+                copies = jax.block_until_ready(copies)
+            for i, h in zip(bucket, copies):
+                host[i] = h
+            for i in bucket:
+                leaves[i].delete()
+        return host
+
+    def _restore_leaves(
+        self, leaves: list, targets: list, free_host: bool
+    ) -> list:
+        """Host -> device, bucket by bucket: each bucket blocks before the
+        next is issued (bounds the in-flight transfer window) and, with
+        ``free_host``, releases its pinned-host source as it lands."""
+        out: list = [None] * len(leaves)
+        buckets = partition_buckets(
+            [x.nbytes for x in leaves], self.bucket_bytes
+        )
+        for bucket in buckets:
+            restored = jax.device_put(
+                [leaves[i] for i in bucket], [targets[i] for i in bucket]
+            )
+            restored = jax.block_until_ready(restored)
+            for i, d in zip(bucket, restored):
+                out[i] = d
+            if free_host:
+                for i in bucket:
+                    leaves[i].delete()
+        return out
+
     # -- edges ---------------------------------------------------------------
 
     def sleep(self, level: int = 1, release: bool = False) -> Dict[str, Any]:
@@ -137,7 +246,11 @@ class SleepManager:
                     for leaf in jax.tree.leaves(self._host_state):
                         leaf.delete()
                 self._host_state = None
+                # staged multi-host shards (and their reassembly metadata)
+                # are host RAM too: escalation must free all of it
                 self._staged = None
+                self._staged_meta = None
+                self._treedef = None
                 self._level = SleepLevel.L2_DISCARD
                 self.stats.bytes_offloaded = 0
             return self.describe()
@@ -153,10 +266,13 @@ class SleepManager:
             ]
             self._shardings = None
             if level == SleepLevel.L1_HOST_OFFLOAD:
-                # one batched fetch (per-leaf np.asarray pays one round
-                # trip per array); returns plain numpy, which survives
-                # the client destruction below
-                self._host_state = jax.device_get(state)
+                # batched fetch per bucket (per-leaf np.asarray pays one
+                # round trip per array); returns plain numpy, which
+                # survives the client destruction below
+                leaves, treedef = jax.tree.flatten(state)
+                self._host_state = jax.tree.unflatten(
+                    treedef, self._offload_leaves(leaves, to_numpy=True)
+                )
             else:
                 self._host_state = None
         elif jax.process_count() > 1:
@@ -185,25 +301,19 @@ class SleepManager:
             self._shardings = jax.tree.map(lambda x: x.sharding, state)
             self._sharding_specs = None
             if level == SleepLevel.L1_HOST_OFFLOAD:
-                if self._use_memory_kind:
-                    # one batched transfer: per-leaf device_puts pay one
-                    # round trip per array on high-latency links
-                    host = jax.device_put(
-                        state,
-                        jax.tree.map(
-                            lambda x: x.sharding.with_memory_kind(
-                                "pinned_host"
-                            ),
-                            state,
-                        ),
-                    )
-                    host = jax.block_until_ready(host)
-                else:
-                    host = jax.tree.map(lambda x: np.asarray(x), state)
-                self._host_state = host
+                # batched transfer per bucket (whole tree = one bucket by
+                # default: per-leaf device_puts pay one round trip per
+                # array on high-latency links); device HBM is freed
+                # bucket-by-bucket inside _offload_leaves
+                leaves, treedef = jax.tree.flatten(state)
+                host_leaves = self._offload_leaves(
+                    leaves, to_numpy=not self._use_memory_kind
+                )
+                self._host_state = jax.tree.unflatten(treedef, host_leaves)
             else:
                 self._host_state = None
-        # Release HBM now, not at GC time.
+        # Release HBM now, not at GC time (chunked offload already deleted
+        # its leaves bucket-by-bucket; delete() is idempotent on them).
         for leaf in jax.tree.leaves(state):
             leaf.delete()
         del state
@@ -253,20 +363,26 @@ class SleepManager:
             assert self._host_state is not None
             if self._released:
                 assert self._sharding_specs is not None
+                # bucket-by-bucket: shardings are rebuilt on the fresh
+                # client and each bucket lands before the next is issued
+                # (bounded in-flight window; whole tree = one bucket by
+                # default)
                 leaves, treedef = jax.tree.flatten(self._host_state)
-                restored = jax.device_put(
+                restored = self._restore_leaves(
                     leaves,
                     [rebuild_spec(spec) for spec in self._sharding_specs],
+                    free_host=False,
                 )
                 state = jax.tree.unflatten(treedef, restored)
-                state = jax.block_until_ready(state)
             else:
-                # batched: one transfer call for the whole tree (see sleep)
-                state = jax.device_put(self._host_state, self._shardings)
-                state = jax.block_until_ready(state)
-                if self._use_memory_kind:
-                    for leaf in jax.tree.leaves(self._host_state):
-                        leaf.delete()
+                # batched transfer per bucket (see sleep); pinned-host
+                # sources are released as their bucket lands
+                leaves, treedef = jax.tree.flatten(self._host_state)
+                shardings, _ = jax.tree.flatten(self._shardings)
+                restored = self._restore_leaves(
+                    leaves, shardings, free_host=self._use_memory_kind
+                )
+                state = jax.tree.unflatten(treedef, restored)
         else:
             if reinit is None:
                 raise ValueError("level-2 wake requires a reinit callback")
@@ -293,7 +409,251 @@ class SleepManager:
         }
 
 
-def attach_sleep(engine) -> SleepManager:
+def swap_states(
+    out_mgr: SleepManager,
+    in_mgr: SleepManager,
+    bucket_bytes: Optional[int] = None,
+    overlapped: bool = True,
+) -> Dict[str, Any]:
+    """Overlapped model hot-swap: stream the awake model behind ``out_mgr``
+    to host while restoring ``in_mgr``'s slept (level-1, non-released) state
+    to device, double-buffered over size-bounded buckets.
+
+    Schedule: the device->host DMA of outgoing bucket k runs concurrently
+    with the host->device DMA of incoming bucket k-1 (issued into the HBM
+    bucket k-1's completion just freed), so swap latency approaches
+    max(sleep, wake) instead of sleep + wake and peak extra HBM is bounded
+    by ~one bucket. In-flight bytes are bounded by ~3 buckets — the
+    double-buffered outgoing pair plus one incoming (the SLO window;
+    `peak_bytes_in_flight` in the returned metrics reports the measured
+    value).
+
+    On memory-kind backends (TPU) the concurrency comes from jax's async
+    transfer dispatch; on the numpy-staging fallback (CPU tests) transfers
+    are synchronous, so the incoming direction runs on a worker thread —
+    the staging copies release the GIL, making the overlap real there too.
+
+    Ends with ``out_mgr`` asleep at level 1 (host-resident, poolable) and
+    ``in_mgr`` awake. Bit-exact: whole leaves move, nothing is recomputed.
+    Returns a metrics dict (timings, overlap fraction, bytes, buckets).
+
+    ``overlapped=False`` runs the identical code path on a strictly
+    sequential schedule (every outgoing bucket lands before the first
+    incoming one is issued) — the measured apples-to-apples baseline the
+    swap sub-bench compares against (bench.py).
+    """
+    if out_mgr.is_sleeping:
+        raise ValueError("swap-out model must be awake")
+    if in_mgr.level != SleepLevel.L1_HOST_OFFLOAD or in_mgr._host_state is None:
+        raise ValueError(
+            "swap-in model must be asleep at level 1 with host-resident "
+            "state (level-2 / multi-host-staged states cannot hot-swap)"
+        )
+    if in_mgr._released:
+        raise ValueError(
+            "swap-in model was released; hot-swap keeps one live client"
+        )
+    if jax.process_count() > 1:
+        raise ValueError("hot-swap is not supported for multi-host gangs")
+    bucket_bytes = bucket_bytes or DEFAULT_SWAP_BUCKET_BYTES
+    use_mk = out_mgr._use_memory_kind
+    t_begin = time.monotonic()
+
+    state_out = out_mgr._get_state()
+    leaves_out, treedef_out = jax.tree.flatten(state_out)
+    shard_out = [x.sharding for x in leaves_out]
+    # leaf byte counts computed once (nbytes is a non-trivial property on
+    # jax arrays) and reused for partitioning, totals, and the in-flight
+    # accounting inside the transfer loop
+    nb_out = [x.nbytes for x in leaves_out]
+    buckets_out = partition_buckets(nb_out, bucket_bytes)
+    leaves_in, treedef_in = jax.tree.flatten(in_mgr._host_state)
+    shard_in, _ = jax.tree.flatten(in_mgr._shardings)
+    nb_in = [x.nbytes for x in leaves_in]
+    buckets_in = partition_buckets(nb_in, bucket_bytes)
+
+    host_out: list = [None] * len(leaves_out)
+    dev_in: list = [None] * len(leaves_in)
+    bytes_out = sum(nb_out)
+    bytes_in = sum(nb_in)
+    bsize_out = [sum(nb_out[i] for i in b) for b in buckets_out]
+    bsize_in = [sum(nb_in[i] for i in b) for b in buckets_in]
+
+    in_flight = 0
+    peak_in_flight = 0
+    d2h_t0 = d2h_t1 = h2d_t0 = h2d_t1 = None
+
+    def _issue_d2h(k):
+        nonlocal in_flight, peak_in_flight
+        bucket = buckets_out[k]
+        if use_mk:
+            copies = jax.device_put(
+                [leaves_out[i] for i in bucket],
+                [
+                    shard_out[i].with_memory_kind("pinned_host")
+                    for i in bucket
+                ],
+            )
+        else:
+            # real copies (not views of the buffers deleted below), same
+            # as the SleepManager staging path
+            copies = [np.array(leaves_out[i], copy=True) for i in bucket]
+        in_flight += bsize_out[k]
+        if in_flight > peak_in_flight:
+            peak_in_flight = in_flight
+        return k, copies
+
+    #: threaded (numpy-staging) mode: outgoing buffer deletes are deferred
+    #: to the commit phase so the main thread never mutates client buffer
+    #: state while the worker thread is mid-device_put — on these backends
+    #: "device" memory is host RAM, so nothing is gained by eager frees
+    deferred_deletes: List[int] = []
+
+    def _finish_d2h(pending):
+        nonlocal in_flight
+        k, copies = pending
+        bucket = buckets_out[k]
+        if use_mk:
+            copies = jax.block_until_ready(copies)
+        for i, h in zip(bucket, copies):
+            host_out[i] = h
+        if h2d_pool is None:
+            for i in bucket:
+                leaves_out[i].delete()  # the HBM the next h2d bucket fills
+        else:
+            deferred_deletes.extend(bucket)
+        in_flight -= bsize_out[k]
+
+    # The incoming direction: async transfer dispatch where the backend
+    # has it (memory kinds); a single worker thread where transfers are
+    # synchronous (numpy staging), so the overlap stays real. EXCEPT in
+    # forked children (the launcher's process model): a fork from a
+    # multi-threaded parent inherits a single-threaded snapshot whose
+    # other-thread lock state is frozen mid-flight, and spawning transfer
+    # threads there intermittently aborts the child — the threaded overlap
+    # is a bench-scale concern on this fallback, not a serving-path one.
+    import multiprocessing
+
+    use_thread = (
+        overlapped
+        and not use_mk
+        and multiprocessing.parent_process() is None
+    )
+    h2d_pool = (
+        ThreadPoolExecutor(1, thread_name_prefix="swap-h2d")
+        if use_thread
+        else None
+    )
+
+    def _h2d_transfer(j):
+        bucket = buckets_in[j]
+        return jax.device_put(
+            [leaves_in[i] for i in bucket], [shard_in[i] for i in bucket]
+        )
+
+    def _issue_h2d(j):
+        nonlocal in_flight, peak_in_flight, h2d_t0
+        if h2d_t0 is None:
+            h2d_t0 = time.monotonic()
+        if h2d_pool is not None:
+            restored = h2d_pool.submit(_h2d_transfer, j)
+        else:
+            restored = _h2d_transfer(j)
+        in_flight += bsize_in[j]
+        if in_flight > peak_in_flight:
+            peak_in_flight = in_flight
+        return j, restored
+
+    def _finish_h2d(pending):
+        nonlocal in_flight
+        j, restored = pending
+        bucket = buckets_in[j]
+        if h2d_pool is not None:
+            restored = restored.result()
+        restored = jax.block_until_ready(restored)
+        for i, d in zip(bucket, restored):
+            dev_in[i] = d
+        if use_mk:
+            for i in bucket:
+                leaves_in[i].delete()  # pinned host copy no longer needed
+        in_flight -= bsize_in[j]
+
+    # Double-buffered main loop: while outgoing bucket k drains, incoming
+    # bucket k-1 rides the opposite direction into the space k-1 freed.
+    # (Sequential mode: the same loop, minus the interleaved h2d issues.)
+    pend_d2h = pend_h2d = None
+    next_in = 0
+    d2h_t0 = time.monotonic()
+    for k in range(len(buckets_out)):
+        cur = _issue_d2h(k)
+        if pend_d2h is not None:
+            _finish_d2h(pend_d2h)
+            if overlapped and next_in < len(buckets_in):
+                if pend_h2d is not None:
+                    _finish_h2d(pend_h2d)
+                pend_h2d = _issue_h2d(next_in)
+                next_in += 1
+        pend_d2h = cur
+    if pend_d2h is not None:
+        _finish_d2h(pend_d2h)
+    d2h_t1 = time.monotonic()
+    while next_in < len(buckets_in):
+        if pend_h2d is not None:
+            _finish_h2d(pend_h2d)
+        pend_h2d = _issue_h2d(next_in)
+        next_in += 1
+    if pend_h2d is not None:
+        _finish_h2d(pend_h2d)
+    h2d_t1 = time.monotonic()
+    if h2d_t0 is None:  # empty incoming tree (degenerate)
+        h2d_t0 = h2d_t1
+    if h2d_pool is not None:
+        h2d_pool.shutdown(wait=True)  # no transfer outlives the swap
+        for i in deferred_deletes:
+            leaves_out[i].delete()
+
+    # Commit the state-machine edges: outgoing asleep (poolable host
+    # state), incoming awake.
+    out_mgr._host_state = jax.tree.unflatten(treedef_out, host_out)
+    out_mgr._shardings = jax.tree.unflatten(treedef_out, shard_out)
+    out_mgr._sharding_specs = None
+    out_mgr._staged = None
+    out_mgr._set_state(None)
+    out_mgr._level = SleepLevel.L1_HOST_OFFLOAD
+    out_mgr.stats.last_sleep_seconds = d2h_t1 - d2h_t0
+    out_mgr.stats.bytes_offloaded = bytes_out
+    out_mgr.stats.sleeps_total += 1
+
+    in_mgr._host_state = None
+    in_mgr._shardings = None
+    in_mgr._sharding_specs = None
+    in_mgr._set_state(jax.tree.unflatten(treedef_in, dev_in))
+    in_mgr._level = SleepLevel.AWAKE
+    in_mgr.stats.last_wake_seconds = h2d_t1 - h2d_t0
+    in_mgr.stats.bytes_offloaded = 0
+    in_mgr.stats.wakes_total += 1
+
+    total = time.monotonic() - t_begin
+    # Overlap = intersection of the two directions' issue->complete
+    # windows. Positive whenever an h2d was issued before the last d2h
+    # completed — i.e. for any >= 2-bucket swap, by construction.
+    overlap = max(0.0, min(d2h_t1, h2d_t1) - max(d2h_t0, h2d_t0))
+    return {
+        "swap_total_s": total,
+        "d2h_s": d2h_t1 - d2h_t0,
+        "h2d_s": h2d_t1 - h2d_t0,
+        "overlap_s": overlap,
+        "overlap_frac": overlap / total if total > 0 else 0.0,
+        "bytes_out": bytes_out,
+        "bytes_in": bytes_in,
+        "buckets_out": len(buckets_out),
+        "buckets_in": len(buckets_in),
+        "bucket_bytes": bucket_bytes,
+        "peak_bytes_in_flight": peak_in_flight,
+    }
+
+
+def attach_sleep(engine, bucket_bytes: Optional[int] = None) -> SleepManager:
     """Wire a SleepManager to an InferenceEngine: the offloadable state is
     (params, kv page pool). Page tables / host bookkeeping stay put, so the
     wake fast path resumes in-flight sequences."""
@@ -318,5 +678,8 @@ def attach_sleep(engine) -> SleepManager:
             engine.pool.replace(state["kv"])
 
     return SleepManager(
-        get_state, set_state, on_reacquire=engine.on_device_reacquire
+        get_state,
+        set_state,
+        on_reacquire=engine.on_device_reacquire,
+        bucket_bytes=bucket_bytes,
     )

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import multiprocessing
 import os
+import shlex
 import signal
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
@@ -27,6 +28,43 @@ from .chiptranslator import ChipTranslator
 logger = logging.getLogger(__name__)
 
 MAX_LOG_RESPONSE_BYTES = 1 << 20  # 1 MiB per ranged-log response
+
+
+def replace_model_option(
+    options: str, model: str, checkpoint_dir: str = ""
+) -> str:
+    """Rewrite the ``--model`` (and ``--checkpoint-dir``) values in an
+    engine options string. After a hot-swap the child serves a different
+    model than it was forked with; the stored config must describe reality
+    (status responses, and any future restart of the instance) — which
+    means the OLD model's checkpoint dir must never survive attached to
+    the new model's name (a restart would load shape-mismatched weights)."""
+    parts = shlex.split(options or "")
+    out: List[str] = []
+    replaced = False
+    i = 0
+    while i < len(parts):
+        p = parts[i]
+        if p == "--model" and i + 1 < len(parts):
+            out += ["--model", model]
+            i += 2
+            replaced = True
+        elif p.startswith("--model="):
+            out.append(f"--model={model}")
+            i += 1
+            replaced = True
+        elif p == "--checkpoint-dir" and i + 1 < len(parts):
+            i += 2  # dropped; re-added below if the swap supplied one
+        elif p.startswith("--checkpoint-dir="):
+            i += 1
+        else:
+            out.append(p)
+            i += 1
+    if not replaced:
+        out = ["--model", model] + out
+    if checkpoint_dir:
+        out += ["--checkpoint-dir", checkpoint_dir]
+    return shlex.join(out)
 
 
 class InvalidInstanceConfig(Exception):

@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.error
 import urllib.request
 import uuid as uuidlib
 from typing import Any, Callable, Dict, List, Optional
@@ -48,6 +49,18 @@ class ChipConflict(Exception):
         self.blockers = blockers
 
 
+class SwapFailed(Exception):
+    """The engine child rejected (or never answered) a model hot-swap."""
+
+    def __init__(self, instance_id: str, status: int, detail: str) -> None:
+        super().__init__(
+            f"swap of instance {instance_id} failed ({status}): {detail}"
+        )
+        self.instance_id = instance_id
+        self.status = status
+        self.detail = detail
+
+
 def probe_instance_awake(instance: "EngineInstance") -> Optional[bool]:
     """Ask the instance's engine admin API whether it still holds its chips.
 
@@ -72,10 +85,13 @@ def probe_instance_awake(instance: "EngineInstance") -> Optional[bool]:
 
 
 class ChipLedger:
-    """Node-local truth of which live instance holds which chips."""
+    """Node-local truth of which live instance holds which chips (and which
+    model each holder currently serves — hot-swap rebinds the model without
+    touching the chip set, so the holder entry survives swaps unchanged)."""
 
     def __init__(self) -> None:
         self._held: Dict[str, List[str]] = {}  # instance_id -> chip_ids
+        self._models: Dict[str, str] = {}  # instance_id -> served model
 
     def overlapping(
         self, chip_ids: Optional[List[str]], exclude: Optional[str] = None
@@ -97,9 +113,18 @@ class ChipLedger:
 
     def release(self, instance_id: str) -> None:
         self._held.pop(instance_id, None)
+        self._models.pop(instance_id, None)
+
+    def set_model(self, instance_id: str, model: str) -> None:
+        """Record which model a holder serves (updated on hot-swap)."""
+        if instance_id in self._held:
+            self._models[instance_id] = model
 
     def holders(self) -> Dict[str, List[str]]:
         return dict(self._held)
+
+    def models(self) -> Dict[str, str]:
+        return dict(self._models)
 
 
 class EngineProcessManager:
@@ -202,6 +227,14 @@ class EngineProcessManager:
         # record ownership only once the process actually exists — a failed
         # start must not leak a chips hold
         self.ledger.acquire(iid, config.chip_ids)
+        try:
+            from ..engine.server import parse_engine_options
+
+            self.ledger.set_model(
+                iid, parse_engine_options(config.options).model
+            )
+        except Exception:  # noqa: BLE001 — fake-kickoff tests use free-form options
+            pass
         self.instances[iid] = instance
         published = dict(result)
         instance.last_revision = self._publish("CREATED", published)
@@ -234,6 +267,75 @@ class EngineProcessManager:
         result["revision"] = self._publish("DELETED", published)
         logger.info("stopped instance %s", instance_id)
         return result
+
+    def swap_instance(
+        self,
+        instance_id: str,
+        model: str,
+        checkpoint_dir: str = "",
+        timeout: float = 300,
+    ) -> Dict[str, Any]:
+        """Hot-swap the model a live instance serves: forward to the engine
+        child's POST /v1/swap (no stop/start cycle — the chip set, the
+        process, and its ChipLedger hold are all unchanged), then bring the
+        stored config and ledger in line with the model actually served."""
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        instance = self.instances[instance_id]
+        from ..engine.server import parse_engine_options
+
+        try:
+            opts = parse_engine_options(instance.config.options)
+        except Exception as e:
+            # free-form options are tolerated at create time (fake-kickoff
+            # managers); a swap on such an instance is a clear client error
+            raise SwapFailed(
+                instance_id, 400, f"stored options are not engine options: {e}"
+            )
+        previous = opts.model
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{opts.port}/v1/swap",
+            data=json.dumps(
+                {"model": model, "checkpoint_dir": checkpoint_dir}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise SwapFailed(instance_id, e.code, detail)
+        except Exception as e:  # noqa: BLE001 — unreachable child, timeout, ...
+            raise SwapFailed(instance_id, 502, f"engine unreachable: {e}")
+        from .instance import replace_model_option
+
+        # rewrite from the ENGINE's answer, not the request: a pool hit
+        # restores the pooled runtime's own checkpoint identity, and the
+        # stored options must describe what the child actually serves
+        # (a restart rebuilds from them)
+        instance.config.options = replace_model_option(
+            instance.config.options,
+            model,
+            checkpoint_dir=body.get("checkpoint_dir") or checkpoint_dir,
+        )
+        self.ledger.set_model(instance_id, model)
+        obj = instance.get_status()
+        obj["swap"] = body
+        instance.last_revision = self._publish("SWAPPED", obj)
+        logger.info(
+            "swapped instance %s: %s -> %s (pool_hit=%s, rev %s)",
+            instance_id, previous, model, body.get("pool_hit"),
+            instance.last_revision,
+        )
+        return {
+            "instance_id": instance_id,
+            "model": model,
+            "previous_model": previous,
+            "swap": body,
+            "revision": instance.last_revision,
+        }
 
     def stop_all_instances(self, timeout: float = 10) -> Dict[str, Any]:
         stopped = []

@@ -23,6 +23,7 @@ from ..utils.events import RevisionTooOld
 from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
 from .manager import ChipConflict
 from .manager import EngineProcessManager
+from .manager import SwapFailed
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +64,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "get_instance_status": "GET /v2/vllm/instances/{instance_id}",
                     "get_all_instances": "GET /v2/vllm/instances",
                     "get_instance_logs": "GET /v2/vllm/instances/{instance_id}/log",
+                    "swap_instance": "POST /v2/vllm/instances/{instance_id}/swap",
                     "watch_instances": "GET /v2/vllm/instances/watch",
                 },
             }
@@ -189,6 +191,43 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             pass
         return resp
 
+    async def swap_instance(request: web.Request) -> web.Response:
+        """Model hot-swap verb: rebind a live instance to a different model
+        over the engine child's /v1/swap — same chip set, same process, no
+        stop/start cycle (docs/engine.md "Model hot-swap")."""
+        instance_id = request.match_info["instance_id"]
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            raise web.HTTPUnprocessableEntity(
+                text="swap requires a 'model' string"
+            )
+        checkpoint_dir = body.get("checkpoint_dir") or ""
+        if not isinstance(checkpoint_dir, str):
+            raise web.HTTPUnprocessableEntity(
+                text="checkpoint_dir must be a string"
+            )
+        try:
+            # the swap streams model state for seconds; keep the loop free
+            result = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: manager.swap_instance(
+                    instance_id, model, checkpoint_dir=checkpoint_dir
+                ),
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except SwapFailed as e:
+            # engine-side rejection (bad model name, gang, sleeping) maps
+            # to the client's fault; an unreachable child is a gateway error
+            if 400 <= e.status < 500:
+                raise web.HTTPBadRequest(text=str(e))
+            raise web.HTTPBadGateway(text=str(e))
+        return web.json_response(result)
+
     async def get_log(request: web.Request) -> web.Response:
         instance_id = request.match_info["instance_id"]
         range_header = request.headers.get("Range")
@@ -240,6 +279,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     app.router.add_get("/v2/vllm/instances", get_all)
     app.router.add_get("/v2/vllm/instances/{instance_id}", get_one)
     app.router.add_get("/v2/vllm/instances/{instance_id}/log", get_log)
+    app.router.add_post("/v2/vllm/instances/{instance_id}/swap", swap_instance)
 
     async def on_shutdown(app: web.Application) -> None:
         manager.stop_all_instances()

@@ -143,6 +143,95 @@ def test_full_instance_lifecycle(launcher):
 
 
 @pytest.mark.e2e
+def test_swap_verb_hot_swaps_model(launcher):
+    """The launcher `swap` verb end to end: two registered models
+    time-sharing one chip set over a real forked engine child — swap to a
+    second model, swap back as a pool hit with zero checkpoint re-reads,
+    same chip hold, no stop/start cycle."""
+    engine_port = free_port()
+    options = (
+        f"--model tiny --port {engine_port} --num-pages 32 --max-batch 2 "
+        f"--page-size 8 --max-model-len 64"
+    )
+    r = requests.put(
+        launcher + "/v2/vllm/instances/swap-1",
+        json={
+            "options": options,
+            "gpu_uuids": ["tpu-mock-0-0"],
+            "env_vars": {"JAX_PLATFORMS": "cpu"},
+        },
+        timeout=30,
+    )
+    assert r.status_code == 201, r.text
+    engine = f"http://127.0.0.1:{engine_port}"
+    wait_http(engine + "/health", timeout=120)
+
+    r = requests.post(
+        engine + "/v1/completions",
+        json={"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    gold = r.json()["choices"][0]["token_ids"]
+
+    # swap to the second model THROUGH THE LAUNCHER (no stop/start: the
+    # process and its chip hold survive)
+    r = requests.post(
+        launcher + "/v2/vllm/instances/swap-1/swap",
+        json={"model": "tiny-gemma"},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["previous_model"] == "tiny" and body["model"] == "tiny-gemma"
+    assert body["swap"]["swapped"] and not body["swap"]["pool_hit"]
+    builds_after_cold = body["swap"]["builds_total"]
+
+    # the engine now serves the new model (same process, same port)
+    assert requests.get(engine + "/v1/models", timeout=30).json()["data"][0][
+        "id"
+    ] == "tiny-gemma"
+    # the stored instance config follows the swap
+    r = requests.get(launcher + "/v2/vllm/instances/swap-1")
+    assert r.json()["status"] == "running"
+    assert "--model tiny-gemma" in r.json()["options"]
+
+    # swap back: pool hit, zero checkpoint re-reads (no new cold build),
+    # bit-exact generation
+    r = requests.post(
+        launcher + "/v2/vllm/instances/swap-1/swap",
+        json={"model": "tiny"},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    body = r.json()
+    assert body["swap"]["pool_hit"] is True
+    assert body["swap"]["builds_total"] == builds_after_cold
+    r = requests.post(
+        engine + "/v1/completions",
+        json={"prompt": [1, 2, 3, 4], "max_tokens": 4},
+        timeout=120,
+    )
+    assert r.json()["choices"][0]["token_ids"] == gold
+
+    # error mapping: unknown model -> 400, missing instance -> 404
+    r = requests.post(
+        launcher + "/v2/vllm/instances/swap-1/swap",
+        json={"model": "bogus"},
+        timeout=60,
+    )
+    assert r.status_code == 400
+    r = requests.post(
+        launcher + "/v2/vllm/instances/no-such/swap",
+        json={"model": "tiny"},
+        timeout=60,
+    )
+    assert r.status_code == 404
+
+    requests.delete(launcher + "/v2/vllm/instances/swap-1", timeout=30)
+
+
+@pytest.mark.e2e
 def test_chip_pinning_env_reaches_child(launcher):
     """chip IDs -> TPU_VISIBLE_DEVICES is injected into the instance env."""
     engine_port = free_port()

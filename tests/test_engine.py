@@ -545,6 +545,36 @@ def test_pipeline_decode_stop_sequences_and_sleep():
     assert done and done[0].out_tokens == gold[:12]
 
 
+def test_pipeline_decode_no_wasted_tail_dispatch():
+    """End-of-batch tail: when every running request can finish inside the
+    in-flight chunk, no speculative chunk k+1 is dispatched (it would be
+    fully frozen — pure wasted device work). Pins the dispatch count AND
+    output identity with the sequential engine."""
+    seq, pipe = _pipeline_pair()  # decode_chunk=4
+    dispatches = []
+    orig = pipe._dispatch_chunk
+
+    def counting_dispatch(running):
+        dispatches.append(sorted(running))
+        return orig(running)
+
+    pipe._dispatch_chunk = counting_dispatch
+    prompt = [5, 6, 7]
+    # 5 tokens total: 1 from prefill + 4 decoded = exactly one T=4 chunk;
+    # the old code dispatched a second, fully-frozen chunk at the tail
+    gold = seq.generate([prompt], max_new_tokens=5)[0]
+    out = pipe.generate([prompt], max_new_tokens=5)[0]
+    assert out == gold
+    assert len(dispatches) == 1, dispatches
+
+    # longer run: budget 9 -> prefill + chunk(4) + chunk(4) and nothing
+    # after the second chunk's drain
+    dispatches.clear()
+    out = pipe.generate([prompt], max_new_tokens=9)[0]
+    assert out == seq.generate([prompt], max_new_tokens=9)[0]
+    assert len(dispatches) == 2, dispatches
+
+
 def test_pipeline_decode_abort_mid_flight():
     """Aborting while a chunk is in flight defers the retire; pages are
     not recycled until the chunk drains, and the allocator balances."""

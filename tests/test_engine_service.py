@@ -80,7 +80,10 @@ def test_level2_wake_aborts_inflight(service):
     orig_step = service.engine.step
 
     def slow_step():
-        time.sleep(0.05)
+        # generation must comfortably outlast the 0.4 s trigger below even
+        # on a loaded box (~7 steps for 40 tokens at decode_chunk=8); at
+        # 0.05 s/step the request could finish before the sleep landed
+        time.sleep(0.2)
         return orig_step()
 
     service.engine.step = slow_step

@@ -171,6 +171,30 @@ def test_log_ranges(translator, tmp_path):
         inst.stop(timeout=2)
 
 
+def test_replace_model_option():
+    from llm_d_fast_model_actuation_tpu.launcher.instance import (
+        replace_model_option,
+    )
+
+    # rewrites --model wherever it sits, both spellings
+    assert replace_model_option("--model tiny --port 80", "tiny-gemma") == (
+        "--model tiny-gemma --port 80"
+    )
+    assert replace_model_option("--port 80 --model=tiny", "x") == (
+        "--port 80 --model=x"
+    )
+    # a missing --model is prepended
+    assert replace_model_option("--port 80", "tiny") == "--model tiny --port 80"
+    # the OLD model's checkpoint dir never survives a swap (a restart
+    # would load shape-mismatched weights); a new one is recorded
+    assert replace_model_option(
+        "--model a --checkpoint-dir /ckpt/a --port 80", "b"
+    ) == "--model b --port 80"
+    assert replace_model_option(
+        "--model a --checkpoint-dir=/ckpt/a", "b", checkpoint_dir="/ckpt/b"
+    ) == "--model b --checkpoint-dir /ckpt/b"
+
+
 def test_parse_range_header():
     assert parse_range_header("bytes=0-99") == (0, 99)
     assert parse_range_header("bytes=100-") == (100, None)
