@@ -80,6 +80,17 @@ class HostModelPool:
                 self.hits += 1
             return entry
 
+    def contains_match(self, model_id: str) -> bool:
+        """Non-mutating ``take_match`` probe: is anything pooled under this
+        model name, with or without a checkpoint qualifier? (Used by
+        prefetch to skip re-staging an already-resident model; counts no
+        hit/miss.)"""
+        with self._mu:
+            return any(
+                key == model_id or key.startswith(model_id + "@")
+                for key in self._entries
+            )
+
     def take_match(self, model_id: str) -> Optional[PoolEntry]:
         """Remove and return the most-recently-parked entry pooled under
         this model name regardless of checkpoint qualifier (keys are

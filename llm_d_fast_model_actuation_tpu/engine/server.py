@@ -147,6 +147,31 @@ ENGINE_POOL_EVICTIONS = Counter(
     "Pooled models evicted (budget pressure or device release)",
 )
 
+# Cold-start observability (docs/perf.md "Cold-start tuning"): the pipelined
+# loader's phase breakdown for the last cold build, and background-prefetch
+# outcomes. `phase` is read (disk -> staged host buffers, wall window),
+# convert (cumulative casted-copy time inside staging), h2d (first transfer
+# issued -> last landed) or total.
+ENGINE_COLDLOAD_PHASE_SECONDS = Gauge(
+    "fma_engine_coldload_phase_seconds",
+    "Last cold weight-load phase timing",
+    ["model", "phase"],  # phase: read | convert | h2d | total
+)
+ENGINE_COLDLOAD_OVERLAP_FRAC = Gauge(
+    "fma_engine_coldload_overlap_fraction",
+    "Fraction of the last cold load spent with disk read and H2D in flight",
+    ["model"],
+)
+ENGINE_PREFETCHES = Counter(
+    "fma_engine_prefetch_total",
+    "Background checkpoint prefetches by outcome",
+    ["outcome"],  # completed | aborted | failed | rejected
+)
+ENGINE_PREFETCH_BYTES = Gauge(
+    "fma_engine_prefetch_staged_bytes",
+    "Host bytes staged by the last completed prefetch",
+)
+
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
@@ -298,6 +323,31 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "DMA window to ~one bucket per direction",
     )
     p.add_argument(
+        "--load-workers",
+        type=int,
+        default=0,
+        help="parallel shard readers for cold HF weight loads "
+        "(0 = auto: min(8, cpu count)); shard reads and dtype casts "
+        "release the GIL, so readers genuinely overlap (docs/perf.md "
+        "Cold-start tuning)",
+    )
+    p.add_argument(
+        "--load-inflight-mib",
+        type=int,
+        default=512,
+        help="bytes-in-flight bound (MiB) for the streaming cold loader's "
+        "host->device transfers: buffers stream to HBM as they complete, "
+        "double-buffered in ~half-this-size buckets",
+    )
+    p.add_argument(
+        "--prefetch-mib-s",
+        type=int,
+        default=0,
+        help="I/O throttle (MiB/s) for background checkpoint prefetch "
+        "(POST /v1/prefetch) so staging the predicted next model never "
+        "starves serving traffic; 0 = unthrottled",
+    )
+    p.add_argument(
         "--tokenizer",
         default="",
         help="HF tokenizer directory (text prompts, chat templates, stop "
@@ -370,6 +420,12 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
         raise ValueError("--swap-bucket-mib must be >= 1")
+    if getattr(args, "load_workers", 0) < 0:
+        raise ValueError("--load-workers must be >= 0 (0 = auto)")
+    if getattr(args, "load_inflight_mib", 1) < 1:
+        raise ValueError("--load-inflight-mib must be >= 1")
+    if getattr(args, "prefetch_mib_s", 0) < 0:
+        raise ValueError("--prefetch-mib-s must be >= 0 (0 = unthrottled)")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -386,6 +442,20 @@ def _pool_key(model: str, checkpoint_dir: str) -> str:
     """Identity of a pooled model: the same model name restored from a
     different checkpoint is a different set of weights."""
     return f"{model}@{checkpoint_dir}" if checkpoint_dir else model
+
+
+@dataclass
+class _PrefetchedWeights:
+    """A pool entry staged by background prefetch (POST /v1/prefetch):
+    host-resident plain numpy weights in cfg.dtype — no engine, no device
+    state, no compiled programs. A swap to it skips the checkpoint read
+    (source="pool") and only pays compile + the H2D stream; eviction is
+    just dropping the reference."""
+
+    model_id: str
+    checkpoint_dir: str
+    params_host: Optional[Dict[str, Any]]
+    nbytes: int
 
 
 @dataclass
@@ -492,6 +562,15 @@ class EngineService:
         #: contract the swap e2e test pins
         self.builds_total = 0
         self.last_swap: Dict[str, Any] = {}
+        #: filled by every _build_runtime (h2d_s / bytes_in / buckets_in /
+        #: overlap): what a pool-miss swap reports instead of zeros
+        self._last_build_stats: Dict[str, Any] = {}
+        # Background checkpoint prefetch (POST /v1/prefetch): one staging
+        # thread at a time, host-only, abortable.
+        self._prefetch_mu = threading.Lock()
+        self._prefetch_thread: Optional[threading.Thread] = None
+        self._prefetch_abort = threading.Event()
+        self.last_prefetch: Dict[str, Any] = {"state": "idle"}
         self._install_runtime(
             self._build_runtime(
                 args.model, getattr(args, "checkpoint_dir", "") or ""
@@ -541,8 +620,13 @@ class EngineService:
         slept runtime to level 2 is exactly 'drop the host copy'."""
         ENGINE_POOL_EVICTIONS.inc(len(victims))
         for victim in victims:
+            rt = victim.runtime
+            if isinstance(rt, _PrefetchedWeights):
+                # staged host numpy: dropping the reference IS the free
+                rt.params_host = None
+                continue
             try:
-                victim.runtime.sleeper.sleep(2)
+                rt.sleeper.sleep(2)
             except Exception:
                 logger.warning(
                     "failed to free pooled model %s (%s)",
@@ -567,11 +651,18 @@ class EngineService:
     # -- model runtimes (build / install / hot-swap) -------------------------
 
     def _build_runtime(
-        self, model_id: str, checkpoint_dir: str = ""
+        self,
+        model_id: str,
+        checkpoint_dir: str = "",
+        staged_params: Optional[Dict[str, Any]] = None,
     ) -> _ModelRuntime:
         """Cold-build an awake runtime for `model_id`: config -> tokenizer
         -> params (checkpoint / HF read, or random init) -> engine ->
-        sleeper. Pool hits on swap bypass this entirely."""
+        sleeper. Pool hits on a slept runtime bypass this entirely;
+        `staged_params` (a prefetched host tree) skips the checkpoint read
+        and streams straight host -> device. Leaves the build's transfer
+        accounting in `_last_build_stats` so a pool-miss swap can report
+        its real H2D cost."""
         args = self.args
         hf_dir = ""
         eos_token_id = args.eos_token_id
@@ -621,18 +712,67 @@ class EngineService:
             from ..parallel.mesh import MeshPlan, make_mesh
 
             mesh = make_mesh(MeshPlan(tp=args.tensor_parallel_size))
+        # Build transfer accounting: a pool-miss swap moves the whole
+        # incoming model to HBM inside this build, and the swap metrics
+        # must say so (h2d seconds/bytes were reported as 0 before).
+        build_stats: Dict[str, Any] = {
+            "h2d_s": 0.0,
+            "bytes_in": 0,
+            "buckets_in": 0,
+            "overlap_s": 0.0,
+            "overlap_frac": 0.0,
+        }
+        inflight = max(1, getattr(args, "load_inflight_mib", 512)) << 20
         params = None
-        if checkpoint_dir:
+        t_load0 = time.monotonic()
+        if checkpoint_dir and staged_params is None:
             from ..models import checkpoint
 
+            ckpt_stats: Dict[str, Any] = {}
             params = checkpoint.load_params(
-                checkpoint_dir, model_cfg, mesh=mesh
+                checkpoint_dir, model_cfg, mesh=mesh, stats_out=ckpt_stats
             )
-        elif hf_dir:
+            # Orbax restores each leaf straight into its device placement:
+            # the restore wall IS the cold H2D window (read inseparable)
+            build_stats["h2d_s"] = ckpt_stats.get(
+                "restore_s", time.monotonic() - t_load0
+            )
+        elif hf_dir or staged_params is not None:
             from ..models import hf as hf_models
 
-            # host-side load; InferenceEngine shards onto the mesh
-            params = hf_models.load_params(hf_dir, model_cfg)
+            lstats = hf_models.LoadStats()
+            if staged_params is not None:
+                # prefetched host weights: no disk read, just the stream in
+                params = hf_models.place_staged_params(
+                    staged_params, model_cfg, mesh=mesh,
+                    max_inflight_bytes=inflight, stats=lstats,
+                )
+            else:
+                # pipelined cold load: parallel shard readers + streaming
+                # placement straight into the serving sharding
+                params = hf_models.load_params(
+                    hf_dir, model_cfg, mesh=mesh,
+                    workers=getattr(args, "load_workers", 0) or None,
+                    max_inflight_bytes=inflight, stats=lstats,
+                )
+                for phase, v in (
+                    ("read", lstats.read_s),
+                    ("convert", lstats.convert_s),
+                    ("h2d", lstats.h2d_s),
+                    ("total", lstats.total_s),
+                ):
+                    ENGINE_COLDLOAD_PHASE_SECONDS.labels(
+                        model=model_id, phase=phase
+                    ).set(v)
+                ENGINE_COLDLOAD_OVERLAP_FRAC.labels(model=model_id).set(
+                    lstats.overlap_frac
+                )
+            build_stats.update(
+                h2d_s=lstats.h2d_s,
+                buckets_in=lstats.buckets_h2d,
+                overlap_s=lstats.overlap_s,
+                overlap_frac=lstats.overlap_frac,
+            )
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
         engine = InferenceEngine(
@@ -660,6 +800,17 @@ class EngineService:
             mesh=mesh,
             seed=args.seed,
         )
+        if params is None:
+            # random init lands on device inside engine construction: the
+            # whole build window is device-state creation
+            build_stats["h2d_s"] = time.monotonic() - t_load0
+        build_stats["bytes_in"] = sum(
+            x.nbytes
+            for x in jax.tree.leaves(
+                {"p": engine.params, "kv": engine.pool.as_tuple()}
+            )
+        )
+        self._last_build_stats = build_stats
         sleeper = attach_sleep(engine, bucket_bytes=self._swap_bucket_bytes)
         self.builds_total += 1
         return _ModelRuntime(
@@ -758,7 +909,10 @@ class EngineService:
             else:
                 entry = self.model_pool.take_match(model)
             pool_hit = entry is not None
-            if pool_hit:
+            prefetched = pool_hit and isinstance(
+                entry.runtime, _PrefetchedWeights
+            )
+            if pool_hit and not prefetched:
                 rt = entry.runtime
                 try:
                     metrics = swap_states(
@@ -786,26 +940,48 @@ class EngineService:
                     self._fail_all(RuntimeError(self.failure))
                     raise
             else:
-                # Cold: stream the old model out first (HBM bounded by the
-                # sleeper's bucket size), then build the new one into the
-                # freed space.
+                # Cold build, or a prefetched-weights pool hit: stream the
+                # old model out first (HBM bounded by the sleeper's bucket
+                # size), then build the new one into the freed space. A
+                # prefetched entry skips the checkpoint read — its staged
+                # host tree streams straight to device inside the build.
                 self.sleeper.sleep(1)
                 try:
-                    rt = self._build_runtime(model, checkpoint_dir)
+                    if prefetched:
+                        rt = self._build_runtime(
+                            model,
+                            entry.runtime.checkpoint_dir,
+                            staged_params=entry.runtime.params_host,
+                        )
+                    else:
+                        rt = self._build_runtime(model, checkpoint_dir)
                 except Exception:
                     # a failed build must not leave the chip serving nothing
                     self.sleeper.wake_up()
+                    if prefetched:
+                        # the staged host weights are untouched by a
+                        # failed build: re-pool them for the next attempt
+                        self.model_pool.put(
+                            entry.model_id, entry.runtime, entry.nbytes
+                        )
                     raise
+                # A pool-miss swap still transfers the whole incoming
+                # model to HBM inside the build — report the build's H2D
+                # window/bytes instead of zeros, so swap_overlap_frac and
+                # dashboards aren't lying on misses (the overlap here is
+                # the cold loader's read/H2D overlap, not a two-direction
+                # DMA overlap).
+                b = self._last_build_stats
                 metrics = {
                     "swap_total_s": 0.0,  # finalized below
                     "d2h_s": outgoing.sleeper.stats.last_sleep_seconds,
-                    "h2d_s": 0.0,
-                    "overlap_s": 0.0,
-                    "overlap_frac": 0.0,
+                    "h2d_s": b.get("h2d_s", 0.0),
+                    "overlap_s": b.get("overlap_s", 0.0),
+                    "overlap_frac": b.get("overlap_frac", 0.0),
                     "bytes_out": outgoing.sleeper.stats.bytes_offloaded,
-                    "bytes_in": 0,
+                    "bytes_in": b.get("bytes_in", 0),
                     "buckets_out": 0,
-                    "buckets_in": 0,
+                    "buckets_in": b.get("buckets_in", 0),
                     "bucket_bytes": self._swap_bucket_bytes,
                     "peak_bytes_in_flight": 0,
                 }
@@ -840,6 +1016,9 @@ class EngineService:
                 "checkpoint_dir": rt.checkpoint_dir,
                 "swapped": True,
                 "pool_hit": pool_hit,
+                # pool_hit via background prefetch: source="pool" but the
+                # entry was staged weights, not a slept runtime
+                "prefetched": prefetched,
                 **{
                     k: (round(v, 6) if isinstance(v, float) else v)
                     for k, v in metrics.items()
@@ -856,6 +1035,196 @@ class EngineService:
             100 * metrics.get("overlap_frac", 0.0),
         )
         return out
+
+    # -- background checkpoint prefetch --------------------------------------
+
+    def prefetch(self, model: str, checkpoint_dir: str = "") -> Dict[str, Any]:
+        """Start a background checkpoint prefetch (POST /v1/prefetch):
+        stage `model`'s weights host-resident into the model pool — never
+        touching HBM, I/O-throttled (--prefetch-mib-s), abortable — so the
+        first-ever swap to it takes the warm (pool) path while the current
+        model keeps serving. The dual-pods controller uses this to hint
+        the predicted next model."""
+        if self.is_follower or self.engine.lockstep is not None:
+            raise ValueError("prefetch is not supported for multi-host gangs")
+        if not model.startswith("hf:"):
+            raise ValueError(
+                "prefetch requires an hf:<model-dir> model (named configs "
+                "are random-init, and Orbax checkpoints restore straight "
+                "into device placement on swap)"
+            )
+        if checkpoint_dir:
+            # Staging can only read the HF directory. Pooling HF base
+            # weights under model@checkpoint_dir would make the later swap
+            # silently serve them where a non-prefetched swap restores the
+            # Orbax checkpoint — wrong weights, not a slow path.
+            raise ValueError(
+                "prefetch cannot stage an Orbax checkpoint_dir (it reads "
+                "the hf: directory only); swap to the checkpoint directly"
+            )
+        hf_dir = model[3:]
+        if not hf_dir:
+            raise ValueError("prefetch model hf: needs a directory path")
+        if model == self.args.model and (
+            not checkpoint_dir or checkpoint_dir == self.checkpoint_dir
+        ):
+            raise ValueError(f"{model} is already the serving model")
+        key = _pool_key(model, checkpoint_dir)
+        if (
+            key in self.model_pool
+            if checkpoint_dir
+            else self.model_pool.contains_match(model)
+        ):
+            return {
+                "state": "already_pooled",
+                "model": model,
+                "checkpoint_dir": checkpoint_dir,
+                "started": False,
+            }
+        from ..models import hf as hf_models
+
+        model_cfg = hf_models.config_from_hf(
+            hf_dir, quantization=self.args.quantization or ""
+        )
+        est = hf_models.estimate_param_bytes(model_cfg)
+        if est > self.model_pool.budget_bytes:
+            ENGINE_PREFETCHES.labels(outcome="rejected").inc()
+            raise ValueError(
+                f"prefetch of {model} (~{est >> 20} MiB staged) exceeds "
+                f"the model pool budget "
+                f"({self.model_pool.budget_bytes >> 20} MiB); raise "
+                "--model-pool-mib"
+            )
+        with self._prefetch_mu:
+            if (
+                self._prefetch_thread is not None
+                and self._prefetch_thread.is_alive()
+            ):
+                raise ValueError(
+                    "a prefetch is already in progress "
+                    "(DELETE /v1/prefetch aborts it)"
+                )
+            self._prefetch_abort = threading.Event()
+            self.last_prefetch = {
+                "state": "running",
+                "model": model,
+                "checkpoint_dir": checkpoint_dir,
+                "bytes": 0,
+            }
+            self._prefetch_thread = threading.Thread(
+                target=self._prefetch_worker,
+                args=(
+                    model, hf_dir, checkpoint_dir, model_cfg,
+                    self._prefetch_abort,
+                ),
+                daemon=True,
+                name="prefetch",
+            )
+            self._prefetch_thread.start()
+        return dict(self.last_prefetch, started=True)
+
+    def _prefetch_worker(
+        self, model, hf_dir, checkpoint_dir, model_cfg, abort
+    ) -> None:
+        """Prefetch thread body: host-only staging (load_params with
+        place=False — pure file I/O + numpy, no device/HBM touch), then
+        registration in the pool under the swap's key."""
+        from ..models import hf as hf_models
+
+        t0 = time.monotonic()
+        lstats = hf_models.LoadStats()
+        try:
+            staged = hf_models.load_params(
+                hf_dir,
+                model_cfg,
+                place=False,
+                workers=getattr(self.args, "load_workers", 0) or None,
+                abort_event=abort,
+                throttle_bytes_per_s=float(
+                    max(0, getattr(self.args, "prefetch_mib_s", 0)) << 20
+                ),
+                stats=lstats,
+            )
+        except hf_models.LoadAborted:
+            ENGINE_PREFETCHES.labels(outcome="aborted").inc()
+            self.last_prefetch = {
+                "state": "aborted",
+                "model": model,
+                "checkpoint_dir": checkpoint_dir,
+                "bytes": lstats.bytes_read,
+            }
+            return
+        except Exception as e:  # noqa: BLE001 — surfaced via GET /v1/prefetch
+            logger.warning("prefetch of %s failed", model, exc_info=True)
+            ENGINE_PREFETCHES.labels(outcome="failed").inc()
+            self.last_prefetch = {
+                "state": "failed",
+                "model": model,
+                "checkpoint_dir": checkpoint_dir,
+                "error": f"{type(e).__name__}: {e}",
+            }
+            return
+        import jax
+
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(staged))
+        pw = _PrefetchedWeights(
+            model_id=model,
+            checkpoint_dir=checkpoint_dir,
+            params_host=staged,
+            nbytes=nbytes,
+        )
+        evicted = self.model_pool.put(
+            _pool_key(model, checkpoint_dir), pw, nbytes
+        )
+        bounced = any(v.runtime is pw for v in evicted)
+        self._free_pooled(evicted, "evicted by prefetch")
+        if bounced:
+            # raced a concurrent budget change / the estimate was low: the
+            # staging cannot be kept
+            ENGINE_PREFETCHES.labels(outcome="rejected").inc()
+            self.last_prefetch = {
+                "state": "rejected",
+                "model": model,
+                "checkpoint_dir": checkpoint_dir,
+                "bytes": nbytes,
+                "error": "staged bytes exceed the model pool budget",
+            }
+            return
+        ENGINE_PREFETCHES.labels(outcome="completed").inc()
+        ENGINE_PREFETCH_BYTES.set(nbytes)
+        self.last_prefetch = {
+            "state": "completed",
+            "model": model,
+            "checkpoint_dir": checkpoint_dir,
+            "bytes": nbytes,
+            "read_s": round(lstats.read_s, 6),
+            "total_s": round(time.monotonic() - t0, 6),
+            "shards": lstats.shards,
+            "workers": lstats.workers,
+            "pool": self.model_pool.describe(),
+        }
+        logger.info(
+            "prefetched %s host-resident (%.1f MiB in %.3fs)",
+            model, nbytes / 2**20, time.monotonic() - t0,
+        )
+
+    def prefetch_status(self) -> Dict[str, Any]:
+        return dict(self.last_prefetch)
+
+    def abort_prefetch(self) -> Dict[str, Any]:
+        """Cancel the in-flight prefetch (DELETE /v1/prefetch): readers
+        observe the abort event between tensors and unwind without ever
+        registering in the pool."""
+        with self._prefetch_mu:
+            t = self._prefetch_thread
+            if t is None or not t.is_alive():
+                return {
+                    "aborted": False,
+                    "state": self.last_prefetch.get("state", "idle"),
+                }
+            self._prefetch_abort.set()
+        t.join(timeout=60)
+        return {"aborted": True, **self.last_prefetch}
 
     def _make_publisher(self):
         chip_ids = [c for c in os.environ.get("FMA_CHIP_IDS", "").split(",") if c]
@@ -1129,13 +1498,21 @@ class EngineService:
                         )
                     elif self.hf_dir:
                         from ..models import hf as _hf
-                        from ..models.registry import logical_axes_for
 
-                        params = _hf.load_params(self.hf_dir, m)
-                        if eng.mesh is not None:
-                            params = shard_pytree(
-                                params, eng.mesh, logical_axes_for(m)
-                            )
+                        # streaming cold loader, straight onto the mesh
+                        # placement (read of layer k+1 overlaps H2D of k)
+                        params = _hf.load_params(
+                            self.hf_dir, m, mesh=eng.mesh,
+                            workers=getattr(
+                                self.args, "load_workers", 0
+                            ) or None,
+                            max_inflight_bytes=max(
+                                1,
+                                getattr(
+                                    self.args, "load_inflight_mib", 512
+                                ),
+                            ) << 20,
+                        )
                     else:
                         from ..models.registry import (
                             init_params_for,
@@ -1170,6 +1547,12 @@ class EngineService:
     def shutdown(self) -> None:
         self._stop = True
         self._new_work.set()
+        with self._prefetch_mu:
+            t = self._prefetch_thread
+            if t is not None and t.is_alive():
+                self._prefetch_abort.set()
+        if t is not None and t.is_alive():
+            t.join(timeout=10)
         if not self.is_follower:
             # follower threads block inside the broadcast collective and
             # exit with the process (daemon); only the leader's loop joins
@@ -1310,6 +1693,34 @@ def build_app(service: EngineService) -> web.Application:
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(info)
+
+    async def prefetch(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            raise web.HTTPBadRequest(text="prefetch requires a 'model' string")
+        ckpt = body.get("checkpoint_dir") or ""
+        if not isinstance(ckpt, str):
+            raise web.HTTPBadRequest(text="checkpoint_dir must be a string")
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: service.prefetch(model, ckpt)
+            )
+        except (ValueError, FileNotFoundError) as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(info)
+
+    async def prefetch_status(request: web.Request) -> web.Response:
+        return web.json_response(service.prefetch_status())
+
+    async def prefetch_abort(request: web.Request) -> web.Response:
+        info = await asyncio.get_running_loop().run_in_executor(
+            None, service.abort_prefetch
+        )
         return web.json_response(info)
 
     async def models(request: web.Request) -> web.Response:
@@ -1932,6 +2343,9 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
     app.router.add_post("/v1/swap", swap)
+    app.router.add_post("/v1/prefetch", prefetch)
+    app.router.add_get("/v1/prefetch", prefetch_status)
+    app.router.add_delete("/v1/prefetch", prefetch_abort)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)

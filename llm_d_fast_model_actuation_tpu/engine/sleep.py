@@ -70,7 +70,12 @@ def partition_buckets(
     ``bucket_bytes`` each. Leaves are never split (bit-exactness is then
     structural), so a single leaf larger than the bound forms its own
     bucket. ``bucket_bytes=None`` (or <= 0) returns one bucket holding
-    everything — the whole-tree legacy path."""
+    everything — the whole-tree legacy path.
+
+    Shared transfer discipline: the streaming cold-start loader
+    (models/hf.py) buckets its host->device stream with this same
+    partition, so sleep/wake, hot-swap, and cold load all bound their
+    in-flight window the same way."""
     if not nbytes:
         return []
     if not bucket_bytes or bucket_bytes <= 0:

@@ -61,6 +61,18 @@ class SwapFailed(Exception):
         self.detail = detail
 
 
+class PrefetchFailed(Exception):
+    """The engine child rejected (or never answered) a prefetch verb."""
+
+    def __init__(self, instance_id: str, status: int, detail: str) -> None:
+        super().__init__(
+            f"prefetch on instance {instance_id} failed ({status}): {detail}"
+        )
+        self.instance_id = instance_id
+        self.status = status
+        self.detail = detail
+
+
 def probe_instance_awake(instance: "EngineInstance") -> Optional[bool]:
     """Ask the instance's engine admin API whether it still holds its chips.
 
@@ -92,6 +104,11 @@ class ChipLedger:
     def __init__(self) -> None:
         self._held: Dict[str, List[str]] = {}  # instance_id -> chip_ids
         self._models: Dict[str, str] = {}  # instance_id -> served model
+        #: instance_id -> model hinted/staged via the prefetch verb: the
+        #: controller's "predicted next model" for this holder. Cleared
+        #: when the hint is consumed (swap to that model), aborted, or
+        #: the holder releases its chips.
+        self._prefetched: Dict[str, str] = {}
 
     def overlapping(
         self, chip_ids: Optional[List[str]], exclude: Optional[str] = None
@@ -114,17 +131,32 @@ class ChipLedger:
     def release(self, instance_id: str) -> None:
         self._held.pop(instance_id, None)
         self._models.pop(instance_id, None)
+        self._prefetched.pop(instance_id, None)
 
     def set_model(self, instance_id: str, model: str) -> None:
-        """Record which model a holder serves (updated on hot-swap)."""
+        """Record which model a holder serves (updated on hot-swap). A
+        swap to the prefetched model consumes the prefetch hint."""
         if instance_id in self._held:
             self._models[instance_id] = model
+            if self._prefetched.get(instance_id) == model:
+                self._prefetched.pop(instance_id, None)
+
+    def set_prefetched(self, instance_id: str, model: Optional[str]) -> None:
+        """Record (or with None, clear) the model a holder has staged via
+        the prefetch verb."""
+        if model is None:
+            self._prefetched.pop(instance_id, None)
+        elif instance_id in self._held:
+            self._prefetched[instance_id] = model
 
     def holders(self) -> Dict[str, List[str]]:
         return dict(self._held)
 
     def models(self) -> Dict[str, str]:
         return dict(self._models)
+
+    def prefetched(self) -> Dict[str, str]:
+        return dict(self._prefetched)
 
 
 class EngineProcessManager:
@@ -285,30 +317,14 @@ class EngineProcessManager:
         from ..engine.server import parse_engine_options
 
         try:
-            opts = parse_engine_options(instance.config.options)
-        except Exception as e:
-            # free-form options are tolerated at create time (fake-kickoff
-            # managers); a swap on such an instance is a clear client error
-            raise SwapFailed(
-                instance_id, 400, f"stored options are not engine options: {e}"
-            )
-        previous = opts.model
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{opts.port}/v1/swap",
-            data=json.dumps(
-                {"model": model, "checkpoint_dir": checkpoint_dir}
-            ).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
+            previous = parse_engine_options(instance.config.options).model
+        except Exception:
+            previous = ""
+        body = self._engine_request(
+            instance_id, "POST", "/v1/swap",
+            {"model": model, "checkpoint_dir": checkpoint_dir},
+            timeout, SwapFailed,
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                body = json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise SwapFailed(instance_id, e.code, detail)
-        except Exception as e:  # noqa: BLE001 — unreachable child, timeout, ...
-            raise SwapFailed(instance_id, 502, f"engine unreachable: {e}")
         from .instance import replace_model_option
 
         # rewrite from the ENGINE's answer, not the request: a pool hit
@@ -336,6 +352,112 @@ class EngineProcessManager:
             "swap": body,
             "revision": instance.last_revision,
         }
+
+    def _engine_request(
+        self,
+        instance_id: str,
+        method: str,
+        api_path: str,
+        body: Optional[Dict[str, Any]],
+        timeout: float,
+        exc_cls,
+    ) -> Dict[str, Any]:
+        """Forward an admin verb to a live instance's engine child; maps
+        stored-options/HTTP failures onto `exc_cls(instance_id, status,
+        detail)` the REST layer turns into 4xx/502."""
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        instance = self.instances[instance_id]
+        from ..engine.server import parse_engine_options
+
+        try:
+            opts = parse_engine_options(instance.config.options)
+        except Exception as e:
+            # free-form options are tolerated at create time (fake-kickoff
+            # managers); admin verbs on such an instance are a client error
+            raise exc_cls(
+                instance_id, 400,
+                f"stored options are not engine options: {e}",
+            )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{opts.port}{api_path}",
+            data=None if body is None else json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise exc_cls(instance_id, e.code, detail)
+        except Exception as e:  # noqa: BLE001 — unreachable child, timeout, ...
+            raise exc_cls(instance_id, 502, f"engine unreachable: {e}")
+
+    def prefetch_instance(
+        self,
+        instance_id: str,
+        model: str,
+        checkpoint_dir: str = "",
+        timeout: float = 60,
+    ) -> Dict[str, Any]:
+        """Background-prefetch verb: have a live instance stage `model`'s
+        weights host-resident (engine POST /v1/prefetch) while it keeps
+        serving its current model, and record the hint in the ChipLedger —
+        the dual-pods controller's way of warming the predicted next swap
+        without touching the chip set or the serving process."""
+        body = self._engine_request(
+            instance_id, "POST", "/v1/prefetch",
+            {"model": model, "checkpoint_dir": checkpoint_dir},
+            timeout, PrefetchFailed,
+        )
+        # The hint is ADVISORY: it is recorded when the engine accepts the
+        # staging and the background outcome is reconciled on status reads
+        # (get_instance_prefetch drops it on failed/rejected/aborted) — a
+        # controller that acts on the hint without having polled may still
+        # get a cold build if the staging later failed.
+        self.ledger.set_prefetched(instance_id, model)
+        logger.info(
+            "prefetch on instance %s: %s (state=%s)",
+            instance_id, model, body.get("state"),
+        )
+        return {
+            "instance_id": instance_id,
+            "model": model,
+            "prefetch": body,
+        }
+
+    def abort_instance_prefetch(
+        self, instance_id: str, timeout: float = 90
+    ) -> Dict[str, Any]:
+        """Cancel an instance's in-flight prefetch (engine DELETE
+        /v1/prefetch) and drop the ledger hint."""
+        body = self._engine_request(
+            instance_id, "DELETE", "/v1/prefetch", None, timeout,
+            PrefetchFailed,
+        )
+        # keep the hint when there was nothing to abort because the
+        # prefetch already COMPLETED: the staged weights are still pooled
+        # and a swap to them is still warm — the hint is still true
+        if body.get("aborted") or body.get("state") != "completed":
+            self.ledger.set_prefetched(instance_id, None)
+        return {
+            "instance_id": instance_id,
+            "prefetch": body,
+        }
+
+    def get_instance_prefetch(
+        self, instance_id: str, timeout: float = 10
+    ) -> Dict[str, Any]:
+        """Prefetch status passthrough (engine GET /v1/prefetch). Also
+        reconciles the advisory ledger hint: a staging that ended
+        failed/rejected/aborted is no longer a warm next model."""
+        body = self._engine_request(
+            instance_id, "GET", "/v1/prefetch", None, timeout, PrefetchFailed
+        )
+        if body.get("state") in ("failed", "rejected", "aborted"):
+            self.ledger.set_prefetched(instance_id, None)
+        return {"instance_id": instance_id, "prefetch": body}
 
     def stop_all_instances(self, timeout: float = 10) -> Dict[str, Any]:
         stopped = []

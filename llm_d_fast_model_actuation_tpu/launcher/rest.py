@@ -23,6 +23,7 @@ from ..utils.events import RevisionTooOld
 from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
 from .manager import ChipConflict
 from .manager import EngineProcessManager
+from .manager import PrefetchFailed
 from .manager import SwapFailed
 
 logger = logging.getLogger(__name__)
@@ -65,6 +66,9 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "get_all_instances": "GET /v2/vllm/instances",
                     "get_instance_logs": "GET /v2/vllm/instances/{instance_id}/log",
                     "swap_instance": "POST /v2/vllm/instances/{instance_id}/swap",
+                    "prefetch_instance": "POST /v2/vllm/instances/{instance_id}/prefetch",
+                    "prefetch_status": "GET /v2/vllm/instances/{instance_id}/prefetch",
+                    "abort_prefetch": "DELETE /v2/vllm/instances/{instance_id}/prefetch",
                     "watch_instances": "GET /v2/vllm/instances/watch",
                 },
             }
@@ -228,6 +232,69 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             raise web.HTTPBadGateway(text=str(e))
         return web.json_response(result)
 
+    def _map_prefetch_error(e: PrefetchFailed):
+        # engine-side rejection (bad model, gang, already running) is the
+        # client's fault; an unreachable child is a gateway error
+        if 400 <= e.status < 500:
+            return web.HTTPBadRequest(text=str(e))
+        return web.HTTPBadGateway(text=str(e))
+
+    async def prefetch_instance(request: web.Request) -> web.Response:
+        """Background-prefetch verb: stage a model's weights host-resident
+        on a live instance (engine POST /v1/prefetch) while it keeps
+        serving — the controller's hint for the predicted next swap."""
+        instance_id = request.match_info["instance_id"]
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str) or not model:
+            raise web.HTTPUnprocessableEntity(
+                text="prefetch requires a 'model' string"
+            )
+        checkpoint_dir = body.get("checkpoint_dir") or ""
+        if not isinstance(checkpoint_dir, str):
+            raise web.HTTPUnprocessableEntity(
+                text="checkpoint_dir must be a string"
+            )
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: manager.prefetch_instance(
+                    instance_id, model, checkpoint_dir=checkpoint_dir
+                ),
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except PrefetchFailed as e:
+            raise _map_prefetch_error(e)
+        return web.json_response(result)
+
+    async def get_instance_prefetch(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: manager.get_instance_prefetch(instance_id)
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except PrefetchFailed as e:
+            raise _map_prefetch_error(e)
+        return web.json_response(result)
+
+    async def abort_instance_prefetch(request: web.Request) -> web.Response:
+        instance_id = request.match_info["instance_id"]
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: manager.abort_instance_prefetch(instance_id)
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except PrefetchFailed as e:
+            raise _map_prefetch_error(e)
+        return web.json_response(result)
+
     async def get_log(request: web.Request) -> web.Response:
         instance_id = request.match_info["instance_id"]
         range_header = request.headers.get("Range")
@@ -280,6 +347,15 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     app.router.add_get("/v2/vllm/instances/{instance_id}", get_one)
     app.router.add_get("/v2/vllm/instances/{instance_id}/log", get_log)
     app.router.add_post("/v2/vllm/instances/{instance_id}/swap", swap_instance)
+    app.router.add_post(
+        "/v2/vllm/instances/{instance_id}/prefetch", prefetch_instance
+    )
+    app.router.add_get(
+        "/v2/vllm/instances/{instance_id}/prefetch", get_instance_prefetch
+    )
+    app.router.add_delete(
+        "/v2/vllm/instances/{instance_id}/prefetch", abort_instance_prefetch
+    )
 
     async def on_shutdown(app: web.Application) -> None:
         manager.stop_all_instances()

@@ -111,12 +111,21 @@ def load_params(
     directory: str,
     cfg: llama.LlamaConfig,
     mesh: Optional[jax.sharding.Mesh] = None,
+    stats_out: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Restore params from `directory`, directly into their serving
     placement (sharded over `mesh` when given, committed to the default
     device otherwise). Checkpoints are always the bf16 form: a quantized
     serving config restores bf16 and quantizes on the way in (runtime
-    quantization, models/quant.py)."""
+    quantization, models/quant.py).
+
+    ``stats_out`` (a dict, filled in place) records ``restore_s`` (the
+    disk->device restore wall — Orbax lands each leaf straight in its
+    placement, so read and H2D are one window) and ``bytes`` — the
+    cold-load accounting the engine's swap metrics report on pool
+    misses."""
+    import time
+
     import orbax.checkpoint as ocp
 
     serve_cfg = cfg
@@ -161,8 +170,14 @@ def load_params(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sharding),
             abstract,
         )
+    t0 = time.monotonic()
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(os.path.join(directory, PARAMS_DIR), target)
+    if stats_out is not None:
+        stats_out["restore_s"] = time.monotonic() - t0
+        stats_out["bytes"] = sum(
+            x.nbytes for x in jax.tree.leaves(params)
+        )
     if serve_cfg is not cfg:
         from .registry import logical_axes_for, maybe_quantize
 

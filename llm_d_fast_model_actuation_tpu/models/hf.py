@@ -26,11 +26,14 @@ Layout notes:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Dict, Iterator, Tuple
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
-import jax.numpy as jnp
 import numpy as np
 
 from .llama import LlamaConfig
@@ -167,18 +170,48 @@ def eos_token_id_from_hf(path: str, default: int = 2) -> int:
 
 # -- weight loading ----------------------------------------------------------
 
+#: bytes-in-flight bound for the streaming loader's host->device transfers
+#: (~two 256 MiB buckets double-buffered, the same window discipline as
+#: engine/sleep.py's chunked swap transfers)
+DEFAULT_LOAD_INFLIGHT_BYTES = 512 << 20
 
-def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
-    """Yield (hf_name, fp32 numpy array) over every tensor in the
-    checkpoint, shard by shard (single-file, indexed-shard, or legacy
-    pytorch_model.bin layouts)."""
+
+class LoadAborted(RuntimeError):
+    """A cold load / prefetch was cancelled through its abort event."""
+
+
+@dataclasses.dataclass
+class LoadStats:
+    """Cold-load phase breakdown, filled in place by ``load_params(...,
+    stats=...)``. Wall windows can overlap: ``overlap_s`` is the time both
+    the disk-read pipeline and host->device transfers were in flight — the
+    streaming win over a read-everything-then-transfer schedule."""
+
+    total_s: float = 0.0
+    read_s: float = 0.0  #: wall window: load start -> last tensor staged
+    convert_s: float = 0.0  #: cumulative casted-copy time (sum over readers)
+    h2d_s: float = 0.0  #: wall window: first transfer issued -> last landed
+    overlap_s: float = 0.0
+    overlap_frac: float = 0.0  #: overlap_s / total_s
+    bytes_read: int = 0  #: native source bytes staged
+    bytes_h2d: int = 0  #: device bytes transferred
+    buckets_h2d: int = 0
+    shards: int = 0
+    workers: int = 0
+    streaming: bool = False
+
+
+def _shard_files(path: str) -> Tuple[str, List[str]]:
+    """Resolve the checkpoint's shard layout WITHOUT reading tensor data:
+    ``("safetensors" | "bin", ordered file list)``.
+
+    A sharded checkpoint declares its shard set in the index file; a
+    missing shard would otherwise just mean fewer tensors iterated (and
+    silently zeroed layers, before load_params grew slice tracking). Fail
+    up front — before any staging work — with the exact absent files."""
     st_files = sorted(
         f for f in os.listdir(path) if f.endswith(".safetensors")
     )
-    # A sharded checkpoint declares its shard set in the index file; a
-    # missing shard would otherwise just mean fewer tensors iterated (and
-    # silently zeroed layers, before load_params grew slice tracking).
-    # Fail up front with the exact files that are absent.
     idx_path = os.path.join(path, "model.safetensors.index.json")
     if os.path.isfile(idx_path):
         with open(idx_path) as f:
@@ -196,16 +229,7 @@ def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
         if declared:
             st_files = declared
     if st_files:
-        from safetensors import safe_open
-
-        for fname in st_files:
-            with safe_open(
-                os.path.join(path, fname), framework="pt", device="cpu"
-            ) as f:
-                for name in f.keys():
-                    t = f.get_tensor(name)
-                    yield name, t.to_dense().float().numpy()
-        return
+        return "safetensors", st_files
     bin_files = sorted(
         f
         for f in os.listdir(path)
@@ -215,14 +239,59 @@ def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
         raise FileNotFoundError(
             f"no *.safetensors or pytorch_model*.bin under {path!r}"
         )
+    return "bin", bin_files
+
+
+def _native_numpy(t) -> np.ndarray:
+    """torch tensor -> numpy in the tensor's OWN dtype. bfloat16 (which
+    numpy cannot express natively) goes through a bit-level uint16 view
+    onto ml_dtypes.bfloat16 — never an fp32 copy. Every tensor the loader
+    stages passes through here, so this is the choke point the
+    no-fp32-transient regression test instruments."""
     import torch
 
-    for fname in bin_files:
-        sd = torch.load(
-            os.path.join(path, fname), map_location="cpu", weights_only=True
-        )
-        for name, t in sd.items():
-            yield name, t.float().numpy()
+    if t.layout != torch.strided:
+        t = t.to_dense()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _iter_shard_tensors(
+    path: str, kind: str, fname: str
+) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, native-dtype numpy array) for one shard file.
+    safetensors shards are mmap-backed (one tensor resident at a time);
+    the legacy .bin path drops each tensor's state-dict reference as it is
+    consumed, so its peak host memory matches the safetensors path's
+    one-tensor transient instead of holding the whole shard alive."""
+    if kind == "safetensors":
+        from safetensors import safe_open
+
+        with safe_open(
+            os.path.join(path, fname), framework="pt", device="cpu"
+        ) as f:
+            for name in f.keys():
+                yield name, _native_numpy(f.get_tensor(name))
+        return
+    import torch
+
+    sd = torch.load(
+        os.path.join(path, fname), map_location="cpu", weights_only=True
+    )
+    for name in sorted(sd.keys()):
+        yield name, _native_numpy(sd.pop(name))
+
+
+def _iter_tensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, native-dtype numpy array) over every tensor in the
+    checkpoint, shard by shard (single-file, indexed-shard, or legacy
+    pytorch_model.bin layouts)."""
+    kind, files = _shard_files(path)
+    for fname in files:
+        yield from _iter_shard_tensors(path, kind, fname)
 
 
 #: per-layer HF suffix -> (our key, transpose?)
@@ -260,20 +329,157 @@ _TOP_MAP: Dict[str, Tuple[str, bool]] = {
 }
 
 
-def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
-    """Load an HF checkpoint into the stacked (L, ...) param tree.
+def _route(
+    name: str, tie_embeddings: bool
+) -> Optional[Tuple[Tuple[str, ...], Optional[int], Optional[int], bool]]:
+    """Map an HF tensor name -> (tree_key, layer, expert, transpose);
+    None for deliberately-ignored tensors (precomputed buffers, tied
+    lm_head); ValueError for anything unrecognized — a silently-dropped
+    weight would serve wrong logits."""
+    if name in _TOP_MAP:
+        ours, transpose = _TOP_MAP[name]
+        if ours == "lm_head" and tie_embeddings:
+            return None  # tied: the forward reuses embed.T
+        return (ours,), None, None, transpose
+    if not name.startswith("model.layers."):
+        if name.endswith(_IGNORED_SUFFIXES):
+            return None
+        raise ValueError(f"unrecognized checkpoint tensor {name!r}")
+    rest = name[len("model.layers.") :]
+    idx, _, suffix = rest.partition(".")
+    if not idx.isdigit():
+        raise ValueError(f"unrecognized checkpoint tensor {name!r}")
+    layer = int(idx)
+    if suffix in _LAYER_MAP:
+        ours, transpose = _LAYER_MAP[suffix]
+        return ("layers", ours), layer, None, transpose
+    if suffix == "block_sparse_moe.gate.weight":
+        return ("layers", "router"), layer, None, True
+    if suffix.startswith("block_sparse_moe.experts."):
+        rest2 = suffix[len("block_sparse_moe.experts.") :]
+        e_str, _, w = rest2.partition(".")
+        if w not in _EXPERT_MAP:
+            raise ValueError(f"unrecognized expert tensor {name!r}")
+        ours, transpose = _EXPERT_MAP[w]
+        return ("layers", ours), layer, int(e_str), transpose
+    if suffix.endswith(_IGNORED_SUFFIXES):
+        return None
+    raise ValueError(f"unrecognized checkpoint tensor {name!r}")
 
-    Tensors are staged per-layer into numpy buffers already in
-    `cfg.dtype` (the only fp32 transient is the single tensor being
-    converted), so peak host memory is ~one model in target dtype plus
-    one tensor — not an fp32 copy of the whole model.
+
+def _want_slices(flat: str, node: Any, n_experts: int) -> Set[tuple]:
+    """Every (layer[, expert]) slice the model expects a checkpoint tensor
+    to write for this stacked key (``("*",)`` = one whole-key write)."""
+    parts = flat.split("/")
+    if parts[0] == "layers":
+        n_layers = node.shape[0]
+        if n_experts and parts[-1] in ("w_gate", "w_up", "w_down"):
+            return {
+                (l, e) for l in range(n_layers) for e in range(n_experts)
+            }
+        return {(l,) for l in range(n_layers)}
+    return {("*",)}
+
+
+def _flat_targets(cfg: LlamaConfig, shapes: Dict[str, Any], mesh) -> Dict[str, Any]:
+    """Per-flat-key device_put target: the serving NamedSharding on a mesh
+    (same logical-axis rules the engine serves with), the default device
+    otherwise."""
+    import jax
+
+    if mesh is None:
+        dev = jax.devices()[0]
+        return {"/".join(p): dev for p, _ in _flatten(shapes)}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import named_sharding
+    from .registry import logical_axes_for
+
+    axes = {"/".join(p): ax for p, ax in _flatten(logical_axes_for(cfg))}
+    return {
+        "/".join(p): (
+            NamedSharding(mesh, P())
+            if axes.get("/".join(p)) is None
+            else named_sharding(mesh, axes["/".join(p)])
+        )
+        for p, _ in _flatten(shapes)
+    }
+
+
+def _quantize_and_repin(
+    cfg: LlamaConfig, params: Dict[str, Any], mesh: Any
+) -> Dict[str, Any]:
+    """Shared device-placement epilogue: apply the config's runtime
+    quantization and — because the eager quantize ops don't all preserve
+    the serving sharding (scale reductions in particular) — re-pin the
+    quantized tree onto the mesh."""
+    from .registry import maybe_quantize
+
+    params = maybe_quantize(cfg, params)
+    if getattr(cfg, "quantization", "") and mesh is not None:
+        from ..parallel.mesh import shard_pytree
+
+        from .registry import logical_axes_for
+
+        params = shard_pytree(params, mesh, logical_axes_for(cfg))
+    return params
+
+
+def load_params(
+    path: str,
+    cfg: LlamaConfig,
+    *,
+    mesh: Any = None,
+    workers: Optional[int] = None,
+    streaming: Optional[bool] = None,
+    place: bool = True,
+    max_inflight_bytes: Optional[int] = None,
+    abort_event: Optional[threading.Event] = None,
+    throttle_bytes_per_s: float = 0.0,
+    stats: Optional[LoadStats] = None,
+) -> Dict[str, Any]:
+    """Load an HF checkpoint into the stacked (L, ...) param tree — the
+    pipelined, parallel cold-start path.
+
+    Three overlapped stages:
+      * **parallel shard readers** — a bounded thread pool over shard
+        files (``workers``; <=0/None = auto). safetensors shards are
+        mmap-backed and both the rust reads and the numpy casted copies
+        release the GIL, so readers genuinely run concurrently.
+      * **direct dtype staging** — each tensor is casted-copied from its
+        NATIVE source dtype straight into the cfg.dtype stacked buffer
+        (bfloat16 via ml_dtypes bit views): the per-tensor fp32 transient
+        of the old loader is gone, and peak host memory stays ~one model
+        in target dtype plus one source tensor.
+      * **streaming device placement** (``streaming``, default on when
+        ``place``) — the moment a stacked buffer's last slice lands, its
+        host->device transfer is issued on a dedicated thread, bucketed
+        and double-buffered with in-flight bytes bounded by
+        ``max_inflight_bytes`` (engine/sleep.py's transfer discipline), so
+        the disk read of layer k+1 overlaps the H2D of layer k. Each
+        buffer's host copy is freed as its transfer lands.
+
+    ``streaming=False`` runs the identical machinery on a strictly
+    sequential schedule (all reads, then all transfers) — the paired
+    baseline ``bench.py coldload`` compares against. ``place=False``
+    skips device placement entirely and returns the host-staged plain
+    (unquantized) numpy tree — the background-prefetch path, which must
+    never touch HBM; ``place_staged_params`` is its deferred second half.
+
+    ``abort_event`` (checked between tensors) raises LoadAborted;
+    ``throttle_bytes_per_s`` bounds read bandwidth (prefetch I/O
+    throttle). ``stats`` (a LoadStats) is filled in place.
+
+    Bit-exactness: staging writes disjoint slices whose values do not
+    depend on schedule, so any (workers, streaming) combination produces
+    the same tree as the sequential loader.
     """
     import jax
 
     from .registry import init_params_for  # shape source of truth
 
-    import dataclasses
-
+    t_begin = time.monotonic()
+    st = stats if stats is not None else LoadStats()
     # eval_shape over the UNquantized tree: staging happens in cfg.dtype,
     # quantization (if any) runs once at the end like the serving path
     plain = (
@@ -285,22 +491,48 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
         lambda: init_params_for(jax.random.key(0), plain)
     )
     np_dtype = np.dtype(cfg.dtype)  # ml_dtypes registers bfloat16
-    buffers: Dict[str, Any] = {}
+    # shard layout first: a declared-but-absent shard must fail before any
+    # staging work starts
+    kind, files = _shard_files(path)
+    if workers is None or int(workers) <= 0:
+        workers = min(8, os.cpu_count() or 1)
+    workers = max(1, min(int(workers), len(files)))
+    if streaming is None:
+        streaming = place
+    streaming = bool(streaming and place)
+    inflight_bound = int(max_inflight_bytes or DEFAULT_LOAD_INFLIGHT_BYTES)
+    st.workers, st.shards, st.streaming = workers, len(files), streaming
+
+    flat_shapes = {"/".join(p): n for p, n in _flatten(shapes)}
+    n_experts = int(getattr(cfg, "num_experts", 0) or 0)
+    want = {k: _want_slices(k, n, n_experts) for k, n in flat_shapes.items()}
+
+    buffers: Dict[str, np.ndarray] = {}
     # Stacked buffers start zeroed, so "the key exists" is not evidence the
     # checkpoint supplied every layer/expert slice — a shard missing from an
     # un-indexed checkpoint would serve zeroed layers. Track exactly which
     # slices each staged tensor wrote; completeness is checked per slice
     # below. (transformers/vLLM get this via the safetensors index; we also
-    # verify that in _iter_tensors when the index file exists.)
-    staged: Dict[str, set] = {}
+    # verify that in _shard_files when the index file exists.)
+    staged: Dict[str, set] = {k: set() for k in flat_shapes}
+    remaining = {k: len(s) for k, s in want.items()}
+    mu = threading.Lock()
+    ready: "queue.Queue[Optional[str]]" = queue.Queue()
+    tie = bool(getattr(cfg, "tie_embeddings", False))
+    convert_s = [0.0]
+    bytes_read = [0]
+    stop = threading.Event()  # internal: first reader error stops siblings
 
-    def stage(
-        tree_key: Tuple[str, ...],
-        layer: int | None,
-        arr: np.ndarray,
-        expert: int | None = None,
-        name: str = "",
-    ):
+    def _aborted() -> bool:
+        return stop.is_set() or (
+            abort_event is not None and abort_event.is_set()
+        )
+
+    def stage(name: str, arr: np.ndarray) -> None:
+        route = _route(name, tie)
+        if route is None:
+            return
+        tree_key, layer, expert, transpose = route
         node = shapes
         for k in tree_key:
             if not isinstance(node, dict) or k not in node:
@@ -308,119 +540,320 @@ def load_params(path: str, cfg: LlamaConfig) -> Dict[str, Any]:
                 # dropped weight otherwise (e.g. biases with
                 # attn_bias=False, q_norm without qk_norm)
                 raise ValueError(
-                    f"checkpoint tensor {name or '/'.join(tree_key)} has no "
+                    f"checkpoint tensor {name} has no "
                     f"place in the model config (architecture mismatch?)"
                 )
             node = node[k]
         flat = "/".join(tree_key)
-        if flat not in buffers:
-            buffers[flat] = np.zeros(node.shape, dtype=np_dtype)
+        if transpose:
+            arr = arr.T
         if expert is not None:
-            want, dst = node.shape[2:], lambda b: b[layer].__setitem__(
-                expert, arr.astype(np_dtype)
-            )
+            want_shape, sl = node.shape[2:], (layer, expert)
         elif layer is not None:
-            want, dst = node.shape[1:], lambda b: b.__setitem__(
-                layer, arr.astype(np_dtype)
-            )
+            want_shape, sl = node.shape[1:], (layer,)
         else:
-            want, dst = node.shape, lambda b: b.__setitem__(
-                ..., arr.astype(np_dtype)
-            )
-        if arr.shape != tuple(want):
+            want_shape, sl = node.shape, ("*",)
+        if arr.shape != tuple(want_shape):
             raise ValueError(
-                f"{flat}: checkpoint shape {arr.shape} != model {tuple(want)}"
+                f"{flat}: checkpoint shape {arr.shape} != model "
+                f"{tuple(want_shape)}"
             )
-        dst(buffers[flat])
-        if expert is not None:
-            staged.setdefault(flat, set()).add((layer, expert))
-        elif layer is not None:
-            staged.setdefault(flat, set()).add((layer,))
+        with mu:
+            buf = buffers.get(flat)
+            if buf is None:
+                buf = buffers[flat] = np.zeros(node.shape, dtype=np_dtype)
+        t0 = time.monotonic()
+        # the ONLY conversion on the path: a casted copy from the native
+        # source dtype into the cfg.dtype buffer slice (no fp32 transient;
+        # disjoint slices, so concurrent readers need no lock here)
+        if sl == ("*",):
+            buf[...] = arr
+        elif expert is not None:
+            buf[layer, expert] = arr
         else:
-            staged.setdefault(flat, set()).add(("*",))
+            buf[layer] = arr
+        dt = time.monotonic() - t0
+        with mu:
+            convert_s[0] += dt
+            bytes_read[0] += arr.nbytes
+            got = staged[flat]
+            if sl not in got:
+                got.add(sl)
+                remaining[flat] -= 1
+                if remaining[flat] == 0 and streaming:
+                    ready.put(flat)
 
-    for name, arr in _iter_tensors(path):
-        if name in _TOP_MAP:
-            ours, transpose = _TOP_MAP[name]
-            if ours == "lm_head" and cfg.tie_embeddings:
-                continue  # tied: the forward reuses embed.T
-            stage((ours,), None, arr.T if transpose else arr, name=name)
-            continue
-        if not name.startswith("model.layers."):
-            if name.endswith(_IGNORED_SUFFIXES):
-                continue
-            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
-        rest = name[len("model.layers.") :]
-        idx, _, suffix = rest.partition(".")
-        if not idx.isdigit():
-            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
-        layer = int(idx)
-        if suffix in _LAYER_MAP:
-            ours, transpose = _LAYER_MAP[suffix]
-            stage(
-                ("layers", ours), layer, arr.T if transpose else arr,
-                name=name,
-            )
-        elif suffix == "block_sparse_moe.gate.weight":
-            stage(("layers", "router"), layer, arr.T, name=name)
-        elif suffix.startswith("block_sparse_moe.experts."):
-            rest2 = suffix[len("block_sparse_moe.experts.") :]
-            e_str, _, w = rest2.partition(".")
-            if w not in _EXPERT_MAP:
-                raise ValueError(f"unrecognized expert tensor {name!r}")
-            ours, transpose = _EXPERT_MAP[w]
-            stage(
-                ("layers", ours), layer, arr.T if transpose else arr,
-                expert=int(e_str), name=name,
-            )
-        elif suffix.endswith(_IGNORED_SUFFIXES):
-            continue
-        else:
-            raise ValueError(f"unrecognized checkpoint tensor {name!r}")
+    throttle_t0 = time.monotonic()
 
-    # Per-slice completeness: every (key, layer[, expert]) the model expects
-    # must have been written by some checkpoint tensor — whole-key presence
-    # is not enough (stacked buffers zero-init, so one staged layer would
-    # mask the rest being absent).
-    n_experts = int(getattr(cfg, "num_experts", 0) or 0)
-    problems = []
-    for p, node in _flatten(shapes):
-        flat = "/".join(p)
-        got = staged.get(flat, set())
-        if ("*",) in got:
-            continue
-        if p[0] == "layers":
-            n_layers = node.shape[0]
-            if n_experts and p[-1] in ("w_gate", "w_up", "w_down"):
-                want_slices = {
-                    (l, e)
-                    for l in range(n_layers)
-                    for e in range(n_experts)
-                }
-            else:
-                want_slices = {(l,) for l in range(n_layers)}
-        else:
-            want_slices = {("*",)}
-        absent = want_slices - got
-        if absent:
-            ex = sorted(absent)[:4]
-            problems.append(
-                f"{flat}: {len(absent)}/{len(want_slices)} slices never "
-                f"staged (e.g. {ex})"
-            )
-    if problems:
-        raise ValueError(
-            f"checkpoint {path!r} is incomplete: " + "; ".join(sorted(problems))
+    def read_shard(fname: str) -> None:
+        try:
+            _read_shard(fname)
+        except LoadAborted:
+            raise
+        except BaseException:
+            # fail fast from INSIDE the failing worker: the main thread
+            # collects futures in submission order, so without this a
+            # wrong tensor in the last shard would let every earlier
+            # shard read (and stream to device) to completion first
+            stop.set()
+            raise
+
+    def _read_shard(fname: str) -> None:
+        for name, arr in _iter_shard_tensors(path, kind, fname):
+            if _aborted():
+                raise LoadAborted(f"load of {path!r} aborted")
+            stage(name, arr)
+            if throttle_bytes_per_s and throttle_bytes_per_s > 0:
+                with mu:
+                    b = bytes_read[0]
+                ahead = b / throttle_bytes_per_s - (
+                    time.monotonic() - throttle_t0
+                )
+                while ahead > 0 and not _aborted():
+                    time.sleep(min(ahead, 0.2))
+                    ahead = b / throttle_bytes_per_s - (
+                        time.monotonic() - throttle_t0
+                    )
+
+    # -- streaming h2d transfer thread (bucketed, double-buffered) ----------
+    placed: Dict[str, Any] = {}
+    xfer_err: List[BaseException] = []
+    h2d_win: List[Optional[float]] = [None, None]
+    h2d_counts = [0, 0]  # buckets, bytes
+    targets = _flat_targets(plain, shapes, mesh) if place else {}
+
+    def run_transfers() -> None:
+        from ..engine.sleep import partition_buckets
+
+        # double-buffered: bucket k+1 is issued while bucket k drains, so
+        # in-flight bytes stay ~<= inflight_bound (two buckets)
+        bucket_bytes = max(1, inflight_bound // 2)
+        pending = None  # (flats, puts, nbytes)
+
+        def finish(p) -> None:
+            flats, puts, nb = p
+            puts = jax.block_until_ready(puts)
+            with mu:
+                for f, a in zip(flats, puts):
+                    placed[f] = a
+                    buffers.pop(f, None)  # host copy freed as it lands
+            h2d_counts[0] += 1
+            h2d_counts[1] += nb
+            h2d_win[1] = time.monotonic()
+
+        try:
+            draining = False
+            while not draining:
+                item = ready.get()
+                if item is None:
+                    break
+                flats = [item]
+                while True:
+                    try:
+                        nxt = ready.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        draining = True
+                        break
+                    flats.append(nxt)
+                with mu:
+                    arrs = {f: buffers[f] for f in flats}
+                nbs = [arrs[f].nbytes for f in flats]
+                for bucket in partition_buckets(nbs, bucket_bytes):
+                    bflats = [flats[i] for i in bucket]
+                    if h2d_win[0] is None:
+                        h2d_win[0] = time.monotonic()
+                    puts = jax.device_put(
+                        [arrs[f] for f in bflats],
+                        [targets[f] for f in bflats],
+                    )
+                    cur = (bflats, puts, sum(nbs[i] for i in bucket))
+                    if pending is not None:
+                        finish(pending)
+                    pending = cur
+            if pending is not None:
+                pending_, pending = pending, None
+                finish(pending_)
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            xfer_err.append(e)
+
+    xfer_thread = None
+    if place:
+        xfer_thread = threading.Thread(
+            target=run_transfers, name="hf-load-h2d", daemon=True
         )
-    params = _unflatten(
-        {k: jnp.asarray(v) for k, v in buffers.items()}
+        xfer_thread.start()
+
+    # -- reads ---------------------------------------------------------------
+    err: Optional[BaseException] = None
+    try:
+        if workers == 1:
+            for fname in files:
+                read_shard(fname)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                workers, thread_name_prefix="hf-load-read"
+            ) as pool:
+                futs = [pool.submit(read_shard, f) for f in files]
+                for fut in futs:
+                    try:
+                        fut.result()
+                    except LoadAborted as e:
+                        if err is None:
+                            err = e
+                    except BaseException as e:  # noqa: BLE001
+                        # the first REAL error wins (in file order);
+                        # sibling LoadAborted from the stop signal is noise
+                        if err is None or isinstance(err, LoadAborted):
+                            err = e
+                        stop.set()
+    except BaseException as e:  # noqa: BLE001 — single-worker path
+        err = e
+    read_t1 = time.monotonic()
+
+    if err is None:
+        # Per-slice completeness: every (key, layer[, expert]) the model
+        # expects must have been written by some checkpoint tensor —
+        # whole-key presence is not enough (stacked buffers zero-init, so
+        # one staged layer would mask the rest being absent).
+        problems = []
+        for flat in flat_shapes:
+            absent = want[flat] - staged[flat]
+            if absent:
+                ex = sorted(absent)[:4]
+                problems.append(
+                    f"{flat}: {len(absent)}/{len(want[flat])} slices never "
+                    f"staged (e.g. {ex})"
+                )
+        if problems:
+            err = ValueError(
+                f"checkpoint {path!r} is incomplete: "
+                + "; ".join(sorted(problems))
+            )
+
+    if place:
+        if err is None and not streaming:
+            # sequential schedule: every transfer happens after every read
+            for flat in flat_shapes:
+                ready.put(flat)
+        ready.put(None)
+        xfer_thread.join()
+        if err is None and xfer_err:
+            err = xfer_err[0]
+    # read-side stats are valid even on the error paths (an aborted
+    # prefetch reports how many bytes it actually spent)
+    st.read_s = read_t1 - t_begin
+    st.convert_s = convert_s[0]
+    st.bytes_read = bytes_read[0]
+    if err is not None:
+        raise err
+
+    if not place:
+        st.total_s = time.monotonic() - t_begin
+        return _unflatten(dict(buffers))
+
+    st.h2d_s = (
+        (h2d_win[1] - h2d_win[0]) if h2d_win[0] is not None else 0.0
     )
-    from .registry import maybe_quantize
+    st.buckets_h2d, st.bytes_h2d = h2d_counts
+    params = _quantize_and_repin(cfg, _unflatten(placed), mesh)
+    st.total_s = time.monotonic() - t_begin
+    # overlap: time the read pipeline and the h2d stream were BOTH in
+    # flight — what the streaming schedule saves over read-then-transfer
+    if h2d_win[0] is not None:
+        st.overlap_s = max(
+            0.0, min(read_t1, h2d_win[1]) - max(t_begin, h2d_win[0])
+        )
+    st.overlap_frac = st.overlap_s / st.total_s if st.total_s > 0 else 0.0
+    return params
 
-    return maybe_quantize(cfg, params)
+
+def place_staged_params(
+    staged: Dict[str, Any],
+    cfg: LlamaConfig,
+    *,
+    mesh: Any = None,
+    max_inflight_bytes: Optional[int] = None,
+    stats: Optional[LoadStats] = None,
+) -> Dict[str, Any]:
+    """The H2D half of the streaming loader, standalone: device-place a
+    host tree produced by ``load_params(..., place=False)`` (the prefetch
+    path), bucketed and double-buffered with the same in-flight bound.
+    The host arrays are left intact (the caller owns them)."""
+    import jax
+
+    from ..engine.sleep import partition_buckets
+
+    t_begin = time.monotonic()
+    st = stats if stats is not None else LoadStats()
+    plain = (
+        dataclasses.replace(cfg, quantization="")
+        if getattr(cfg, "quantization", "")
+        else cfg
+    )
+    flat = {"/".join(p): a for p, a in _flatten(staged)}
+    targets = _flat_targets(plain, staged, mesh)
+    keys = list(flat)
+    nbs = [flat[k].nbytes for k in keys]
+    bucket_bytes = max(
+        1, int(max_inflight_bytes or DEFAULT_LOAD_INFLIGHT_BYTES) // 2
+    )
+    placed: Dict[str, Any] = {}
+    pending = None
+
+    def finish(p) -> None:
+        bkeys, puts, nb = p
+        puts = jax.block_until_ready(puts)
+        for k, a in zip(bkeys, puts):
+            placed[k] = a
+        st.buckets_h2d += 1
+        st.bytes_h2d += nb
+
+    for bucket in partition_buckets(nbs, bucket_bytes):
+        bkeys = [keys[i] for i in bucket]
+        puts = jax.device_put(
+            [flat[k] for k in bkeys], [targets[k] for k in bkeys]
+        )
+        cur = (bkeys, puts, sum(nbs[i] for i in bucket))
+        if pending is not None:
+            finish(pending)
+        pending = cur
+    if pending is not None:
+        finish(pending)
+
+    params = _quantize_and_repin(cfg, _unflatten(placed), mesh)
+    st.h2d_s = st.total_s = time.monotonic() - t_begin
+    return params
 
 
-def load_model(path: str, **overrides: Any) -> Tuple[LlamaConfig, Dict[str, Any]]:
+def estimate_param_bytes(cfg: LlamaConfig) -> int:
+    """Host bytes a staged (cfg.dtype, unquantized) copy of the model
+    occupies — the prefetch budget pre-check. Shapes only; nothing read."""
+    import jax
+
+    from .registry import init_params_for
+
+    plain = (
+        dataclasses.replace(cfg, quantization="")
+        if getattr(cfg, "quantization", "")
+        else cfg
+    )
+    shapes = jax.eval_shape(
+        lambda: init_params_for(jax.random.key(0), plain)
+    )
+    itemsize = np.dtype(cfg.dtype).itemsize
+    return sum(
+        int(np.prod(node.shape)) * itemsize
+        for _, node in _flatten(shapes)
+    )
+
+
+def load_model(
+    path: str, **overrides: Any
+) -> Tuple[LlamaConfig, Dict[str, Any]]:
     cfg = config_from_hf(path, **overrides)
     return cfg, load_params(path, cfg)
 

@@ -100,6 +100,48 @@ def build_tiny_bpe_tokenizer_files(dirpath: str, chat_template: str = ""):
     return dirpath
 
 
+def build_sharded_hf_model_dir(
+    dirpath: str,
+    max_shard_size: str = "200KB",
+    torch_dtype=None,
+    **cfg_kw,
+):
+    """A tiny real HF model directory saved as a MULTI-SHARD safetensors
+    checkpoint (model.safetensors.index.json + N shard files) — the
+    parallel cold-start loader's unit of work. ``torch_dtype=
+    torch.bfloat16`` saves bf16 shards (exercising the loader's
+    no-fp32-transient path). Asserts the checkpoint really sharded, so a
+    transformers default change can't silently turn these tests into
+    single-shard no-ops."""
+    import os
+
+    import torch
+    import transformers
+
+    cfg = transformers.LlamaConfig(
+        **{
+            **dict(
+                vocab_size=512,
+                hidden_size=64,
+                intermediate_size=128,
+                num_hidden_layers=4,
+                num_attention_heads=4,
+                num_key_value_heads=2,
+                max_position_embeddings=128,
+            ),
+            **cfg_kw,
+        }
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg)
+    if torch_dtype is not None:
+        m = m.to(torch_dtype)
+    m.save_pretrained(dirpath, max_shard_size=max_shard_size)
+    shards = [f for f in os.listdir(dirpath) if f.endswith(".safetensors")]
+    assert len(shards) > 1, f"expected a sharded checkpoint, got {shards}"
+    return dirpath
+
+
 def build_tiny_hf_model_dir(dirpath: str, chat_template: str = "", **cfg_kw):
     """A tiny real HF model directory (config.json + safetensors +
     tokenizer) like the ones vLLM users bring. `cfg_kw` overrides the

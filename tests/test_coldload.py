@@ -1,0 +1,200 @@
+"""Parallel streaming cold-start loader (models/hf.py load_params).
+
+The loader equivalence contract: any (workers, streaming) schedule must
+produce a param tree BIT-identical to the sequential reference, per-slice
+completeness errors must still name the exact missing slices, a
+declared-but-absent shard must fail before any staging work, and a bf16
+source tensor must never pass through an fp32 transient (the old loader's
+per-tensor `.float()` copy).
+"""
+
+import json
+import os
+import threading
+
+import ml_dtypes
+import numpy as np
+import pytest
+import torch
+
+from conftest import build_sharded_hf_model_dir
+
+from llm_d_fast_model_actuation_tpu.models import hf
+
+
+def _assert_trees_bit_identical(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.shape == y.shape and x.dtype == y.dtype
+        assert np.asarray(x).tobytes() == np.asarray(y).tobytes()
+
+
+def test_parallel_streaming_matches_sequential(tmp_path):
+    """The tentpole contract: parallel readers + streaming placement on a
+    multi-shard bf16 checkpoint == the sequential loader, bit for bit."""
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    cfg = hf.config_from_hf(d)
+    seq = hf.load_params(d, cfg, workers=1, streaming=False)
+    stats = hf.LoadStats()
+    par = hf.load_params(d, cfg, workers=4, stats=stats)
+    _assert_trees_bit_identical(seq, par)
+    assert stats.shards > 1
+    assert stats.streaming and stats.bytes_h2d == stats.bytes_read > 0
+    # non-streaming parallel and streaming single-worker too (the two
+    # schedule knobs are independent)
+    _assert_trees_bit_identical(
+        seq, hf.load_params(d, cfg, workers=4, streaming=False)
+    )
+    _assert_trees_bit_identical(
+        seq, hf.load_params(d, cfg, workers=1, streaming=True)
+    )
+
+
+def test_no_fp32_transient_for_bf16_source(tmp_path, monkeypatch):
+    """Every staged tensor passes through hf._native_numpy; for a bf16
+    checkpoint none of them may materialize as fp32 (guards the transient
+    the streaming loader removed from regressing back in)."""
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    cfg = hf.config_from_hf(d)  # cfg.dtype = bf16 default
+    assert np.dtype(cfg.dtype) == np.dtype(ml_dtypes.bfloat16)
+    seen = []
+    real = hf._native_numpy
+
+    def spy(t):
+        out = real(t)
+        seen.append((t.dtype, out.dtype))
+        return out
+
+    monkeypatch.setattr(hf, "_native_numpy", spy)
+    hf.load_params(d, cfg, workers=2)
+    assert seen
+    for torch_dtype, np_dtype in seen:
+        assert np_dtype != np.dtype(np.float32), (
+            f"{torch_dtype} source materialized as fp32"
+        )
+        assert torch_dtype == torch.bfloat16
+        assert np_dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+def test_missing_layer_slice_error_names_exact_slices(tmp_path):
+    """Deleting one layer's tensor from a shard must fail per-slice with
+    the exact missing (layer,) tuples — identical to the sequential
+    loader's error, from any schedule."""
+    import safetensors.torch as st
+
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    victim = "model.layers.1.mlp.gate_proj.weight"
+    with open(os.path.join(d, "model.safetensors.index.json")) as f:
+        shard = json.load(f)["weight_map"][victim]
+    sd = st.load_file(os.path.join(d, shard))
+    del sd[victim]
+    st.save_file(sd, os.path.join(d, shard))
+    cfg = hf.config_from_hf(d)
+    for kwargs in (
+        dict(workers=1, streaming=False),
+        dict(workers=4, streaming=True),
+    ):
+        with pytest.raises(ValueError, match="slices never staged") as ei:
+            hf.load_params(d, cfg, **kwargs)
+        msg = str(ei.value)
+        assert "layers/w_gate: 1/4 slices never staged" in msg
+        assert "(1,)" in msg
+
+
+def test_absent_declared_shard_fails_before_staging(tmp_path, monkeypatch):
+    """When the index declares shard files, a missing one must fail the
+    load before ANY tensor is read or staged."""
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    shards = sorted(
+        f for f in os.listdir(d) if f.endswith(".safetensors")
+    )
+    os.remove(os.path.join(d, shards[-1]))
+    reads = []
+    monkeypatch.setattr(
+        hf, "_native_numpy", lambda t: reads.append(1)
+    )
+    cfg = hf.config_from_hf(d)
+    with pytest.raises(FileNotFoundError, match="not present"):
+        hf.load_params(d, cfg)
+    assert not reads, "staging work ran before the shard-set check"
+
+
+def test_abort_event_stops_load(tmp_path):
+    """A pre-set abort event unwinds the load as LoadAborted (the prefetch
+    cancellation path); one set mid-read stops the remaining work."""
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    cfg = hf.config_from_hf(d)
+    ev = threading.Event()
+    ev.set()
+    with pytest.raises(hf.LoadAborted):
+        hf.load_params(d, cfg, abort_event=ev)
+
+
+def test_host_staging_and_deferred_place_match_direct_load(tmp_path):
+    """place=False (the prefetch staging mode) returns plain numpy — no
+    device arrays, so no HBM touch — and place_staged_params completes it
+    to the exact same tree a direct load produces."""
+    d = build_sharded_hf_model_dir(
+        str(tmp_path / "m"), torch_dtype=torch.bfloat16
+    )
+    cfg = hf.config_from_hf(d)
+    import jax
+
+    staged = hf.load_params(d, cfg, place=False)
+    assert all(
+        isinstance(x, np.ndarray) for x in jax.tree.leaves(staged)
+    )
+    placed = hf.place_staged_params(staged, cfg)
+    _assert_trees_bit_identical(
+        hf.load_params(d, cfg, workers=1, streaming=False), placed
+    )
+    assert hf.estimate_param_bytes(cfg) == sum(
+        x.nbytes for x in jax.tree.leaves(staged)
+    )
+
+
+def test_legacy_bin_checkpoint_loads_and_drops_refs(tmp_path):
+    """The pytorch_model*.bin path still loads (now yielding native-dtype
+    arrays and dropping each state-dict reference as it is consumed)."""
+    import transformers
+
+    cfg_t = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg_t)
+    d = str(tmp_path / "m")
+    m.save_pretrained(d, safe_serialization=False)
+    assert any(
+        f.startswith("pytorch_model") and f.endswith(".bin")
+        for f in os.listdir(d)
+    )
+    cfg = hf.config_from_hf(d)
+    params = hf.load_params(d, cfg)
+    sd = m.state_dict()
+    got = np.asarray(
+        params["layers"]["w_up"][0], dtype=np.float32
+    )
+    want = (
+        sd["model.layers.0.mlp.up_proj.weight"].float().numpy().T
+    ).astype(np.dtype(cfg.dtype)).astype(np.float32)
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,420 @@
+"""Background checkpoint prefetch: POST /v1/prefetch on the engine service
+(host-resident staging into the model pool, budget-checked, abortable) and
+the launcher's prefetch verb (engine passthrough + ChipLedger hint).
+
+The headline contract: a FIRST-EVER swap to a prefetched model takes the
+warm path — recorded with source="pool", zero checkpoint re-read on the
+swap edge — while the previous model kept serving through the staging.
+"""
+
+import asyncio
+import http.server
+import json
+import threading
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from conftest import build_sharded_hf_model_dir, free_port
+
+from llm_d_fast_model_actuation_tpu.engine.server import (
+    ENGINE_SWAPS,
+    EngineService,
+    build_app,
+    parse_engine_options,
+)
+
+
+@pytest.fixture
+def service():
+    args = parse_engine_options(
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --model-pool-mib 256 --swap-bucket-mib 1"
+    )
+    svc = EngineService(args)
+    yield svc
+    svc.shutdown()
+
+
+def run_async(coro):
+    return asyncio.run(coro)
+
+
+async def _client(service, fn):
+    app = build_app(service)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+async def _wait_prefetch(client, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        r = await client.get("/v1/prefetch")
+        body = await r.json()
+        if body.get("state") != "running":
+            return body
+        await asyncio.sleep(0.05)
+    raise AssertionError("prefetch did not finish in time")
+
+
+def _counter(metric, **labels):
+    return metric.labels(**labels)._value.get()
+
+
+def test_prefetch_then_first_swap_is_pool_source(service, tmp_path):
+    """Prefetch stages host-resident weights while `tiny` serves; the
+    subsequent first-ever swap is a pool hit (source="pool") whose metrics
+    carry the real H2D bytes, and the model serves."""
+    d = build_sharded_hf_model_dir(str(tmp_path / "m"))
+    model = f"hf:{d}"
+
+    async def scenario(client):
+        # serving continues before/during/after prefetch
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 2}
+        )
+        assert r.status == 200
+        builds_before = service.builds_total
+
+        r = await client.post("/v1/prefetch", json={"model": model})
+        assert r.status == 200
+        body = await r.json()
+        assert body["state"] in ("running", "completed")
+        done = await _wait_prefetch(client)
+        assert done["state"] == "completed"
+        assert done["bytes"] > 0
+        assert model in done["pool"]["models"]
+        # staging never cold-built an engine runtime
+        assert service.builds_total == builds_before
+
+        pool_swaps_before = _counter(
+            ENGINE_SWAPS, model=model, source="pool"
+        )
+        r = await client.post("/v1/swap", json={"model": model})
+        assert r.status == 200
+        body = await r.json()
+        assert body["swapped"] and body["pool_hit"] and body["prefetched"]
+        # the swap re-read no checkpoint: the build consumed staged host
+        # weights, and its H2D transfer is reported (not zeros)
+        assert body["bytes_in"] > 0 and body["h2d_s"] > 0
+        assert (
+            _counter(ENGINE_SWAPS, model=model, source="pool")
+            == pool_swaps_before + 1
+        )
+
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 2}
+        )
+        assert r.status == 200
+        r = await client.get("/v1/models")
+        assert (await r.json())["data"][0]["id"] == model
+
+    run_async(_client(service, scenario))
+
+
+def test_prefetch_already_pooled_and_serving_model(service, tmp_path):
+    d = build_sharded_hf_model_dir(str(tmp_path / "m"))
+    model = f"hf:{d}"
+
+    async def scenario(client):
+        r = await client.post("/v1/prefetch", json={"model": model})
+        assert r.status == 200
+        await _wait_prefetch(client)
+        # second prefetch of a pooled model is a no-op, not a re-stage
+        r = await client.post("/v1/prefetch", json={"model": model})
+        assert r.status == 200
+        assert (await r.json())["state"] == "already_pooled"
+        # prefetching the currently-serving model is a client error
+        r = await client.post("/v1/prefetch", json={"model": "tiny"})
+        assert r.status == 400  # named configs are rejected outright
+        r = await client.post("/v1/swap", json={"model": model})
+        assert r.status == 200
+        r = await client.post("/v1/prefetch", json={"model": model})
+        assert r.status == 400
+        assert "already the serving model" in await r.text()
+
+    run_async(_client(service, scenario))
+
+
+def test_prefetch_budget_rejection(tmp_path):
+    """--model-pool-mib 0 disables pooling: prefetch must refuse up front
+    (outcome=rejected) instead of staging bytes it can never keep."""
+    args = parse_engine_options(
+        "--model tiny --num-pages 16 --page-size 8 --max-batch 2 "
+        "--max-model-len 32 --model-pool-mib 0"
+    )
+    svc = EngineService(args)
+    try:
+        d = build_sharded_hf_model_dir(str(tmp_path / "m"))
+
+        async def scenario(client):
+            r = await client.post(
+                "/v1/prefetch", json={"model": f"hf:{d}"}
+            )
+            assert r.status == 400
+            assert "budget" in await r.text()
+
+        run_async(_client(svc, scenario))
+    finally:
+        svc.shutdown()
+
+
+def test_prefetch_validation_errors(service):
+    async def scenario(client):
+        r = await client.post("/v1/prefetch", json={})
+        assert r.status == 400
+        r = await client.post("/v1/prefetch", json={"model": "hf:"})
+        assert r.status == 400
+        r = await client.post(
+            "/v1/prefetch", json={"model": "no-such-model"}
+        )
+        assert r.status == 400
+        r = await client.post(
+            "/v1/prefetch", json={"model": "hf:/nonexistent-dir"}
+        )
+        assert r.status == 400
+        # an Orbax checkpoint_dir cannot be staged from the hf: dir; a
+        # qualified pool entry of base weights would serve wrong weights
+        r = await client.post(
+            "/v1/prefetch",
+            json={"model": "hf:/x", "checkpoint_dir": "/ckpt"},
+        )
+        assert r.status == 400
+        assert "checkpoint_dir" in await r.text()
+        # nothing started
+        r = await client.get("/v1/prefetch")
+        assert (await r.json())["state"] == "idle"
+        r = await client.delete("/v1/prefetch")
+        assert (await r.json())["aborted"] is False
+
+    run_async(_client(service, scenario))
+
+
+def test_prefetch_abort_over_http(service, tmp_path, monkeypatch):
+    """DELETE /v1/prefetch cancels an in-flight staging: the worker
+    observes the abort event and unwinds without pooling anything."""
+    d = build_sharded_hf_model_dir(str(tmp_path / "m"))
+    from llm_d_fast_model_actuation_tpu.models import hf as hf_models
+
+    real = hf_models.load_params
+
+    def slow(path, cfg, **kw):
+        ev = kw.get("abort_event")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ev is not None and ev.is_set():
+                raise hf_models.LoadAborted("aborted by test")
+            time.sleep(0.02)
+        return real(path, cfg, **kw)
+
+    monkeypatch.setattr(hf_models, "load_params", slow)
+
+    async def scenario(client):
+        r = await client.post("/v1/prefetch", json={"model": f"hf:{d}"})
+        assert r.status == 200
+        r = await client.delete("/v1/prefetch")
+        body = await r.json()
+        assert body["aborted"] is True
+        r = await client.get("/v1/prefetch")
+        assert (await r.json())["state"] == "aborted"
+        assert len(service.model_pool) == 0
+        # a fresh prefetch can start after the abort
+        monkeypatch.setattr(hf_models, "load_params", real)
+        r = await client.post("/v1/prefetch", json={"model": f"hf:{d}"})
+        assert r.status == 200
+        done = await _wait_prefetch(client)
+        assert done["state"] == "completed"
+
+    run_async(_client(service, scenario))
+
+
+# -- launcher verb ------------------------------------------------------------
+
+
+class _StubEngineHandler(http.server.BaseHTTPRequestHandler):
+    """Stands in for the engine child's /v1/prefetch endpoints."""
+
+    calls = []
+
+    def _reply(self, obj, status=200):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        type(self).calls.append(("POST", self.path, body))
+        if self.path == "/v1/prefetch":
+            if body.get("model") == "hf:/bad":
+                self._reply({"error": "nope"}, status=400)
+            else:
+                self._reply(
+                    {"state": "running", "model": body.get("model")}
+                )
+        else:
+            self._reply({}, status=404)
+
+    def do_DELETE(self):
+        type(self).calls.append(("DELETE", self.path, None))
+        self._reply({"aborted": True, "state": "aborted"})
+
+    def do_GET(self):
+        type(self).calls.append(("GET", self.path, None))
+        self._reply({"state": "completed", "bytes": 123})
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+@pytest.fixture
+def stub_engine():
+    port = free_port()
+    srv = http.server.ThreadingHTTPServer(
+        ("127.0.0.1", port), _StubEngineHandler
+    )
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    _StubEngineHandler.calls = []
+    yield port
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_launcher_prefetch_verb_and_ledger_hint(tmp_path, stub_engine):
+    """manager.prefetch_instance forwards to the engine child and records
+    the predicted-next-model hint in the ChipLedger; abort clears it; a
+    swap to the hinted model consumes it."""
+    from llm_d_fast_model_actuation_tpu.launcher.chiptranslator import (
+        ChipTranslator,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.instance import (
+        InstanceConfig,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        PrefetchFailed,
+    )
+
+    def fake_kickoff(config, log_path):
+        time.sleep(300)
+
+    translator = ChipTranslator.create(mock_chips=True, mock_chip_count=2)
+    manager = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=fake_kickoff,
+        enforce_chip_exclusivity=False,
+    )
+    try:
+        chip = translator.chip_ids()[0]
+        manager.create_instance(
+            InstanceConfig(
+                options=f"--model tiny --port {stub_engine}",
+                chip_ids=[chip],
+            ),
+            instance_id="i1",
+        )
+        out = manager.prefetch_instance("i1", "hf:/models/next")
+        assert out["prefetch"]["state"] == "running"
+        assert manager.ledger.prefetched() == {"i1": "hf:/models/next"}
+        assert (
+            "POST",
+            "/v1/prefetch",
+            {"model": "hf:/models/next", "checkpoint_dir": ""},
+        ) in _StubEngineHandler.calls
+
+        st = manager.get_instance_prefetch("i1")
+        assert st["prefetch"]["state"] == "completed"
+
+        manager.abort_instance_prefetch("i1")
+        assert manager.ledger.prefetched() == {}
+
+        # hint consumed by a swap to the hinted model
+        manager.ledger.set_prefetched("i1", "hf:/models/next")
+        manager.ledger.set_model("i1", "hf:/models/next")
+        assert manager.ledger.prefetched() == {}
+
+        # engine-side rejection surfaces as PrefetchFailed with the status
+        with pytest.raises(PrefetchFailed) as ei:
+            manager.prefetch_instance("i1", "hf:/bad")
+        assert ei.value.status == 400
+
+        with pytest.raises(KeyError):
+            manager.prefetch_instance("nope", "hf:/x")
+    finally:
+        manager.stop_all_instances(timeout=2)
+
+
+def test_launcher_rest_prefetch_route(tmp_path, stub_engine):
+    """The REST verb end to end against the manager: 200 passthrough, 404
+    unknown instance, 422 bad body, 400 on engine rejection."""
+    from llm_d_fast_model_actuation_tpu.launcher.chiptranslator import (
+        ChipTranslator,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.rest import build_app
+
+    def fake_kickoff(config, log_path):
+        time.sleep(300)
+
+    translator = ChipTranslator.create(mock_chips=True, mock_chip_count=2)
+    manager = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=fake_kickoff,
+        enforce_chip_exclusivity=False,
+    )
+
+    async def scenario():
+        app = build_app(manager)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.put(
+                "/v2/vllm/instances/i1",
+                json={"options": f"--model tiny --port {stub_engine}"},
+            )
+            assert r.status == 201
+            r = await client.post(
+                "/v2/vllm/instances/i1/prefetch",
+                json={"model": "hf:/models/next"},
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["prefetch"]["state"] == "running"
+            r = await client.get("/v2/vllm/instances/i1/prefetch")
+            assert r.status == 200
+            r = await client.delete("/v2/vllm/instances/i1/prefetch")
+            assert r.status == 200
+            r = await client.post(
+                "/v2/vllm/instances/i1/prefetch", json={}
+            )
+            assert r.status == 422
+            r = await client.post(
+                "/v2/vllm/instances/nope/prefetch",
+                json={"model": "hf:/x"},
+            )
+            assert r.status == 404
+            r = await client.post(
+                "/v2/vllm/instances/i1/prefetch",
+                json={"model": "hf:/bad"},
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    try:
+        run_async(scenario())
+    finally:
+        manager.stop_all_instances(timeout=2)
