@@ -541,6 +541,103 @@ def _measure_coldload() -> None:
     print(json.dumps(result))
 
 
+def _measure_swap_recovery() -> None:
+    """Child entry for the `swap` sub-bench: the failure-recovery probe.
+
+    Arms a fail-once fault on the hot-swap's incoming transfer
+    (``swap.h2d``, utils/faults.py), drives a pool-hit swap into it, and
+    measures the transactional rollback: how long the failed-swap call
+    took (rollback included), how fast the outgoing model served its next
+    token, and that /health stayed OK while
+    ``fma_engine_recoveries_total{path="swap",outcome="rolled_back"}``
+    incremented. Compared against the recovery path the rollback replaces:
+    a full engine-service restart (tear down + cold rebuild + first
+    token)."""
+    import jax
+
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        ENGINE_RECOVERIES,
+        EngineService,
+        parse_engine_options,
+    )
+    from llm_d_fast_model_actuation_tpu.engine.sleep import SwapRolledBack
+    from llm_d_fast_model_actuation_tpu.utils import faults
+
+    opts = (
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --swap-bucket-mib 1"
+    )
+    svc = EngineService(parse_engine_options(opts))
+
+    def first_token_s(service) -> float:
+        t0 = time.monotonic()
+        service.submit([1, 2, 3], 1, 0.0).result(timeout=120)
+        return time.monotonic() - t0
+
+    rolled_back = False
+    health_ok = False
+    try:
+        first_token_s(svc)  # compile the serving path
+        svc.swap("tiny-gemma")  # cold build -> `tiny` parked in the pool
+        first_token_s(svc)
+        faults.arm("swap.h2d", mode="fail", count=1)
+        t0 = time.monotonic()
+        try:
+            svc.swap("tiny")  # pool hit -> injected mid-transfer failure
+        except SwapRolledBack:
+            rolled_back = True
+        rollback_s = time.monotonic() - t0
+        recover_ttft_s = first_token_s(svc)  # tiny-gemma serves again
+        health_ok = svc.failure is None
+        degraded = svc.degraded
+        recoveries = ENGINE_RECOVERIES.labels(
+            path="swap", outcome="rolled_back"
+        )._value.get()
+        # the retried swap takes the warm pool path (the entry re-pooled)
+        retry = svc.swap("tiny")
+        retry_pool_hit = bool(retry.get("pool_hit"))
+    finally:
+        svc.shutdown()
+
+    # Baseline: what recovery costs WITHOUT the rollback — the controller's
+    # crash-and-reheal path, approximated by a fresh service build + first
+    # token on the same options (process fork/scheduling overhead excluded,
+    # so this under-states the real restart and the ratio is conservative).
+    t0 = time.monotonic()
+    svc2 = EngineService(parse_engine_options(opts))
+    try:
+        first_token_s(svc2)
+        restart_baseline_s = time.monotonic() - t0
+    finally:
+        svc2.shutdown()
+
+    result = {
+        "metric": "swap_rollback_recovery",
+        "value": round(rollback_s + recover_ttft_s, 4),
+        "unit": "s",
+        # recovery-via-rollback vs recovery-via-restart (< 1 = rollback
+        # is the faster heal; the headline of this probe)
+        "vs_baseline": round(
+            (rollback_s + recover_ttft_s) / restart_baseline_s
+            if restart_baseline_s > 0
+            else 0.0,
+            4,
+        ),
+        "extra": {
+            "platform": jax.devices()[0].platform,
+            "rolled_back": rolled_back,
+            "health_ok": health_ok,
+            "degraded_after_rollback": bool(degraded),
+            "recoveries_total": recoveries,
+            "retry_pool_hit": retry_pool_hit,
+            "rollback_s": round(rollback_s, 4),
+            "recover_ttft_s": round(recover_ttft_s, 4),
+            "restart_baseline_s": round(restart_baseline_s, 4),
+        },
+    }
+    print(json.dumps(result))
+
+
 def _run_child(
     env: dict, sub: str = ""
 ) -> "subprocess.CompletedProcess[str]":
@@ -572,11 +669,16 @@ def _extract_json_line(stdout: str) -> str | None:
 
 def main() -> int:
     # `bench.py` = the actuation headline; `bench.py coldload` = the
-    # cold-start loader sub-bench (same TPU-then-CPU fallback runner).
-    sub = "coldload" if "coldload" in sys.argv[1:] else ""
+    # cold-start loader sub-bench; `bench.py swap` = the failure-recovery
+    # probe (rollback vs full restart) — same TPU-then-CPU fallback runner.
+    sub = next(
+        (s for s in ("coldload", "swap") if s in sys.argv[1:]), ""
+    )
     if "--child" in sys.argv:
         if sub == "coldload":
             _measure_coldload()
+        elif sub == "swap":
+            _measure_swap_recovery()
         else:
             _measure()
         return 0
@@ -632,12 +734,14 @@ def main() -> int:
     # BENCH_r{N}.json records a structured failure instead of parsed=null.
     label, proc = last if last is not None else ("none", None)
     print(json.dumps({
-        "metric": (
-            "coldload_parallel_speedup" if sub == "coldload"
-            else "level1_wake_bandwidth"
-        ),
+        "metric": {
+            "coldload": "coldload_parallel_speedup",
+            "swap": "swap_rollback_recovery",
+        }.get(sub, "level1_wake_bandwidth"),
         "value": 0.0,
-        "unit": "x_vs_sequential" if sub == "coldload" else "GiB/s",
+        "unit": {"coldload": "x_vs_sequential", "swap": "s"}.get(
+            sub, "GiB/s"
+        ),
         "vs_baseline": 0.0,
         "extra": {
             "platform": "unavailable",

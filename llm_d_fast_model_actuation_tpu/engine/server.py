@@ -45,9 +45,15 @@ from prometheus_client import Counter, Gauge, Histogram
 
 from ..models import llama
 from ..models.moe import MoeConfig
+from ..utils import faults
 from .engine import EngineConfig, InferenceEngine
 from .model_pool import HostModelPool
-from .sleep import attach_sleep, swap_states
+from .sleep import (
+    SwapRolledBack,
+    SwapRollbackFailed,
+    attach_sleep,
+    swap_states,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -145,6 +151,17 @@ ENGINE_POOL_HITS = Counter(
 ENGINE_POOL_EVICTIONS = Counter(
     "fma_engine_model_pool_evictions",
     "Pooled models evicted (budget pressure or device release)",
+)
+
+# Self-healing observability (docs/operations.md "Self-healing and fault
+# drills"): every recovery edge — a swap failure rolled back in-process, or
+# a rollback that itself failed and flipped /health — is counted, so an
+# operator can tell "the failure path fired and healed" apart from silence.
+ENGINE_RECOVERIES = Counter(
+    "fma_engine_recoveries_total",
+    "Recovery attempts by path and outcome",
+    ["path", "outcome"],  # path: swap | swap_cold; outcome: rolled_back |
+    #                       rollback_failed
 )
 
 # Cold-start observability (docs/perf.md "Cold-start tuning"): the pipelined
@@ -348,6 +365,14 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "starves serving traffic; 0 = unthrottled",
     )
     p.add_argument(
+        "--faults",
+        default="",
+        help="arm fault-injection points at startup (utils/faults.py), "
+        'e.g. "swap.h2d=fail:1,coldload.read=delay:0.25" — the '
+        "deterministic failure-drill knob; also armable via FMA_FAULTS "
+        "env and POST /v1/faults",
+    )
+    p.add_argument(
         "--tokenizer",
         default="",
         help="HF tokenizer directory (text prompts, chat templates, stop "
@@ -426,6 +451,11 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--load-inflight-mib must be >= 1")
     if getattr(args, "prefetch_mib_s", 0) < 0:
         raise ValueError("--prefetch-mib-s must be >= 0 (0 = unthrottled)")
+    if getattr(args, "faults", ""):
+        try:
+            faults.parse_spec(args.faults)
+        except ValueError as e:
+            raise ValueError(f"--faults: {e}")
     if args.port <= 0 or args.port > 65535:
         raise ValueError(f"invalid port {args.port}")
 
@@ -494,7 +524,17 @@ class EngineService:
         self._pending: List[Any] = []
         self._abort_q: List[Any] = []  # futures whose client went away
         self.failure: Optional[str] = None
+        #: a recoverable failure happened and was healed in-process (e.g.
+        #: a rolled-back swap): /health stays 200 but reports DEGRADED
+        #: with this reason until the next successful admin edge clears it
+        self.degraded: Optional[str] = None
         self.started_at = time.monotonic()
+        # Fault-injection arming (utils/faults.py): env first, then the
+        # flag — both before the first build so coldload points can fire
+        # on the initial model too.
+        faults.load_env()
+        if getattr(args, "faults", ""):
+            faults.arm_spec(args.faults)
 
         dist = resolve_distributed(args)
         if dist is not None and args.tensor_parallel_size <= 1:
@@ -839,14 +879,27 @@ class EngineService:
     def _current_runtime(self) -> _ModelRuntime:
         return self._runtime
 
-    def swap(self, model: str, checkpoint_dir: str = "") -> Dict[str, Any]:
+    def swap(
+        self, model: str, checkpoint_dir: str = "", request_id: str = ""
+    ) -> Dict[str, Any]:
         """Hot-swap the model this chip serves (POST /v1/swap): stream the
         current model's state to the host pool while the target's
         host-resident state streams back in, chunked and double-buffered
         (engine/sleep.py swap_states) so the two DMA directions overlap.
         Pool miss = cold build (checkpoint / HF / random init) after a
         chunked offload. No process restart, no chip release: the
-        launcher's ChipLedger holder is unchanged."""
+        launcher's ChipLedger holder is unchanged.
+
+        **Transactional**: a mid-transfer failure rolls back (the outgoing
+        model serves again, the incoming pool entry is re-pooled) and
+        raises SwapRolledBack — surfaced as a retryable 503 with /health
+        still 200 (DEGRADED); only a failed rollback fails the service.
+
+        ``request_id`` (optional, caller-chosen) makes the verb safely
+        retryable across a lost response: a repeat request whose id matches
+        the last committed swap replays ``last_swap`` instead of swapping
+        again (the launcher's timeout-recovery path reads GET /v1/swap the
+        same way)."""
         if self.is_follower or self.engine.lockstep is not None:
             raise ValueError(
                 "model hot-swap is not supported for multi-host gangs"
@@ -860,6 +913,14 @@ class EngineService:
                 "or hf:<model-dir>"
             )
         with self._admin_lock():
+            if (
+                request_id
+                and self.last_swap.get("request_id") == request_id
+            ):
+                # idempotent replay: this exact swap already committed and
+                # the caller lost the answer (timeout / connection drop) —
+                # re-executing would swap AWAY from what it asked for
+                return dict(self.last_swap, replayed=True)
             previous = self.args.model
             if model == previous and (
                 not checkpoint_dir or checkpoint_dir == self.checkpoint_dir
@@ -927,12 +988,35 @@ class EngineService:
                     # a checkpoint-qualified entry)
                     self.model_pool.put(entry.model_id, rt, entry.nbytes)
                     raise
+                except SwapRolledBack as e:
+                    # mid-transfer failure, rolled back by swap_states:
+                    # the outgoing model is awake and serving again and
+                    # the incoming entry's host state is untouched —
+                    # re-pool it, mark DEGRADED (visible, but /health
+                    # stays 200), and surface a retryable 503
+                    self.model_pool.put(entry.model_id, rt, entry.nbytes)
+                    self.degraded = (
+                        f"hot-swap {previous}->{model} rolled back: {e}"
+                    )
+                    ENGINE_RECOVERIES.labels(
+                        path="swap", outcome="rolled_back"
+                    ).inc()
+                    self._new_work.set()
+                    logger.warning(
+                        "hot-swap %s -> %s rolled back (%s); still "
+                        "serving %s", previous, model, e, previous,
+                    )
+                    raise
                 except Exception as e:
-                    # mid-transfer failure (e.g. HBM OOM streaming in a
-                    # larger model): both models' state is partially moved
-                    # and unrecoverable in-process — fail the service
-                    # loudly so /health flips and the controller heals us,
-                    # instead of serving from half-deleted arrays
+                    # rollback failed (SwapRollbackFailed) or an error
+                    # outside the transactional window: device state is
+                    # partially moved and unrecoverable in-process — fail
+                    # the service loudly so /health flips and the
+                    # controller heals us, instead of serving from
+                    # half-deleted arrays
+                    ENGINE_RECOVERIES.labels(
+                        path="swap", outcome="rollback_failed"
+                    ).inc()
                     self.failure = (
                         f"hot-swap {previous}->{model} failed "
                         f"mid-transfer: {type(e).__name__}: {e}"
@@ -955,15 +1039,40 @@ class EngineService:
                         )
                     else:
                         rt = self._build_runtime(model, checkpoint_dir)
-                except Exception:
+                except Exception as build_exc:
                     # a failed build must not leave the chip serving nothing
-                    self.sleeper.wake_up()
+                    try:
+                        self.sleeper.wake_up()
+                    except Exception as wake_exc:
+                        # the rollback itself failed: the outgoing model
+                        # cannot come back — fail the service with BOTH
+                        # causes (losing the build error here would send
+                        # the operator chasing the wake failure only)
+                        ENGINE_RECOVERIES.labels(
+                            path="swap_cold", outcome="rollback_failed"
+                        ).inc()
+                        self.failure = (
+                            f"hot-swap {previous}->{model} build failed "
+                            f"({type(build_exc).__name__}: {build_exc}) "
+                            f"and the rollback wake failed "
+                            f"({type(wake_exc).__name__}: {wake_exc})"
+                        )
+                        self._fail_all(RuntimeError(self.failure))
+                        raise RuntimeError(self.failure) from build_exc
                     if prefetched:
                         # the staged host weights are untouched by a
                         # failed build: re-pool them for the next attempt
                         self.model_pool.put(
                             entry.model_id, entry.runtime, entry.nbytes
                         )
+                    ENGINE_RECOVERIES.labels(
+                        path="swap_cold", outcome="rolled_back"
+                    ).inc()
+                    self.degraded = (
+                        f"hot-swap {previous}->{model} build failed; "
+                        f"rolled back to {previous}: "
+                        f"{type(build_exc).__name__}: {build_exc}"
+                    )
                     raise
                 # A pool-miss swap still transfers the whole incoming
                 # model to HBM inside the build — report the build's H2D
@@ -1006,9 +1115,13 @@ class EngineService:
             ENGINE_SWAP_INFLIGHT_BYTES.labels(model=model).set(
                 metrics.get("peak_bytes_in_flight", 0)
             )
+            # a committed swap is proof the failure domain healed: clear
+            # any DEGRADED marker from an earlier rolled-back attempt
+            self.degraded = None
             self.last_swap = {
                 "model": model,
                 "previous_model": previous,
+                "request_id": request_id,
                 # the installed runtime's checkpoint identity (pooled
                 # runtimes remember theirs): the launcher rewrites its
                 # stored options from THIS, not from the request, so a
@@ -1134,6 +1247,7 @@ class EngineService:
         t0 = time.monotonic()
         lstats = hf_models.LoadStats()
         try:
+            faults.fire("prefetch.stage")
             staged = hf_models.load_params(
                 hf_dir,
                 model_cfg,
@@ -1647,6 +1761,13 @@ def build_app(service: EngineService) -> web.Application:
             return web.json_response(
                 {"status": "FAILED", "error": service.failure}, status=503
             )
+        if service.degraded is not None:
+            # healed-in-process failures (rolled-back swap): still serving
+            # — 200, so no controller restarts us — but visibly degraded
+            # for operators and the launcher
+            return web.json_response(
+                {"status": "DEGRADED", "reason": service.degraded}
+            )
         return web.json_response({"status": "OK"})
 
     async def is_sleeping(request: web.Request) -> web.Response:
@@ -1687,13 +1808,34 @@ def build_app(service: EngineService) -> web.Application:
         ckpt = body.get("checkpoint_dir") or ""
         if not isinstance(ckpt, str):
             raise web.HTTPBadRequest(text="checkpoint_dir must be a string")
+        rid = body.get("request_id") or ""
+        if not isinstance(rid, str):
+            raise web.HTTPBadRequest(text="request_id must be a string")
         try:
             info = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: service.swap(model, ckpt)
+                None, lambda: service.swap(model, ckpt, request_id=rid)
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
+        except SwapRolledBack as e:
+            # transactional rollback: the previous model serves again and
+            # the target is still pooled — retryable, so 503 (not 500)
+            return web.json_response(
+                {
+                    "error": str(e),
+                    "rolled_back": True,
+                    "model": service.args.model,
+                },
+                status=503,
+            )
         return web.json_response(info)
+
+    async def last_swap(request: web.Request) -> web.Response:
+        # the launcher's timeout-recovery read: last committed swap (with
+        # its request_id) + the degraded marker
+        return web.json_response(
+            {**service.last_swap, "degraded": service.degraded}
+        )
 
     async def prefetch(request: web.Request) -> web.Response:
         try:
@@ -2342,7 +2484,34 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_get("/is_sleeping", is_sleeping)
     app.router.add_post("/sleep", sleep)
     app.router.add_post("/wake_up", wake_up)
+    async def faults_get(request: web.Request) -> web.Response:
+        return web.json_response(faults.describe())
+
+    async def faults_arm(request: web.Request) -> web.Response:
+        """Arm fault-injection points at runtime (the test / fault-drill
+        surface; utils/faults.py): {"spec": "swap.h2d=fail:1,..."}."""
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        spec = body.get("spec")
+        if not isinstance(spec, str) or not spec:
+            raise web.HTTPBadRequest(text="faults requires a 'spec' string")
+        try:
+            faults.arm_spec(spec)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(faults.describe())
+
+    async def faults_reset(request: web.Request) -> web.Response:
+        faults.reset()
+        return web.json_response(faults.describe())
+
     app.router.add_post("/v1/swap", swap)
+    app.router.add_get("/v1/swap", last_swap)
+    app.router.add_get("/v1/faults", faults_get)
+    app.router.add_post("/v1/faults", faults_arm)
+    app.router.add_delete("/v1/faults", faults_reset)
     app.router.add_post("/v1/prefetch", prefetch)
     app.router.add_get("/v1/prefetch", prefetch_status)
     app.router.add_delete("/v1/prefetch", prefetch_abort)

@@ -50,12 +50,26 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from ..utils import faults
 from .device import (
     rebuild_spec,
     reacquire_devices,
     release_devices,
     sharding_spec,
 )
+
+
+class SwapRolledBack(RuntimeError):
+    """A mid-transfer hot-swap failure was rolled back: the outgoing model
+    is fully back on device (awake, serving) and the incoming model's
+    host-resident state is intact (re-poolable). The swap did not happen,
+    but nothing was lost — retryable."""
+
+
+class SwapRollbackFailed(RuntimeError):
+    """A mid-transfer hot-swap failure could NOT be rolled back: device
+    state is partially moved and unrecoverable in-process. The service
+    must fail loudly (flip /health) so the controller heals the process."""
 
 #: Default transfer bucket for chunked/overlapped swaps: large enough to
 #: amortize per-transfer dispatch, small enough that peak extra HBM and the
@@ -442,6 +456,19 @@ def swap_states(
     ``in_mgr`` awake. Bit-exact: whole leaves move, nothing is recomputed.
     Returns a metrics dict (timings, overlap fraction, bytes, buckets).
 
+    **Transactional**: no destructive operation on the incoming model's
+    host state happens before the swap commits (its pinned-host copies are
+    freed at commit, not bucket-by-bucket — peak pinned-host during the
+    swap is therefore the full incoming model plus the growing outgoing
+    copy, the price of recoverability), and the outgoing model's host
+    copies always land before their device HBM is freed. A mid-transfer
+    failure (HBM OOM, injected ``swap.d2h``/``swap.h2d`` fault) is rolled
+    back: partially-restored incoming device buckets are dropped, the
+    outgoing model's already-freed device leaves are re-uploaded from
+    their host copies, and :class:`SwapRolledBack` is raised — both models
+    end exactly as they began. Only a failure *during that rollback*
+    raises :class:`SwapRollbackFailed` (state genuinely lost).
+
     ``overlapped=False`` runs the identical code path on a strictly
     sequential schedule (every outgoing bucket lands before the first
     incoming one is issued) — the measured apples-to-apples baseline the
@@ -488,8 +515,16 @@ def swap_states(
     peak_in_flight = 0
     d2h_t0 = d2h_t1 = h2d_t0 = h2d_t1 = None
 
+    #: outgoing leaf indices whose device HBM was freed (what a rollback
+    #: must re-upload from host_out)
+    deleted_out: set = set()
+    #: incoming leaf indices whose pinned-host copies are due at commit
+    #: (deferred so a rollback can re-pool the incoming entry intact)
+    deferred_in_frees: List[int] = []
+
     def _issue_d2h(k):
         nonlocal in_flight, peak_in_flight
+        faults.fire("swap.d2h")
         bucket = buckets_out[k]
         if use_mk:
             copies = jax.device_put(
@@ -525,6 +560,7 @@ def swap_states(
         if h2d_pool is None:
             for i in bucket:
                 leaves_out[i].delete()  # the HBM the next h2d bucket fills
+            deleted_out.update(bucket)
         else:
             deferred_deletes.extend(bucket)
         in_flight -= bsize_out[k]
@@ -558,6 +594,7 @@ def swap_states(
 
     def _issue_h2d(j):
         nonlocal in_flight, peak_in_flight, h2d_t0
+        faults.fire("swap.h2d")
         if h2d_t0 is None:
             h2d_t0 = time.monotonic()
         if h2d_pool is not None:
@@ -579,8 +616,10 @@ def swap_states(
         for i, d in zip(bucket, restored):
             dev_in[i] = d
         if use_mk:
-            for i in bucket:
-                leaves_in[i].delete()  # pinned host copy no longer needed
+            # NOT freed here: the incoming pool entry must survive intact
+            # until the swap commits, so a mid-transfer failure can put it
+            # back untouched
+            deferred_in_frees.extend(bucket)
         in_flight -= bsize_in[j]
 
     # Double-buffered main loop: while outgoing bucket k drains, incoming
@@ -588,27 +627,103 @@ def swap_states(
     # (Sequential mode: the same loop, minus the interleaved h2d issues.)
     pend_d2h = pend_h2d = None
     next_in = 0
+
+    def _rollback() -> None:
+        """Undo every side effect of a partial transfer: drop what the
+        incoming model landed on device, re-upload the outgoing leaves
+        whose HBM was already freed (their host copies land before the
+        free, by construction), and reinstall the outgoing state. The
+        incoming host tree was never touched (frees are deferred to
+        commit), so the pool entry goes back intact."""
+        # quiesce the in-flight incoming transfer first: its device_put
+        # must land (or fail) before any buffer it touches is reclaimed
+        if pend_h2d is not None:
+            _, restored = pend_h2d
+            try:
+                if h2d_pool is not None:
+                    restored = restored.result()
+                for a in jax.block_until_ready(restored):
+                    a.delete()
+            except Exception:  # noqa: BLE001 — the failed transfer itself
+                pass
+        if h2d_pool is not None:
+            h2d_pool.shutdown(wait=True)
+        # the in-flight outgoing copy: let it land and keep the host copy
+        # (its device leaves are only deleted by _finish_d2h, which did
+        # not run for a still-pending bucket)
+        if pend_d2h is not None:
+            k, copies = pend_d2h
+            try:
+                if use_mk:
+                    copies = jax.block_until_ready(copies)
+                for i, h in zip(buckets_out[k], copies):
+                    host_out[i] = h
+            except Exception:  # noqa: BLE001 — the failed transfer itself
+                pass
+        for a in dev_in:
+            if a is not None:
+                a.delete()
+        # re-upload freed outgoing leaves, bucket-by-bucket (same bounded
+        # in-flight window as the forward direction)
+        idxs = sorted(deleted_out)
+        for b in partition_buckets([nb_out[i] for i in idxs], bucket_bytes):
+            bidx = [idxs[i] for i in b]
+            back = jax.device_put(
+                [host_out[i] for i in bidx], [shard_out[i] for i in bidx]
+            )
+            for i, a in zip(bidx, jax.block_until_ready(back)):
+                leaves_out[i] = a
+        if use_mk:
+            # staging copies served their purpose (re-upload done): free
+            # the pinned-host bytes
+            for h in host_out:
+                if h is not None:
+                    h.delete()
+        # the re-uploaded leaves are NEW arrays; the engine must point at
+        # them (their originals are deleted)
+        out_mgr._set_state(jax.tree.unflatten(treedef_out, leaves_out))
+
     d2h_t0 = time.monotonic()
-    for k in range(len(buckets_out)):
-        cur = _issue_d2h(k)
+    try:
+        for k in range(len(buckets_out)):
+            cur = _issue_d2h(k)
+            if pend_d2h is not None:
+                _finish_d2h(pend_d2h)
+                pend_d2h = None
+                if overlapped and next_in < len(buckets_in):
+                    if pend_h2d is not None:
+                        _finish_h2d(pend_h2d)
+                        pend_h2d = None
+                    pend_h2d = _issue_h2d(next_in)
+                    next_in += 1
+            pend_d2h = cur
         if pend_d2h is not None:
             _finish_d2h(pend_d2h)
-            if overlapped and next_in < len(buckets_in):
-                if pend_h2d is not None:
-                    _finish_h2d(pend_h2d)
-                pend_h2d = _issue_h2d(next_in)
-                next_in += 1
-        pend_d2h = cur
-    if pend_d2h is not None:
-        _finish_d2h(pend_d2h)
-    d2h_t1 = time.monotonic()
-    while next_in < len(buckets_in):
+            pend_d2h = None
+        d2h_t1 = time.monotonic()
+        while next_in < len(buckets_in):
+            if pend_h2d is not None:
+                _finish_h2d(pend_h2d)
+                pend_h2d = None
+            pend_h2d = _issue_h2d(next_in)
+            next_in += 1
         if pend_h2d is not None:
             _finish_h2d(pend_h2d)
-        pend_h2d = _issue_h2d(next_in)
-        next_in += 1
-    if pend_h2d is not None:
-        _finish_h2d(pend_h2d)
+            pend_h2d = None
+    except Exception as exc:
+        try:
+            _rollback()
+        except Exception as rb_exc:
+            raise SwapRollbackFailed(
+                f"hot-swap transfer failed "
+                f"({type(exc).__name__}: {exc}) and the rollback failed "
+                f"({type(rb_exc).__name__}: {rb_exc}); device state is "
+                "partially moved"
+            ) from rb_exc
+        raise SwapRolledBack(
+            f"hot-swap transfer failed mid-flight; rolled back "
+            f"({type(exc).__name__}: {exc})"
+        ) from exc
     h2d_t1 = time.monotonic()
     if h2d_t0 is None:  # empty incoming tree (degenerate)
         h2d_t0 = h2d_t1
@@ -616,6 +731,11 @@ def swap_states(
         h2d_pool.shutdown(wait=True)  # no transfer outlives the swap
         for i in deferred_deletes:
             leaves_out[i].delete()
+    if use_mk:
+        # commit point for the incoming pool entry's pinned-host copies:
+        # deferred from _finish_h2d so a rollback could re-pool it intact
+        for i in deferred_in_frees:
+            leaves_in[i].delete()
 
     # Commit the state-machine edges: outgoing asleep (poolable host
     # state), incoming awake.

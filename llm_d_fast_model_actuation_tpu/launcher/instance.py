@@ -154,6 +154,10 @@ def engine_kickoff(config: InstanceConfig, log_path: str) -> None:
         os.close(fd)
     for k, v in (config.env_vars or {}).items():
         os.environ[k] = str(v)
+    # per-instance FMA_FAULTS must win over (latched) launcher-level state
+    from ..utils import faults
+
+    faults.load_env(force=True)
     from ..engine.server import parse_engine_options, run_server
 
     args = parse_engine_options(config.options)
@@ -201,15 +205,24 @@ class EngineInstance:
             "status": status,
             "instance_id": self.instance_id,
             "revision": self.last_revision,
+            # the child's pid (None pre-start): fault drills and the
+            # supervisor e2e need a real process to signal
+            "pid": self.process.pid if self.process is not None else None,
             **self.config.to_dict(),
         }
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self) -> Dict[str, Any]:
+    def start(self, fresh_log: bool = True) -> Dict[str, Any]:
         if self.process and self.process.is_alive():
             return self._make_state("already_running")
-        open(self._log_file_path, "wb").close()
+        if fresh_log or not os.path.exists(self._log_file_path):
+            open(self._log_file_path, "wb").close()
+        else:
+            # supervised restart: append below the crash forensics (the
+            # kickoff opens O_APPEND), with a marker separating the lives
+            with open(self._log_file_path, "ab") as f:
+                f.write(b"\n--- supervised restart ---\n")
         self.process = multiprocessing.get_context("fork").Process(
             target=self._kickoff, args=(self.config, self._log_file_path)
         )

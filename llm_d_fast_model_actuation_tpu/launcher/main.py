@@ -59,6 +59,35 @@ def main(argv=None) -> None:
         "--compile-cache-dir", default="/tmp/fma-tpu-xla-cache"
     )
     p.add_argument("--no-preload", action="store_true")
+    # Crash supervision (launcher/manager.py RestartPolicy): 0 keeps the
+    # pre-existing report-only behavior (controller re-pair heals crashes).
+    p.add_argument(
+        "--restart-budget",
+        type=int,
+        default=int(os.environ.get("FMA_RESTART_BUDGET", "0")),
+        help="supervised restarts per crash loop for a crashed engine "
+        "child (0 = report-only); a child that stays up past the reset "
+        "window earns its budget back",
+    )
+    p.add_argument(
+        "--restart-backoff",
+        type=float,
+        default=0.5,
+        help="first restart delay (s); doubles per attempt with jitter",
+    )
+    p.add_argument(
+        "--restart-backoff-max",
+        type=float,
+        default=30.0,
+        help="backoff ceiling (s) for supervised restarts",
+    )
+    p.add_argument(
+        "--restart-reset-window",
+        type=float,
+        default=300.0,
+        help="uptime (s) after which a restarted child's crash counter "
+        "resets (budget bounds crash loops, not lifetime restarts)",
+    )
     p.add_argument(
         "--notify-pod",
         action="store_true",
@@ -72,9 +101,14 @@ def main(argv=None) -> None:
     if not args.no_preload:
         preload(args.compile_cache_dir)
 
+    from ..utils import faults
     from .chiptranslator import ChipTranslator
-    from .manager import EngineProcessManager
+    from .manager import EngineProcessManager, RestartPolicy
     from .rest import build_app
+
+    # FMA_FAULTS armed pre-fork: launcher-process points (launcher.rpc,
+    # instance.spawn) fire here; engine children re-load their own env
+    faults.load_env()
 
     translator = ChipTranslator.create(
         mock_chips=args.mock_chips,
@@ -82,7 +116,17 @@ def main(argv=None) -> None:
         mock_topology=args.mock_topology,
         chip_map_path=args.chip_map_path or None,
     )
-    manager = EngineProcessManager(translator, log_dir=args.log_dir)
+    restart_policy = None
+    if args.restart_budget > 0:
+        restart_policy = RestartPolicy(
+            budget=args.restart_budget,
+            backoff_s=args.restart_backoff,
+            backoff_max_s=args.restart_backoff_max,
+            reset_window_s=args.restart_reset_window,
+        )
+    manager = EngineProcessManager(
+        translator, log_dir=args.log_dir, restart_policy=restart_policy
+    )
     app = build_app(manager)
 
     if args.notify_pod:

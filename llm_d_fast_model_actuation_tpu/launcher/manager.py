@@ -21,12 +21,17 @@ from __future__ import annotations
 
 import json
 import logging
+import random
+import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 import uuid as uuidlib
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils import faults
 from ..utils.events import EventBroadcaster
 from .chiptranslator import ChipTranslator
 from .instance import EngineInstance, InstanceConfig
@@ -35,6 +40,15 @@ logger = logging.getLogger(__name__)
 
 STATUS_STOPPED = "stopped"
 STATUS_RUNNING = "running"
+
+# probe_instance_state vocabulary: "still booting" (connected but no answer
+# yet) and "crashed" (nothing listening) are DIFFERENT failure domains — a
+# supervisor must never restart an instance that is merely slow to bind.
+PROBE_AWAKE = "awake"
+PROBE_RELEASED = "released"  # asleep AND devices released: chip is free
+PROBE_REFUSED = "refused"  # nothing listening: crashed or not yet bound
+PROBE_TIMEOUT = "timeout"  # listening but slow: booting / busy, NOT dead
+PROBE_ERROR = "error"  # unparseable options, DNS, test fakes, ...
 
 
 class ChipConflict(Exception):
@@ -73,27 +87,60 @@ class PrefetchFailed(Exception):
         self.detail = detail
 
 
+def probe_instance_state(
+    instance: "EngineInstance", timeout: float = 2.0
+) -> str:
+    """Classified probe of an instance's engine admin API (one of the
+    PROBE_* constants). Unlike a bare reachable/unreachable check this
+    separates connection-refused (nothing bound to the port: crashed, or
+    the child hasn't reached its listen() yet) from timeout (something IS
+    listening but slow to answer: booting, compiling, or busy) — the
+    supervisor and chip-exclusivity logic weigh those differently."""
+    try:
+        from ..engine.server import parse_engine_options
+
+        port = parse_engine_options(instance.config.options).port
+    except Exception:
+        return PROBE_ERROR
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/is_sleeping", timeout=timeout
+        ) as resp:
+            body = json.loads(resp.read() or b"{}")
+    except urllib.error.URLError as e:
+        reason = getattr(e, "reason", None)
+        if isinstance(reason, ConnectionRefusedError):
+            return PROBE_REFUSED
+        if isinstance(reason, (TimeoutError, socket.timeout)):
+            return PROBE_TIMEOUT
+        return PROBE_ERROR
+    except ConnectionRefusedError:
+        return PROBE_REFUSED
+    except (TimeoutError, socket.timeout):
+        return PROBE_TIMEOUT
+    except Exception:
+        return PROBE_ERROR
+    if body.get("is_sleeping", False) and body.get(
+        "devices_released", False
+    ):
+        return PROBE_RELEASED
+    return PROBE_AWAKE
+
+
 def probe_instance_awake(instance: "EngineInstance") -> Optional[bool]:
     """Ask the instance's engine admin API whether it still holds its chips.
 
     Returns True ("awake": serving, or sleeping with the TPU client still
     open — either way the chip is held), False (asleep AND devices released
     — the chip is genuinely free), or None (engine not reachable — still
-    booting, crashed, or a test fake)."""
-    try:
-        from ..engine.server import parse_engine_options
-
-        port = parse_engine_options(instance.config.options).port
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/is_sleeping", timeout=2
-        ) as resp:
-            body = json.loads(resp.read() or b"{}")
-        return not (
-            body.get("is_sleeping", False)
-            and body.get("devices_released", False)
-        )
-    except Exception:
-        return None
+    booting, crashed, or a test fake). For the supervisor-facing
+    distinction between those None cases, use probe_instance_state."""
+    state = probe_instance_state(instance)
+    if state == PROBE_AWAKE:
+        return True
+    if state == PROBE_RELEASED:
+        return False
+    return None
 
 
 class ChipLedger:
@@ -159,6 +206,37 @@ class ChipLedger:
         return dict(self._prefetched)
 
 
+@dataclass
+class RestartPolicy:
+    """Supervised-restart knobs for crashed engine children.
+
+    ``budget`` restarts per crash loop (0 disables supervision — the
+    launcher then only reports the death, the pre-existing behavior, and
+    the dual-pods controller heals by re-pairing). Delays grow
+    ``backoff_s * 2**attempt`` up to ``backoff_max_s``, with up to
+    ``jitter_frac`` random extra so a node full of children crashed by one
+    cause doesn't restart in lockstep. A child that stays up longer than
+    ``reset_window_s`` earns its crash counter back — the budget bounds
+    crash *loops*, not total restarts over a long instance lifetime."""
+
+    budget: int = 0
+    backoff_s: float = 0.5
+    backoff_max_s: float = 30.0
+    jitter_frac: float = 0.2
+    reset_window_s: float = 300.0
+
+
+@dataclass
+class _RestartState:
+    attempts: int = 0
+    last_crash: float = 0.0
+    timer: Optional[threading.Timer] = None
+    #: set by _cancel_restart under the restart lock; a timer body that
+    #: already started (Timer.cancel is a no-op then) re-checks this
+    #: before forking, so an explicit stop can never race an orphan child
+    cancelled: bool = False
+
+
 class EngineProcessManager:
     def __init__(
         self,
@@ -169,6 +247,7 @@ class EngineProcessManager:
         awake_probe: Optional[
             Callable[["EngineInstance"], Optional[bool]]
         ] = None,
+        restart_policy: Optional[RestartPolicy] = None,
     ) -> None:
         self.instances: Dict[str, EngineInstance] = {}
         self.translator = translator
@@ -190,6 +269,16 @@ class EngineProcessManager:
         # stays opt-in for such managers (tests pass a probe or disable).
         self.enforce_chip_exclusivity = enforce_chip_exclusivity
         self._awake_probe = awake_probe or probe_instance_awake
+        # Crash supervision (docs/operations.md "Self-healing"): a child
+        # death becomes a backoff-scheduled in-place restart instead of a
+        # wait for the controller's minutes-long re-pair path.
+        self.restart_policy = restart_policy
+        self._restart_states: Dict[str, _RestartState] = {}
+        # RLock: _restart_instance holds it across its whole body (so a
+        # concurrent stop_instance serializes against the fork) and its
+        # spawn-failure path re-enters via _restart_allowed/_schedule
+        self._restart_lock = threading.RLock()
+        self._loop = None  # captured from the sentinel callback's loop
 
     # -- revisions -----------------------------------------------------------
 
@@ -275,23 +364,180 @@ class EngineProcessManager:
         return result
 
     def _on_instance_stopped(self, instance_id: str, exitcode) -> None:
-        """Sentinel callback: the child died on its own."""
+        """Sentinel callback: the child died on its own. Publishes STOPPED
+        (wire behavior unchanged), then — when a restart policy is armed
+        and the crash-loop budget allows — keeps the ChipLedger hold (the
+        chips stay earmarked for the comeback; a concurrent create must
+        not steal them) and schedules a supervised restart."""
         instance = self.instances.get(instance_id)
         if instance is None:
             return
-        self.ledger.release(instance_id)
+        will_restart = self._restart_allowed(instance_id)
+        if not will_restart:
+            self.ledger.release(instance_id)
         obj = instance.get_status()
         obj["exit_code"] = exitcode
         instance.last_revision = self._publish("STOPPED", obj)
         logger.warning(
             "instance %s stopped itself (exit code %s)", instance_id, exitcode
         )
+        if will_restart:
+            try:
+                import asyncio
+
+                self._loop = asyncio.get_running_loop()
+            except RuntimeError:
+                pass
+            self._schedule_restart(instance_id, exitcode)
+
+    # -- crash supervision ---------------------------------------------------
+
+    def _restart_allowed(self, instance_id: str) -> bool:
+        pol = self.restart_policy
+        if pol is None or pol.budget <= 0:
+            return False
+        if instance_id not in self.instances:
+            return False
+        with self._restart_lock:
+            st = self._restart_states.setdefault(instance_id, _RestartState())
+            now = time.monotonic()
+            if (
+                st.attempts
+                and now - st.last_crash > pol.reset_window_s
+            ):
+                # survived a full window since the last crash: not a loop
+                st.attempts = 0
+            if st.attempts >= pol.budget:
+                logger.error(
+                    "instance %s crash-looped through its restart budget "
+                    "(%d); leaving it stopped", instance_id, pol.budget,
+                )
+                return False
+            return True
+
+    def _schedule_restart(self, instance_id: str, exitcode) -> None:
+        """Publish RESTARTING and arm the backoff timer for one attempt."""
+        pol = self.restart_policy
+        instance = self.instances.get(instance_id)
+        if pol is None or instance is None:
+            return
+        with self._restart_lock:
+            st = self._restart_states.setdefault(instance_id, _RestartState())
+            attempt = st.attempts
+            st.attempts += 1
+            st.last_crash = time.monotonic()
+            delay = min(pol.backoff_max_s, pol.backoff_s * (2 ** attempt))
+            delay *= 1.0 + random.uniform(0.0, max(0.0, pol.jitter_frac))
+            delay = min(delay, pol.backoff_max_s)  # cap is a hard ceiling
+            timer = threading.Timer(
+                delay,
+                self._restart_instance,
+                args=(instance_id, attempt + 1, st),
+            )
+            timer.daemon = True
+            st.timer = timer
+        obj = instance.get_status()
+        obj.update(
+            exit_code=exitcode,
+            restart_attempt=attempt + 1,
+            restart_budget=pol.budget,
+            backoff_s=round(delay, 3),
+        )
+        instance.last_revision = self._publish("RESTARTING", obj)
+        logger.warning(
+            "instance %s: supervised restart %d/%d in %.2fs",
+            instance_id, attempt + 1, pol.budget, delay,
+        )
+        timer.start()
+
+    def _restart_instance(
+        self, instance_id: str, attempt: int, st: _RestartState
+    ) -> None:
+        """Backoff-timer body: re-fork the child from the instance's
+        CURRENT (engine-truth rewritten) options — a restarted instance
+        comes back serving its last-swapped model — then reconcile the
+        ChipLedger and re-arm crash detection.
+
+        Runs under the restart lock end to end: Timer.cancel is a no-op
+        once this body has started, so an explicit stop_instance racing it
+        serializes on the lock instead — either the restart completes
+        first (and the stop then stops the fresh child and releases the
+        ledger), or the cancel lands first (``st.cancelled``) and no child
+        is forked."""
+        with self._restart_lock:
+            if st.cancelled:
+                return  # explicit stop won the race
+            instance = self.instances.get(instance_id)
+            if instance is None:
+                return  # stopped/deleted while the backoff ran
+            if instance.process is not None and instance.process.is_alive():
+                return  # never restart a live child (manual intervention)
+            try:
+                faults.fire("instance.spawn")
+                # append to the existing log: the crash forensics above
+                # the restart marker are exactly what the operator needs
+                instance.start(fresh_log=False)
+            except Exception as e:  # noqa: BLE001 — spawn failed: retry
+                logger.warning(
+                    "instance %s restart attempt %d failed to spawn: %s",
+                    instance_id, attempt, e,
+                )
+                if self._restart_allowed(instance_id):
+                    self._schedule_restart(instance_id, None)
+                else:
+                    self.ledger.release(instance_id)
+                return
+            # reconcile the ledger: the hold was kept across the crash
+            # window; acquire is idempotent, and the model comes from the
+            # rewritten options (what the child will actually serve)
+            self.ledger.acquire(instance_id, instance.config.chip_ids)
+            try:
+                from ..engine.server import parse_engine_options
+
+                self.ledger.set_model(
+                    instance_id,
+                    parse_engine_options(instance.config.options).model,
+                )
+            except Exception:  # noqa: BLE001 — free-form options
+                pass
+            obj = instance.get_status()
+            obj["restart_attempt"] = attempt
+            instance.last_revision = self._publish("RESTARTED", obj)
+            logger.info(
+                "instance %s restarted (attempt %d, pid %s)",
+                instance_id, attempt,
+                instance.process.pid if instance.process else None,
+            )
+        loop = self._loop
+        if loop is not None and loop.is_running():
+            # crash detection must be re-armed on the event loop thread
+            loop.call_soon_threadsafe(self._rearm_sentinel, instance_id)
+
+    def _rearm_sentinel(self, instance_id: str) -> None:
+        instance = self.instances.get(instance_id)
+        if instance is None:
+            return
+        try:
+            instance.start_sentinel_watcher(self._on_instance_stopped)
+        except RuntimeError:
+            logger.warning(
+                "no running loop; sentinel not re-armed for %s", instance_id
+            )
+
+    def _cancel_restart(self, instance_id: str) -> None:
+        with self._restart_lock:
+            st = self._restart_states.pop(instance_id, None)
+            if st is not None:
+                st.cancelled = True
+                if st.timer is not None:
+                    st.timer.cancel()
 
     def stop_instance(self, instance_id: str, timeout: float = 10) -> Dict[str, Any]:
         if instance_id not in self.instances:
             raise KeyError(instance_id)
         instance = self.instances[instance_id]
         instance.cancel_sentinel_watcher()
+        self._cancel_restart(instance_id)  # an explicit stop is not a crash
         result = instance.stop(timeout=timeout)
         del self.instances[instance_id]
         self.ledger.release(instance_id)
@@ -320,11 +566,25 @@ class EngineProcessManager:
             previous = parse_engine_options(instance.config.options).model
         except Exception:
             previous = ""
-        body = self._engine_request(
-            instance_id, "POST", "/v1/swap",
-            {"model": model, "checkpoint_dir": checkpoint_dir},
-            timeout, SwapFailed,
-        )
+        # The request id makes the verb safely recoverable: if the POST
+        # times out with the swap possibly still executing, we do NOT
+        # re-send (that could swap twice) — we poll GET /v1/swap and accept
+        # the committed record carrying OUR id as the answer.
+        request_id = uuidlib.uuid4().hex
+        try:
+            body = self._engine_request(
+                instance_id, "POST", "/v1/swap",
+                {
+                    "model": model,
+                    "checkpoint_dir": checkpoint_dir,
+                    "request_id": request_id,
+                },
+                timeout, SwapFailed,
+            )
+        except SwapFailed as e:
+            if e.status != 504:
+                raise
+            body = self._recover_swap_result(instance_id, request_id, e)
         from .instance import replace_model_option
 
         # rewrite from the ENGINE's answer, not the request: a pool hit
@@ -353,6 +613,64 @@ class EngineProcessManager:
             "revision": instance.last_revision,
         }
 
+    def _recover_swap_result(
+        self,
+        instance_id: str,
+        request_id: str,
+        timeout_exc: "SwapFailed",
+        window_s: float = 10.0,
+        poll_s: float = 0.5,
+    ) -> Dict[str, Any]:
+        """Timed-out swap recovery: poll the engine's committed-swap record
+        for our request id. Found => the swap happened exactly once and
+        this is its result; not found within the window => surface the
+        original timeout as a 504 (the caller knows the verb may still be
+        executing and can widen its timeout)."""
+        deadline = time.monotonic() + window_s
+        while time.monotonic() < deadline:
+            try:
+                body = self._engine_request(
+                    instance_id, "GET", "/v1/swap", None,
+                    min(5.0, window_s), SwapFailed, retries=1,
+                )
+            except SwapFailed:
+                body = {}
+            if body.get("request_id") == request_id:
+                logger.info(
+                    "swap on instance %s recovered via request id after a "
+                    "timeout", instance_id,
+                )
+                return body
+            time.sleep(poll_s)
+        raise SwapFailed(
+            instance_id, 504,
+            f"swap timed out and no committed record with request id "
+            f"{request_id} appeared within {window_s}s "
+            f"({timeout_exc.detail})",
+        )
+
+    @staticmethod
+    def _is_connection_refused(e: BaseException) -> bool:
+        if isinstance(e, (ConnectionRefusedError, faults.FaultError)):
+            # an injected launcher.rpc fault models exactly this class of
+            # failure: the request never reached the engine
+            return True
+        if isinstance(e, urllib.error.URLError):
+            return isinstance(
+                getattr(e, "reason", None), ConnectionRefusedError
+            )
+        return False
+
+    @staticmethod
+    def _is_timeout(e: BaseException) -> bool:
+        if isinstance(e, (TimeoutError, socket.timeout)):
+            return True
+        if isinstance(e, urllib.error.URLError):
+            return isinstance(
+                getattr(e, "reason", None), (TimeoutError, socket.timeout)
+            )
+        return False
+
     def _engine_request(
         self,
         instance_id: str,
@@ -361,10 +679,21 @@ class EngineProcessManager:
         body: Optional[Dict[str, Any]],
         timeout: float,
         exc_cls,
+        retries: int = 2,
+        retry_backoff_s: float = 0.2,
     ) -> Dict[str, Any]:
         """Forward an admin verb to a live instance's engine child; maps
         stored-options/HTTP failures onto `exc_cls(instance_id, status,
-        detail)` the REST layer turns into 4xx/502."""
+        detail)` the REST layer turns into 4xx/502/503.
+
+        Connection-refused is retried up to ``retries`` times with
+        exponential backoff + jitter: refused means the request never
+        reached the engine (crash window mid-restart, child not yet bound),
+        so a retry is safe for EVERY verb. A TIMEOUT is never retried here
+        — the request may be executing (a timed-out swap re-sent blindly
+        could swap twice); it raises with status **504** (vs 502 for
+        unreachable) so callers with an idempotent recovery path
+        (swap_instance's request-id replay) can take it."""
         if instance_id not in self.instances:
             raise KeyError(instance_id)
         instance = self.instances[instance_id]
@@ -385,14 +714,31 @@ class EngineProcessManager:
             headers={"Content-Type": "application/json"},
             method=method,
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise exc_cls(instance_id, e.code, detail)
-        except Exception as e:  # noqa: BLE001 — unreachable child, timeout, ...
-            raise exc_cls(instance_id, 502, f"engine unreachable: {e}")
+        attempt = 0
+        while True:
+            try:
+                faults.fire("launcher.rpc")
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:500]
+                raise exc_cls(instance_id, e.code, detail)
+            except Exception as e:  # noqa: BLE001 — refused, timeout, ...
+                if self._is_connection_refused(e) and attempt < retries:
+                    attempt += 1
+                    delay = retry_backoff_s * (2 ** (attempt - 1))
+                    delay *= 1.0 + random.random()  # jitter: no lockstep
+                    logger.warning(
+                        "engine %s refused %s %s (attempt %d/%d); "
+                        "retrying in %.2fs",
+                        instance_id, method, api_path, attempt, retries,
+                        delay,
+                    )
+                    time.sleep(min(delay, 2.0))
+                    continue
+                if self._is_timeout(e):
+                    raise exc_cls(instance_id, 504, f"engine timed out: {e}")
+                raise exc_cls(instance_id, 502, f"engine unreachable: {e}")
 
     def prefetch_instance(
         self,

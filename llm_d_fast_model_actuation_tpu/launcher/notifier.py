@@ -24,6 +24,7 @@ import asyncio
 import hashlib
 import json
 import logging
+import random
 import shutil
 from typing import Any, AsyncIterator, Awaitable, Callable, Dict, List, Optional
 
@@ -60,14 +61,36 @@ class InstanceStateNotifier:
         patch: Callable[[str], Awaitable[None]],
         watcher: Optional[WatcherFactory] = None,
         poll_interval_s: float = 2.0,
+        reconnect_backoff_s: float = 0.5,
+        reconnect_backoff_max_s: float = 30.0,
     ) -> None:
         self._lister = lister
         self._patch = patch
         self._watcher = watcher
         self._poll_interval_s = poll_interval_s
+        # Reconnect discipline: a down launcher must not be hammered on a
+        # fixed cadence — consecutive connect/stream failures back off
+        # exponentially (with jitter, so a fleet of sidecars doesn't
+        # reconnect in lockstep) up to a capped ceiling, and one success
+        # resets the schedule.
+        self._reconnect_backoff_s = reconnect_backoff_s
+        self._reconnect_backoff_max_s = reconnect_backoff_max_s
+        self._consecutive_failures = 0
         self._last_signature: Optional[str] = None
         self._last_revision = 0
         self._stopping = False
+
+    def _reconnect_delay(self) -> float:
+        """Delay before the next watch (re)connect after N consecutive
+        failures: min(cap, base * 2**(N-1)), jittered into [d/2, d] so a
+        fleet of sidecars spreads out while the configured ceiling stays a
+        hard cap."""
+        n = max(1, self._consecutive_failures)
+        d = min(
+            self._reconnect_backoff_max_s,
+            self._reconnect_backoff_s * (2 ** (n - 1)),
+        )
+        return d * (0.5 + 0.5 * random.random())
 
     async def reflect_once(self) -> Optional[str]:
         """List, compute, patch-if-changed. Returns the new signature when a
@@ -87,6 +110,7 @@ class InstanceStateNotifier:
         again on every event. Falls back to polling without a watcher."""
         while not self._stopping:
             stream: Optional[AsyncIterator[Any]] = None
+            connect_failed = False
             if self._watcher is not None:
                 try:
                     stream = await self._watcher(self._last_revision)
@@ -97,13 +121,26 @@ class InstanceStateNotifier:
                         # resume cursor evicted: restart from the buffer
                         # start; the reflect below covers current state
                         self._last_revision = 0
-                    logger.warning("watch connect failed (%s); polling", e)
+                    connect_failed = True
+                    self._consecutive_failures += 1
+                    logger.warning(
+                        "watch connect failed (%s); retry %d backing off",
+                        e, self._consecutive_failures,
+                    )
 
             await self._reflect_guarded()
 
             if stream is None:
-                await asyncio.sleep(self._poll_interval_s)
+                # no watcher configured: steady polling cadence; a FAILED
+                # connect instead backs off exponentially (capped, with
+                # jitter) so a down launcher isn't hammered
+                await asyncio.sleep(
+                    self._reconnect_delay()
+                    if connect_failed
+                    else self._poll_interval_s
+                )
                 continue
+            self._consecutive_failures = 0  # connected: schedule resets
             try:
                 async for event in stream:
                     rev = (event.get("object") or {}).get("revision") if isinstance(
@@ -119,8 +156,12 @@ class InstanceStateNotifier:
             except Exception as e:
                 if isinstance(e, RevisionTooOld):
                     self._last_revision = 0
-                logger.warning("watch stream broke (%s); resyncing", e)
-                await asyncio.sleep(min(self._poll_interval_s, 1.0))
+                self._consecutive_failures += 1
+                logger.warning(
+                    "watch stream broke (%s); resync %d backing off",
+                    e, self._consecutive_failures,
+                )
+                await asyncio.sleep(self._reconnect_delay())
 
     async def _reflect_guarded(self) -> None:
         try:

@@ -70,6 +70,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "prefetch_status": "GET /v2/vllm/instances/{instance_id}/prefetch",
                     "abort_prefetch": "DELETE /v2/vllm/instances/{instance_id}/prefetch",
                     "watch_instances": "GET /v2/vllm/instances/watch",
+                    "faults": "GET/POST/DELETE /v2/vllm/faults",
                 },
             }
         )
@@ -226,17 +227,25 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
         except SwapFailed as e:
             # engine-side rejection (bad model name, gang, sleeping) maps
-            # to the client's fault; an unreachable child is a gateway error
+            # to the client's fault; a rolled-back swap is a retryable 503;
+            # a timed-out-and-unrecovered swap is 504; an unreachable child
+            # is a gateway error
             if 400 <= e.status < 500:
                 raise web.HTTPBadRequest(text=str(e))
+            if e.status == 503:
+                raise web.HTTPServiceUnavailable(text=str(e))
+            if e.status == 504:
+                raise web.HTTPGatewayTimeout(text=str(e))
             raise web.HTTPBadGateway(text=str(e))
         return web.json_response(result)
 
     def _map_prefetch_error(e: PrefetchFailed):
         # engine-side rejection (bad model, gang, already running) is the
-        # client's fault; an unreachable child is a gateway error
+        # client's fault; a timed-out child is 504, unreachable is 502
         if 400 <= e.status < 500:
             return web.HTTPBadRequest(text=str(e))
+        if e.status == 504:
+            return web.HTTPGatewayTimeout(text=str(e))
         return web.HTTPBadGateway(text=str(e))
 
     async def prefetch_instance(request: web.Request) -> web.Response:
@@ -336,8 +345,42 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             },
         )
 
+    async def faults_get(request: web.Request) -> web.Response:
+        from ..utils import faults
+
+        return web.json_response(faults.describe())
+
+    async def faults_arm(request: web.Request) -> web.Response:
+        """Arm launcher-process fault points (launcher.rpc,
+        instance.spawn) for tests and fault drills (utils/faults.py)."""
+        from ..utils import faults
+
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        spec = body.get("spec")
+        if not isinstance(spec, str) or not spec:
+            # 400 like the engine's mirrored /v1/faults — one convention
+            # for drill scripts hitting either surface
+            raise web.HTTPBadRequest(text="faults requires a 'spec' string")
+        try:
+            faults.arm_spec(spec)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(faults.describe())
+
+    async def faults_reset(request: web.Request) -> web.Response:
+        from ..utils import faults
+
+        faults.reset()
+        return web.json_response(faults.describe())
+
     app.router.add_get("/health", health)
     app.router.add_get("/", index)
+    app.router.add_get("/v2/vllm/faults", faults_get)
+    app.router.add_post("/v2/vllm/faults", faults_arm)
+    app.router.add_delete("/v2/vllm/faults", faults_reset)
     app.router.add_get("/v2/vllm/instances/watch", watch)
     app.router.add_post("/v2/vllm/instances", create_instance)
     app.router.add_put("/v2/vllm/instances/{instance_id}", create_named_instance)

@@ -36,6 +36,7 @@ from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..utils import faults
 from .llama import LlamaConfig
 
 #: HF `architectures[0]` -> config-knob overrides for our shared forward
@@ -599,6 +600,7 @@ def load_params(
             raise
 
     def _read_shard(fname: str) -> None:
+        faults.fire("coldload.read")
         for name, arr in _iter_shard_tensors(path, kind, fname):
             if _aborted():
                 raise LoadAborted(f"load of {path!r} aborted")
@@ -662,6 +664,7 @@ def load_params(
                 nbs = [arrs[f].nbytes for f in flats]
                 for bucket in partition_buckets(nbs, bucket_bytes):
                     bflats = [flats[i] for i in bucket]
+                    faults.fire("coldload.h2d")
                     if h2d_win[0] is None:
                         h2d_win[0] = time.monotonic()
                     puts = jax.device_put(
@@ -814,6 +817,7 @@ def place_staged_params(
 
     for bucket in partition_buckets(nbs, bucket_bytes):
         bkeys = [keys[i] for i in bucket]
+        faults.fire("coldload.h2d")
         puts = jax.device_put(
             [flat[k] for k in bkeys], [targets[k] for k in bkeys]
         )

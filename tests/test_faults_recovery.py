@@ -1,0 +1,859 @@
+"""Fault-injection framework + self-healing actuation.
+
+Three failure domains, each armed deterministically through utils/faults.py
+and asserted to HEAL rather than wedge:
+
+  * engine hot-swap — a mid-transfer failure rolls back transactionally
+    (outgoing model serves again, incoming entry re-pooled, /health stays
+    200 with a DEGRADED marker, `fma_engine_recoveries_total` increments);
+  * launcher supervision — a crashed engine child is restarted with
+    exponential backoff under a budget, from the engine-truth rewritten
+    options, with its ChipLedger hold kept across the crash window;
+  * launcher -> engine RPC — connection-refused retries with backoff, and
+    a timed-out swap recovered through its request id instead of being
+    re-executed.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llm_d_fast_model_actuation_tpu.utils import faults
+from llm_d_fast_model_actuation_tpu.utils.faults import FaultError
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """The registry is process-global: no test leaks armed points."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- the registry -------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_fault_registry_modes():
+    faults.arm("p.once")  # default: fail once
+    with pytest.raises(FaultError):
+        faults.fire("p.once")
+    faults.fire("p.once")  # consumed: no-op
+
+    faults.arm("p.twice", mode="fail", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            faults.fire("p.twice")
+    faults.fire("p.twice")
+
+    faults.arm("p.slow", mode="delay", delay_s=0.05, count=1)
+    t0 = time.monotonic()
+    faults.fire("p.slow")
+    assert time.monotonic() - t0 >= 0.05
+    t0 = time.monotonic()
+    faults.fire("p.slow")  # consumed: no delay
+    assert time.monotonic() - t0 < 0.05
+
+    # programmatic arm matches the spec grammar's mode defaults: fail
+    # once, delay every time
+    faults.arm("p.sustained", mode="delay", delay_s=0.0)
+    assert faults.describe()["armed"]["p.sustained"]["remaining"] == -1
+
+    faults.arm_spec("a.b=fail:1, c.d=delay:0.01:2")
+    desc = faults.describe()
+    assert desc["armed"]["a.b"]["mode"] == "fail"
+    assert desc["armed"]["c.d"] == {
+        "mode": "delay", "remaining": 2, "delay_s": 0.01, "fired": 0,
+    }
+    faults.disarm("a.b")
+    faults.fire("a.b")  # disarmed: no-op
+    faults.reset()
+    assert faults.describe()["armed"] == {}
+
+
+@pytest.mark.faults
+def test_fault_spec_validation():
+    for bad in ("nomode", "p=", "=fail", "p=explode", "p=fail:1:2",
+                "p=delay", "p=delay:-1", "p=delay:x"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+    # unknown POINT names are fine (tests add their own); unknown MODES are not
+    assert "anything.goes" in faults.parse_spec("anything.goes=fail:3")
+
+
+@pytest.mark.faults
+def test_env_arming_is_latched_until_forced(monkeypatch):
+    monkeypatch.setenv("FMA_FAULTS", "env.point=fail:1")
+    reg = faults.FaultRegistry()
+    reg.load_env()
+    with pytest.raises(FaultError):
+        reg.fire("env.point")
+    # consumed; a second (latched) load must NOT re-arm it
+    monkeypatch.setenv("FMA_FAULTS", "env.point=fail:1")
+    reg.load_env()
+    reg.fire("env.point")
+    # the forked-child path re-reads explicitly
+    reg.load_env(force=True)
+    with pytest.raises(FaultError):
+        reg.fire("env.point")
+
+
+@pytest.mark.faults
+def test_engine_faults_flag_validated_at_parse_time():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        parse_engine_options,
+    )
+
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --faults junkspec")
+    args = parse_engine_options("--model tiny --faults swap.h2d=fail:1")
+    assert args.faults == "swap.h2d=fail:1"
+
+
+# -- engine: transactional swap rollback --------------------------------------
+
+
+@pytest.fixture
+def service():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    args = parse_engine_options(
+        "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --swap-bucket-mib 1"
+    )
+    svc = EngineService(args)
+    yield svc
+    svc.shutdown()
+
+
+def _generate(service, prompt=(1, 2, 3), n=4):
+    return service.submit(list(prompt), n, 0.0).result(timeout=60).out_tokens
+
+
+def _recoveries(path, outcome):
+    from llm_d_fast_model_actuation_tpu.engine.server import ENGINE_RECOVERIES
+
+    return ENGINE_RECOVERIES.labels(path=path, outcome=outcome)._value.get()
+
+
+async def _with_engine_client(service, fn):
+    from llm_d_fast_model_actuation_tpu.engine.server import build_app
+
+    client = TestClient(TestServer(build_app(service)))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+@pytest.mark.faults
+def test_swap_h2d_rollback_over_http(service):
+    """The acceptance scenario: with swap.h2d armed fail-once (over REST),
+    a pool-hit swap rolls back — 503, /health stays 200 (DEGRADED), the
+    recoveries counter increments, the outgoing model serves bit-exact,
+    and the retried swap takes the warm pool path."""
+    gold_tiny = _generate(service)
+    assert service.swap("tiny-gemma")["swapped"]
+    gold_gemma = _generate(service)
+    assert service.builds_total == 2
+    before = _recoveries("swap", "rolled_back")
+
+    async def scenario(client):
+        r = await client.post(
+            "/v1/faults", json={"spec": "swap.h2d=fail:1"}
+        )
+        assert r.status == 200
+        assert "swap.h2d" in (await r.json())["armed"]
+
+        # pool-hit swap back to tiny hits the injected transfer failure
+        r = await client.post("/v1/swap", json={"model": "tiny"})
+        assert r.status == 503
+        body = await r.json()
+        assert body["rolled_back"] and body["model"] == "tiny-gemma"
+
+        r = await client.get("/health")
+        assert r.status == 200  # degraded, NOT failed
+        health = await r.json()
+        assert health["status"] == "DEGRADED"
+        assert "rolled back" in health["reason"]
+
+        # the outgoing model serves again, bit-exact, within this window
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 4}
+        )
+        assert r.status == 200
+        assert (await r.json())["choices"][0]["token_ids"] == gold_gemma
+
+        # the fault is consumed: the retry succeeds as a pool hit (the
+        # incoming entry was re-pooled, not discarded)
+        r = await client.post(
+            "/v1/swap", json={"model": "tiny", "request_id": "rid-1"}
+        )
+        assert r.status == 200
+        retry = await r.json()
+        assert retry["swapped"] and retry["pool_hit"]
+
+        r = await client.get("/health")
+        assert (await r.json())["status"] == "OK"  # success clears DEGRADED
+
+        # GET /v1/swap exposes the committed record with its request id
+        r = await client.get("/v1/swap")
+        last = await r.json()
+        assert last["request_id"] == "rid-1" and last["model"] == "tiny"
+
+    asyncio.run(_with_engine_client(service, scenario))
+    assert _recoveries("swap", "rolled_back") == before + 1
+    assert service.failure is None
+    assert service.builds_total == 2  # rollback + retry re-read nothing
+    assert _generate(service) == gold_tiny
+
+
+@pytest.mark.faults
+def test_swap_d2h_rollback_first_bucket(service):
+    """A failure on the very first outgoing bucket rolls back with zero
+    transfers done: both models end exactly as they began."""
+    from llm_d_fast_model_actuation_tpu.engine.sleep import SwapRolledBack
+
+    gold = _generate(service)
+    service.swap("tiny-gemma")
+    faults.arm("swap.d2h", mode="fail", count=1)
+    with pytest.raises(SwapRolledBack):
+        service.swap("tiny")
+    assert service.failure is None and service.degraded
+    out = service.swap("tiny")
+    assert out["pool_hit"]
+    assert _generate(service) == gold
+
+
+@pytest.mark.faults
+def test_swap_request_id_is_idempotent(service):
+    service.swap("tiny-gemma", request_id="req-A")
+    assert service.last_swap["request_id"] == "req-A"
+    builds = service.builds_total
+    # same id, DIFFERENT model: must replay the committed record, never
+    # swap again (the retry of a lost response must not move the engine)
+    out = service.swap("tiny", request_id="req-A")
+    assert out["replayed"] and out["model"] == "tiny-gemma"
+    assert service.builds_total == builds
+    assert service.args.model == "tiny-gemma"
+
+
+@pytest.mark.faults
+def test_cold_build_rollback_chains_wake_failure(service, monkeypatch):
+    """Satellite: when the rollback wake itself dies after a failed cold
+    build, the service failure carries BOTH causes and the raised error
+    chains the original build exception."""
+    build_exc = RuntimeError("checkpoint exploded")
+    monkeypatch.setattr(
+        service, "_build_runtime",
+        lambda *a, **k: (_ for _ in ()).throw(build_exc),
+    )
+    monkeypatch.setattr(
+        service.sleeper, "wake_up",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("wake died")),
+    )
+    with pytest.raises(RuntimeError) as ei:
+        service.swap("tiny-gemma")
+    assert ei.value.__cause__ is build_exc
+    assert "checkpoint exploded" in str(service.failure)
+    assert "wake died" in str(service.failure)
+
+
+@pytest.mark.faults
+def test_cold_build_failure_rolls_back_and_degrades(service):
+    """A failed cold build (bad model dir) wakes the outgoing model back
+    up: still serving, DEGRADED, recoveries counted."""
+    before = _recoveries("swap_cold", "rolled_back")
+    gold = _generate(service)
+    with pytest.raises(Exception):
+        service.swap("hf:/nonexistent-model-dir")
+    assert service.failure is None
+    assert service.degraded and "rolled back" in service.degraded
+    assert _recoveries("swap_cold", "rolled_back") == before + 1
+    assert _generate(service) == gold
+
+
+@pytest.mark.faults
+def test_coldload_and_prefetch_fault_points(tmp_path, service):
+    """coldload.read aborts a cold HF load; prefetch.stage fails a
+    background prefetch into the recorded `failed` state (not a wedge)."""
+    from conftest import build_sharded_hf_model_dir
+
+    from llm_d_fast_model_actuation_tpu.models import hf as hf_models
+
+    model_dir = build_sharded_hf_model_dir(str(tmp_path / "m"))
+    cfg = hf_models.config_from_hf(model_dir)
+    faults.arm("coldload.read", mode="fail", count=1)
+    with pytest.raises(FaultError):
+        hf_models.load_params(model_dir, cfg, workers=1)
+    # consumed: the same load now succeeds
+    params = hf_models.load_params(model_dir, cfg, workers=1)
+    assert params is not None
+
+    faults.arm("prefetch.stage", mode="fail", count=1)
+    service.prefetch(f"hf:{model_dir}")
+    deadline = time.monotonic() + 30
+    while (
+        service.last_prefetch.get("state") == "running"
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    assert service.last_prefetch["state"] == "failed"
+    assert "FaultError" in service.last_prefetch["error"]
+
+
+# -- launcher: probe classification, RPC retries, swap recovery ---------------
+
+
+@pytest.fixture
+def translator():
+    from llm_d_fast_model_actuation_tpu.launcher.chiptranslator import (
+        ChipTranslator,
+    )
+
+    return ChipTranslator.create(
+        mock_chips=True, mock_chip_count=8, mock_topology="2x4"
+    )
+
+
+def _fake_kickoff(config, log_path):
+    with open(log_path, "ab", buffering=0) as f:
+        f.write(b"fake engine up\n")
+    time.sleep(300)
+
+
+@pytest.mark.faults
+def test_probe_distinguishes_refused_from_timeout(translator, tmp_path):
+    from conftest import free_port
+
+    from llm_d_fast_model_actuation_tpu.launcher.instance import (
+        EngineInstance,
+        InstanceConfig,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        PROBE_REFUSED,
+        PROBE_TIMEOUT,
+        probe_instance_awake,
+        probe_instance_state,
+    )
+
+    port = free_port()
+    cfg = InstanceConfig(options=f"--model tiny --port {port}")
+    inst = EngineInstance(
+        "p1", cfg, translator, log_dir=str(tmp_path), kickoff=_fake_kickoff
+    )
+    # nothing bound: refused == crashed (or not yet bound)
+    assert probe_instance_state(inst, timeout=0.5) == PROBE_REFUSED
+    assert probe_instance_awake(inst) is None
+
+    # something listening that never answers: "still booting", NOT crashed
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", port))
+    srv.listen(1)
+    try:
+        assert probe_instance_state(inst, timeout=0.5) == PROBE_TIMEOUT
+        assert probe_instance_awake(inst) is None
+    finally:
+        srv.close()
+
+
+@pytest.mark.faults
+def test_engine_request_retries_connection_refused(translator, tmp_path):
+    """launcher.rpc armed fail:2 models two refused connections; the verb
+    succeeds on the third attempt with backoff in between."""
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        SwapFailed,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher import manager as manager_mod
+    from llm_d_fast_model_actuation_tpu.launcher.instance import InstanceConfig
+
+    m = EngineProcessManager(
+        translator, log_dir=str(tmp_path), kickoff=_fake_kickoff
+    )
+    try:
+        m.create_instance(InstanceConfig(options="--model tiny"), "r1")
+        calls = []
+
+        class _Resp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return json.dumps({"ok": True}).encode()
+
+        def fake_urlopen(req, timeout=None):
+            calls.append(req.full_url)
+            return _Resp()
+
+        orig = manager_mod.urllib.request.urlopen
+        manager_mod.urllib.request.urlopen = fake_urlopen
+        try:
+            faults.arm("launcher.rpc", mode="fail", count=2)
+            out = m._engine_request(
+                "r1", "GET", "/v1/swap", None, 5, SwapFailed,
+                retries=3, retry_backoff_s=0.01,
+            )
+            assert out == {"ok": True}
+            assert len(calls) == 1  # two injected refusals never hit HTTP
+
+            # retries exhausted -> 502, refused reported as unreachable
+            faults.arm("launcher.rpc", mode="fail", count=5)
+            with pytest.raises(SwapFailed) as ei:
+                m._engine_request(
+                    "r1", "GET", "/v1/swap", None, 5, SwapFailed,
+                    retries=2, retry_backoff_s=0.01,
+                )
+            assert ei.value.status == 502
+        finally:
+            manager_mod.urllib.request.urlopen = orig
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
+@pytest.mark.faults
+def test_swap_timeout_recovered_via_request_id(translator, tmp_path):
+    """A timed-out swap POST is NOT re-sent; the launcher polls the
+    committed-swap record and accepts the one carrying its request id."""
+    from llm_d_fast_model_actuation_tpu.launcher import manager as manager_mod
+    from llm_d_fast_model_actuation_tpu.launcher.instance import InstanceConfig
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+    )
+
+    m = EngineProcessManager(
+        translator, log_dir=str(tmp_path), kickoff=_fake_kickoff
+    )
+    try:
+        m.create_instance(
+            InstanceConfig(options="--model tiny --port 18123"), "t1"
+        )
+        posts, committed = [], {}
+
+        class _Resp:
+            def __init__(self, body):
+                self._body = body
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return json.dumps(self._body).encode()
+
+        def fake_urlopen(req, timeout=None):
+            if req.get_method() == "POST":
+                body = json.loads(req.data)
+                posts.append(body)
+                # the engine EXECUTES the swap but the response is lost
+                committed.update(
+                    body, swapped=True, pool_hit=True,
+                    checkpoint_dir=body.get("checkpoint_dir", ""),
+                )
+                raise urllib.error.URLError(TimeoutError("read timed out"))
+            return _Resp(dict(committed))
+
+        orig = manager_mod.urllib.request.urlopen
+        manager_mod.urllib.request.urlopen = fake_urlopen
+        try:
+            out = m.swap_instance("t1", "tiny-gemma", timeout=1)
+        finally:
+            manager_mod.urllib.request.urlopen = orig
+        assert len(posts) == 1  # never re-executed
+        assert out["swap"]["model"] == "tiny-gemma"
+        assert out["swap"]["request_id"] == posts[0]["request_id"]
+        # stored options rewritten from the recovered engine answer
+        assert "--model tiny-gemma" in m.instances["t1"].config.options
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
+# -- launcher: supervised restart ---------------------------------------------
+
+
+@pytest.mark.faults
+def test_supervised_restart_backoff_budget_and_ledger(translator, tmp_path):
+    """A crashed child is restarted within the backoff schedule, keeping
+    its ChipLedger hold; the crash-loop budget then exhausts and the chips
+    release."""
+    from llm_d_fast_model_actuation_tpu.launcher.instance import InstanceConfig
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        RestartPolicy,
+    )
+
+    chips = translator.chip_ids()[:2]
+    m = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=_fake_kickoff,
+        restart_policy=RestartPolicy(
+            budget=2, backoff_s=0.05, backoff_max_s=0.2, jitter_frac=0.0
+        ),
+    )
+    try:
+        m.create_instance(
+            InstanceConfig(options="--model tiny", chip_ids=chips), "s1"
+        )
+        inst = m.instances["s1"]
+
+        def crash_and_report():
+            pid = inst.process.pid
+            os.kill(pid, signal.SIGKILL)
+            inst.process.join(timeout=10)
+            m._on_instance_stopped("s1", inst.process.exitcode)
+            return pid
+
+        def wait_restarted(count, timeout=10):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                n = sum(
+                    1 for _, e in m.broadcaster._buf
+                    if e["type"] == "RESTARTED"
+                )
+                if n >= count:
+                    return
+                time.sleep(0.02)
+            raise AssertionError(f"RESTARTED #{count} never published")
+
+        held_before = m.ledger.holders()["s1"]
+        pid1 = crash_and_report()
+        # the hold survives the crash window (chips stay earmarked)
+        assert m.ledger.holders()["s1"] == held_before
+        wait_restarted(1)
+        assert inst.process.is_alive() and inst.process.pid != pid1
+        assert m.ledger.holders()["s1"] == held_before
+        assert m.ledger.models().get("s1") == "tiny"
+
+        types = [e["type"] for _, e in m.broadcaster._buf]
+        assert types == ["CREATED", "STOPPED", "RESTARTING", "RESTARTED"]
+        restarting = next(
+            e for _, e in m.broadcaster._buf if e["type"] == "RESTARTING"
+        )["object"]
+        assert restarting["restart_attempt"] == 1
+        assert restarting["restart_budget"] == 2
+        assert restarting["backoff_s"] >= 0.05
+
+        # second crash: budget 2 allows one more restart, with a LONGER
+        # backoff (exponential)
+        pid2 = crash_and_report()
+        wait_restarted(2)
+        assert inst.process.is_alive() and inst.process.pid != pid2
+        r2 = [
+            e["object"] for _, e in m.broadcaster._buf
+            if e["type"] == "RESTARTING"
+        ][-1]
+        assert r2["restart_attempt"] == 2 and r2["backoff_s"] >= 0.1
+
+        # third crash: budget exhausted -> stays stopped, chips released
+        crash_and_report()
+        time.sleep(0.5)
+        assert inst.process is None or not inst.process.is_alive()
+        assert "s1" not in m.ledger.holders()
+        types = [e["type"] for _, e in m.broadcaster._buf]
+        assert types.count("RESTARTED") == 2
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
+@pytest.mark.faults
+def test_restart_spawn_failure_consumes_budget(translator, tmp_path):
+    """instance.spawn armed fail-once: the first restart attempt dies in
+    the spawn, is counted against the budget, and the next scheduled
+    attempt succeeds."""
+    from llm_d_fast_model_actuation_tpu.launcher.instance import InstanceConfig
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        RestartPolicy,
+    )
+
+    m = EngineProcessManager(
+        translator,
+        log_dir=str(tmp_path),
+        kickoff=_fake_kickoff,
+        restart_policy=RestartPolicy(
+            budget=3, backoff_s=0.05, backoff_max_s=0.2, jitter_frac=0.0
+        ),
+    )
+    try:
+        m.create_instance(InstanceConfig(options="--model tiny"), "f1")
+        inst = m.instances["f1"]
+        pid = inst.process.pid
+        faults.arm("instance.spawn", mode="fail", count=1)
+        os.kill(pid, signal.SIGKILL)
+        inst.process.join(timeout=10)
+        m._on_instance_stopped("f1", inst.process.exitcode)
+        deadline = time.monotonic() + 10
+        restarted = []
+        while time.monotonic() < deadline:
+            restarted = [
+                e["object"] for _, e in m.broadcaster._buf
+                if e["type"] == "RESTARTED"
+            ]
+            if restarted:
+                break
+            time.sleep(0.02)
+        assert restarted, "restart after spawn failure never happened"
+        assert restarted[-1]["restart_attempt"] == 2
+        assert inst.process.is_alive() and inst.process.pid != pid
+    finally:
+        m.stop_all_instances(timeout=2)
+
+
+# -- notifier: reconnect backoff ----------------------------------------------
+
+
+@pytest.mark.faults
+def test_notifier_reconnect_backoff_growth_cap_and_reset():
+    from llm_d_fast_model_actuation_tpu.launcher.notifier import (
+        InstanceStateNotifier,
+    )
+
+    async def lister():
+        return []
+
+    async def patch(sig):
+        return None
+
+    n = InstanceStateNotifier(
+        lister, patch, reconnect_backoff_s=0.5, reconnect_backoff_max_s=8.0
+    )
+    # delay is exponential in consecutive failures, jittered into [d/2, d]
+    for failures, base in ((1, 0.5), (2, 1.0), (3, 2.0), (4, 4.0)):
+        n._consecutive_failures = failures
+        for _ in range(16):
+            d = n._reconnect_delay()
+            assert base * 0.5 <= d <= base
+    # the configured ceiling is a HARD cap, jitter included
+    n._consecutive_failures = 50
+    for _ in range(16):
+        assert n._reconnect_delay() <= 8.0
+
+
+@pytest.mark.faults
+def test_notifier_backs_off_on_connect_failure_and_resets():
+    from llm_d_fast_model_actuation_tpu.launcher.notifier import (
+        InstanceStateNotifier,
+    )
+
+    sleeps = []
+
+    async def scenario():
+        states = [{"instance_id": "a", "status": "running"}]
+        connects = [0]
+
+        async def lister():
+            return states
+
+        async def patch(sig):
+            return None
+
+        async def watcher(since):
+            connects[0] += 1
+            if connects[0] <= 3:
+                raise ConnectionRefusedError("launcher down")
+
+            async def gen():
+                n.stop()
+                if False:
+                    yield None
+
+            return gen()
+
+        n = InstanceStateNotifier(
+            lister, patch, watcher=watcher,
+            poll_interval_s=0.0, reconnect_backoff_s=0.1,
+            reconnect_backoff_max_s=2.0,
+        )
+
+        real_sleep = asyncio.sleep
+
+        async def spy_sleep(d):
+            sleeps.append(d)
+            await real_sleep(0)
+
+        import llm_d_fast_model_actuation_tpu.launcher.notifier as nmod
+
+        orig = nmod.asyncio.sleep
+        nmod.asyncio.sleep = spy_sleep
+        try:
+            await asyncio.wait_for(n.run(), timeout=10)
+        finally:
+            nmod.asyncio.sleep = orig
+        return n
+
+    n = asyncio.run(scenario())
+    assert len(sleeps) == 3  # one backoff per failed connect
+    # exponential: each delay window doubles (jitter within [d/2, d])
+    assert 0.05 <= sleeps[0] <= 0.1
+    assert 0.1 <= sleeps[1] <= 0.2
+    assert 0.2 <= sleeps[2] <= 0.4
+    assert n._consecutive_failures == 0  # successful connect reset it
+
+
+# -- e2e: SIGKILL a launcher-managed engine child -----------------------------
+
+
+@pytest.mark.e2e
+@pytest.mark.faults
+def test_crash_restart_e2e(tmp_path):
+    """SIGKILL a real launcher-managed engine child mid-serve: the
+    supervisor restarts it within the backoff schedule, serving its
+    last-SWAPPED model (engine-truth rewritten options), and the budget
+    bounds the crash loop."""
+    import requests
+
+    from conftest import cpu_subprocess_env, free_port
+
+    launcher_port, engine_port = free_port(), free_port()
+    env = cpu_subprocess_env()
+    log_dir = str(tmp_path)
+    with open(os.path.join(log_dir, "launcher-stdout.log"), "wb") as out:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "llm_d_fast_model_actuation_tpu.launcher.main",
+                "--mock-chips", "--mock-chip-count", "2",
+                "--mock-topology", "1x2",
+                "--host", "127.0.0.1", "--port", str(launcher_port),
+                "--log-dir", log_dir,
+                "--restart-budget", "2",
+                "--restart-backoff", "0.2",
+                "--restart-backoff-max", "1.0",
+                # a recovered child must count as a crash LOOP across this
+                # short test, not earn its budget back between kills
+                "--restart-reset-window", "600",
+            ],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+        )
+    base = f"http://127.0.0.1:{launcher_port}"
+    engine = f"http://127.0.0.1:{engine_port}"
+
+    def wait_for(pred, timeout=90, what=""):
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                got = pred()
+                if got:
+                    return got
+                last = got
+            except Exception as e:  # noqa: BLE001 — booting
+                last = e
+            time.sleep(0.25)
+        raise TimeoutError(f"{what or 'condition'} never held: {last!r}")
+
+    try:
+        wait_for(
+            lambda: requests.get(base + "/health", timeout=2).status_code
+            == 200,
+            what="launcher health",
+        )
+        options = (
+            f"--model tiny --port {engine_port} --num-pages 32 "
+            f"--max-batch 2 --page-size 8 --max-model-len 64 "
+            f"--swap-bucket-mib 1"
+        )
+        r = requests.put(
+            base + "/v2/vllm/instances/cr1",
+            json={
+                "options": options,
+                "env_vars": {"JAX_PLATFORMS": "cpu"},
+            },
+            timeout=30,
+        )
+        assert r.status_code == 201, r.text
+        wait_for(
+            lambda: requests.get(engine + "/health", timeout=2).status_code
+            == 200,
+            what="engine health",
+        )
+
+        # hot-swap so the REWRITTEN options (engine truth) differ from the
+        # created ones — the restart must serve the swapped model
+        r = requests.post(
+            base + "/v2/vllm/instances/cr1/swap",
+            json={"model": "tiny-gemma"},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+
+        def served_model():
+            resp = requests.get(engine + "/v1/models", timeout=2)
+            return resp.json()["data"][0]["id"]
+
+        assert served_model() == "tiny-gemma"
+
+        def status():
+            return requests.get(
+                base + "/v2/vllm/instances/cr1", timeout=5
+            ).json()
+
+        for kill_round in range(2):  # budget is 2: both kills recover
+            pid = status()["pid"]
+            assert isinstance(pid, int)
+            os.kill(pid, signal.SIGKILL)
+            wait_for(
+                lambda: status()["pid"] not in (None, pid)
+                and status()["status"] == "running",
+                what=f"supervised restart {kill_round + 1}",
+            )
+            wait_for(
+                lambda: requests.get(
+                    engine + "/health", timeout=2
+                ).status_code == 200,
+                what="restarted engine health",
+            )
+            # the restarted child rebuilt from the rewritten options:
+            # it serves the last-swapped model, not the created one
+            assert served_model() == "tiny-gemma"
+            assert "--model tiny-gemma" in status()["options"]
+
+        # third kill: budget exhausted -> stays stopped
+        pid = status()["pid"]
+        os.kill(pid, signal.SIGKILL)
+        wait_for(
+            lambda: status()["status"] == "stopped",
+            what="budget-exhausted stop",
+        )
+        time.sleep(3.0)  # past any backoff: still down
+        assert status()["status"] == "stopped"
+
+        # the event stream recorded the supervision lifecycle
+        resp = requests.get(
+            base + "/v2/vllm/instances/watch",
+            params={"since": "0"}, stream=True, timeout=10,
+        )
+        types = []
+        for line in resp.iter_lines():
+            if line:
+                types.append(json.loads(line)["type"])
+            if types.count("STOPPED") >= 3:
+                break
+        resp.close()
+        assert types.count("RESTARTING") == 2
+        assert types.count("RESTARTED") == 2
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
