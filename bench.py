@@ -35,6 +35,49 @@ import time
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
+def _trace_out_path() -> str:
+    """``--trace-out PATH`` (or ``--trace-out=PATH``): write the captured
+    span timeline as Chrome trace-event JSON (Perfetto-loadable) next to
+    the BENCH json line, and fold per-phase durations into the result."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--trace-out" and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith("--trace-out="):
+            return a.split("=", 1)[1]
+    return ""
+
+
+def _emit_trace(trace_out: str, result: dict) -> None:
+    """Child-side epilogue for --trace-out: dump the span ring buffer
+    (utils/tracing.py) and record per-span-name duration aggregates in the
+    bench result, so a phase regression localizes without re-running.
+
+    Never fatal: a bad artifact path must not discard a completed
+    (potentially minutes-long TPU) measurement — the error is recorded in
+    the result instead."""
+    from llm_d_fast_model_actuation_tpu.utils import tracing
+
+    spans = tracing.snapshot()
+    try:
+        parent = os.path.dirname(os.path.abspath(trace_out))
+        os.makedirs(parent, exist_ok=True)
+        with open(trace_out, "w") as f:
+            json.dump(tracing.export_chrome(spans), f)
+    except OSError as e:
+        print(f"--trace-out write failed: {e}", file=sys.stderr)
+        result.setdefault("extra", {})["trace_error"] = str(e)
+        return
+    phases: dict = {}
+    for s in spans:
+        agg = phases.setdefault(s.name, {"count": 0, "total_s": 0.0})
+        agg["count"] += 1
+        agg["total_s"] = round(agg["total_s"] + s.duration_s, 6)
+    result.setdefault("extra", {})["trace_phases"] = phases
+    result["extra"]["trace_out"] = trace_out
+    result["extra"]["trace_spans"] = len(spans)
+
+
 def _measure() -> None:
     """Child entry: init jax, run the full measurement, print the JSON line."""
     import jax
@@ -401,6 +444,8 @@ def _measure() -> None:
             "tunnel_d2h_gibps": round(d2h_gibps, 3),
         },
     }
+    if _trace_out_path():
+        _emit_trace(_trace_out_path(), result)
     print(json.dumps(result))
 
 
@@ -538,6 +583,8 @@ def _measure_coldload() -> None:
             "pairs_measured": len(pairs),
         },
     }
+    if _trace_out_path():
+        _emit_trace(_trace_out_path(), result)
     print(json.dumps(result))
 
 
@@ -635,6 +682,8 @@ def _measure_swap_recovery() -> None:
             "restart_baseline_s": round(restart_baseline_s, 4),
         },
     }
+    if _trace_out_path():
+        _emit_trace(_trace_out_path(), result)
     print(json.dumps(result))
 
 
@@ -646,6 +695,9 @@ def _run_child(
     argv = [sys.executable, os.path.abspath(__file__)]
     if sub:
         argv.append(sub)
+    trace_out = _trace_out_path()
+    if trace_out:
+        argv += ["--trace-out", trace_out]
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )
@@ -675,6 +727,11 @@ def main() -> int:
         (s for s in ("coldload", "swap") if s in sys.argv[1:]), ""
     )
     if "--child" in sys.argv:
+        if _trace_out_path():
+            # --trace-out implies capture, even if the env disabled it
+            from llm_d_fast_model_actuation_tpu.utils import tracing
+
+            tracing.enable()
         if sub == "coldload":
             _measure_coldload()
         elif sub == "swap":

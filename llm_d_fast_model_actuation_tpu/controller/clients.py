@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional, Protocol
 import aiohttp
 
 from ..api import constants as C
+from ..utils import tracing
 from .metrics import HTTP_LATENCY
 
 
@@ -92,15 +93,29 @@ class _Http:
         self, method: str, url: str, purpose: str, json_body=None
     ):
         s = await self.session()
-        t0 = time.monotonic()
-        try:
-            async with s.request(method, url, json=json_body) as resp:
-                body = await resp.read()
-                return resp.status, body
-        finally:
-            HTTP_LATENCY.labels(purpose=purpose, method=method).observe(
-                time.monotonic() - t0
-            )
+        # One span per controller-originated call (same single-choke-point
+        # discipline as fma_http_latency_seconds), propagated downstream
+        # as a W3C traceparent so the launcher / engine / SPI side of the
+        # hop joins the same trace (docs/tracing.md).
+        with tracing.span(
+            "controller.http", purpose=purpose, method=method
+        ) as sp:
+            headers = {}
+            tp = sp.traceparent()
+            if tp:
+                headers["traceparent"] = tp
+            t0 = time.monotonic()
+            try:
+                async with s.request(
+                    method, url, json=json_body, headers=headers
+                ) as resp:
+                    body = await resp.read()
+                    sp.set(status=resp.status)
+                    return resp.status, body
+            finally:
+                HTTP_LATENCY.labels(purpose=purpose, method=method).observe(
+                    time.monotonic() - t0
+                )
 
 
 class HttpLauncherHandle:

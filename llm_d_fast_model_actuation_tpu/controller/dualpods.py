@@ -37,6 +37,7 @@ from typing import Any, Awaitable, Callable, Dict, List, Optional, Set, Tuple
 
 from ..api import constants as C
 from ..api.types import EngineServerConfig, InferenceServerConfig, LauncherConfig
+from ..utils import tracing
 from ..utils.hashing import canonical_json, instance_id_for, sha256_hex, template_hash
 from . import metrics as M
 from .clients import InstanceNotFound, Transports
@@ -980,6 +981,30 @@ class DualPodsController:
         isc_name: str,
         sd: ServerData,
     ) -> None:
+        """Traced entry: every HTTP call inside (launcher REST, engine
+        admin, SPI relay — all through clients.py) becomes a child span
+        and carries the traceparent downstream, so one reconcile pass of
+        one actuation is one coherent trace (docs/tracing.md)."""
+        with tracing.span(
+            "controller.reconcile_bound",
+            requester=req["metadata"]["name"],
+            provider=provider["metadata"]["name"],
+            isc=isc_name,
+            path=sd.path or "",
+        ):
+            await self._reconcile_bound_impl(
+                ns, req, provider, isc, isc_name, sd
+            )
+
+    async def _reconcile_bound_impl(
+        self,
+        ns: str,
+        req: Dict[str, Any],
+        provider: Dict[str, Any],
+        isc: InferenceServerConfig,
+        isc_name: str,
+        sd: ServerData,
+    ) -> None:
         pname = provider["metadata"]["name"]
         self.recover_instance_state(provider, sd)
         handle = self.transports.launcher(provider)
@@ -1276,6 +1301,22 @@ class DualPodsController:
                 sleepers = [p for p in sleepers if p["metadata"]["name"] != vname]
 
     async def _reconcile_bound_direct(
+        self,
+        ns: str,
+        req: Dict[str, Any],
+        provider: Dict[str, Any],
+        sd: ServerData,
+    ) -> None:
+        with tracing.span(
+            "controller.reconcile_bound",
+            requester=req["metadata"]["name"],
+            provider=provider["metadata"]["name"],
+            isc="direct",
+            path=sd.path or "",
+        ):
+            await self._reconcile_bound_direct_impl(ns, req, provider, sd)
+
+    async def _reconcile_bound_direct_impl(
         self,
         ns: str,
         req: Dict[str, Any],

@@ -45,7 +45,7 @@ from prometheus_client import Counter, Gauge, Histogram
 
 from ..models import llama
 from ..models.moe import MoeConfig
-from ..utils import faults
+from ..utils import faults, tracing
 from .engine import EngineConfig, InferenceEngine
 from .model_pool import HostModelPool
 from .sleep import (
@@ -468,6 +468,11 @@ def parse_engine_options(options: str) -> argparse.Namespace:
     return args
 
 
+class ProfileConflict(Exception):
+    """POST /v1/profile while a capture is running (jax.profiler is
+    process-global: exactly one concurrent capture), or DELETE with none."""
+
+
 def _pool_key(model: str, checkpoint_dir: str) -> str:
     """Identity of a pooled model: the same model name restored from a
     different checkpoint is a different set of weights."""
@@ -611,11 +616,24 @@ class EngineService:
         self._prefetch_thread: Optional[threading.Thread] = None
         self._prefetch_abort = threading.Event()
         self.last_prefetch: Dict[str, Any] = {"state": "idle"}
-        self._install_runtime(
-            self._build_runtime(
-                args.model, getattr(args, "checkpoint_dir", "") or ""
+        # On-demand deep profiling (POST/DELETE /v1/profile): one
+        # concurrent jax.profiler capture per process.
+        self._profile_mu = threading.Lock()
+        self._profile_dir: Optional[str] = None
+        # The startup span parents on FMA_TRACEPARENT when the spawning
+        # launcher stamped one (utils/tracing.py): the child's initial
+        # build joins the create-instance trace across the fork.
+        with tracing.span(
+            "engine.start",
+            parent=tracing.env_context(),
+            model=args.model,
+            pid=os.getpid(),
+        ):
+            self._install_runtime(
+                self._build_runtime(
+                    args.model, getattr(args, "checkpoint_dir", "") or ""
+                )
             )
-        )
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
         mode = getattr(args, "sleep_release_devices", "auto")
@@ -691,6 +709,25 @@ class EngineService:
     # -- model runtimes (build / install / hot-swap) -------------------------
 
     def _build_runtime(
+        self,
+        model_id: str,
+        checkpoint_dir: str = "",
+        staged_params: Optional[Dict[str, Any]] = None,
+    ) -> _ModelRuntime:
+        """Traced wrapper around the cold build: the `with` form ends the
+        span (stamping the error) even when the build raises — the
+        cold-swap failure path must not leak an open span."""
+        with tracing.span(
+            "engine.build_runtime",
+            model=model_id,
+            checkpoint_dir=checkpoint_dir,
+            staged=staged_params is not None,
+        ):
+            return self._build_runtime_impl(
+                model_id, checkpoint_dir, staged_params
+            )
+
+    def _build_runtime_impl(
         self,
         model_id: str,
         checkpoint_dir: str = "",
@@ -880,6 +917,26 @@ class EngineService:
         return self._runtime
 
     def swap(
+        self, model: str, checkpoint_dir: str = "", request_id: str = ""
+    ) -> Dict[str, Any]:
+        """Traced entry for the hot-swap verb: the span adopts whatever
+        context the caller established (the HTTP handler's remote
+        ``traceparent``), so the engine-side swap tree hangs off the
+        launcher's RPC span in one coherent trace."""
+        with tracing.span(
+            "engine.swap",
+            model=model,
+            previous=self.args.model,
+            request_id=request_id,
+        ) as sp:
+            out = self._swap_impl(model, checkpoint_dir, request_id)
+            sp.set(
+                pool_hit=bool(out.get("pool_hit")),
+                swapped=bool(out.get("swapped")),
+            )
+            return out
+
+    def _swap_impl(
         self, model: str, checkpoint_dir: str = "", request_id: str = ""
     ) -> Dict[str, Any]:
         """Hot-swap the model this chip serves (POST /v1/swap): stream the
@@ -1229,6 +1286,9 @@ class EngineService:
                 args=(
                     model, hf_dir, checkpoint_dir, model_cfg,
                     self._prefetch_abort,
+                    # the caller's span context, captured HERE: ContextVars
+                    # do not cross into the staging thread on their own
+                    tracing.current_context(),
                 ),
                 daemon=True,
                 name="prefetch",
@@ -1237,13 +1297,16 @@ class EngineService:
         return dict(self.last_prefetch, started=True)
 
     def _prefetch_worker(
-        self, model, hf_dir, checkpoint_dir, model_cfg, abort
+        self, model, hf_dir, checkpoint_dir, model_cfg, abort, trace_ctx=None
     ) -> None:
         """Prefetch thread body: host-only staging (load_params with
         place=False — pure file I/O + numpy, no device/HBM touch), then
         registration in the pool under the swap's key."""
         from ..models import hf as hf_models
 
+        worker_sp = tracing.begin(
+            "engine.prefetch", parent=trace_ctx, model=model
+        )
         t0 = time.monotonic()
         lstats = hf_models.LoadStats()
         try:
@@ -1267,6 +1330,8 @@ class EngineService:
                 "checkpoint_dir": checkpoint_dir,
                 "bytes": lstats.bytes_read,
             }
+            worker_sp.set(state="aborted")
+            worker_sp.end()
             return
         except Exception as e:  # noqa: BLE001 — surfaced via GET /v1/prefetch
             logger.warning("prefetch of %s failed", model, exc_info=True)
@@ -1277,6 +1342,8 @@ class EngineService:
                 "checkpoint_dir": checkpoint_dir,
                 "error": f"{type(e).__name__}: {e}",
             }
+            worker_sp.set(state="failed", error=f"{type(e).__name__}: {e}")
+            worker_sp.end()
             return
         import jax
 
@@ -1303,6 +1370,8 @@ class EngineService:
                 "bytes": nbytes,
                 "error": "staged bytes exceed the model pool budget",
             }
+            worker_sp.set(state="rejected")
+            worker_sp.end()
             return
         ENGINE_PREFETCHES.labels(outcome="completed").inc()
         ENGINE_PREFETCH_BYTES.set(nbytes)
@@ -1317,6 +1386,8 @@ class EngineService:
             "workers": lstats.workers,
             "pool": self.model_pool.describe(),
         }
+        worker_sp.set(state="completed", bytes=nbytes)
+        worker_sp.end()
         logger.info(
             "prefetched %s host-resident (%.1f MiB in %.3fs)",
             model, nbytes / 2**20, time.monotonic() - t0,
@@ -1339,6 +1410,54 @@ class EngineService:
             self._prefetch_abort.set()
         t.join(timeout=60)
         return {"aborted": True, **self.last_prefetch}
+
+    # -- on-demand deep profiling (POST/DELETE /v1/profile) -------------------
+
+    def start_profile(self, log_dir: str = "") -> Dict[str, Any]:
+        """Start a jax.profiler capture (XLA device + host activity,
+        viewable in Perfetto / TensorBoard) — the "why is THIS phase slow"
+        microscope the span timeline points at. Gated to one concurrent
+        capture: the profiler is process-global state."""
+        import jax
+
+        with self._profile_mu:
+            if self._profile_dir is not None:
+                raise ProfileConflict(
+                    f"a profile capture is already running "
+                    f"(log_dir={self._profile_dir}); DELETE /v1/profile "
+                    "stops it"
+                )
+            log_dir = log_dir or os.path.join(
+                "/tmp", f"fma-profile-{os.getpid()}-{int(time.time())}"
+            )
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+            self._profile_dir = log_dir
+        logger.info("jax profiler capture started -> %s", log_dir)
+        return {"profiling": True, "log_dir": log_dir}
+
+    def stop_profile(self) -> Dict[str, Any]:
+        import jax
+
+        with self._profile_mu:
+            if self._profile_dir is None:
+                raise ProfileConflict("no profile capture is running")
+            # stop FIRST, clear state only on success: a raising
+            # stop_trace (deleted log_dir, export error) must leave the
+            # capture marked running so a retried DELETE can reach the
+            # still-active process-global profiler — clearing first would
+            # wedge the API (409 forever, start_trace 500s) until restart
+            jax.profiler.stop_trace()
+            log_dir, self._profile_dir = self._profile_dir, None
+        logger.info("jax profiler capture stopped (%s)", log_dir)
+        return {"profiling": False, "log_dir": log_dir}
+
+    def profile_status(self) -> Dict[str, Any]:
+        with self._profile_mu:
+            return {
+                "profiling": self._profile_dir is not None,
+                "log_dir": self._profile_dir or "",
+            }
 
     def _make_publisher(self):
         chip_ids = [c for c in os.environ.get("FMA_CHIP_IDS", "").split(",") if c]
@@ -1541,6 +1660,12 @@ class EngineService:
         self._new_work.set()
 
     def sleep(self, level: int) -> Dict[str, Any]:
+        with tracing.span(
+            "engine.sleep", level=level, model=self.args.model
+        ):
+            return self._sleep_impl(level)
+
+    def _sleep_impl(self, level: int) -> Dict[str, Any]:
         if self.is_follower:
             # a follower can't unilaterally leave the collective loop; the
             # leader's broadcast sleeps the whole gang
@@ -1576,6 +1701,10 @@ class EngineService:
         return out
 
     def wake_up(self) -> Dict[str, Any]:
+        with tracing.span("engine.wake", model=self.args.model):
+            return self._wake_up_impl()
+
+    def _wake_up_impl(self) -> Dict[str, Any]:
         if self.is_follower:
             return {
                 "deferred": True,
@@ -1781,20 +1910,23 @@ def build_app(service: EngineService) -> web.Application:
             }
         )
 
+    def _traced_call(request: web.Request, fn):
+        """Blocking admin call on the executor, with the caller's remote
+        ``traceparent`` (if any) as the current context inside it."""
+        return tracing.run_traced(
+            asyncio.get_running_loop(), request.headers, fn
+        )
+
     async def sleep(request: web.Request) -> web.Response:
         level = int(request.query.get("level", "1"))
         try:
-            info = await asyncio.get_running_loop().run_in_executor(
-                None, service.sleep, level
-            )
+            info = await _traced_call(request, lambda: service.sleep(level))
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         return web.json_response(info)
 
     async def wake_up(request: web.Request) -> web.Response:
-        info = await asyncio.get_running_loop().run_in_executor(
-            None, service.wake_up
-        )
+        info = await _traced_call(request, service.wake_up)
         return web.json_response(info)
 
     async def swap(request: web.Request) -> web.Response:
@@ -1812,8 +1944,8 @@ def build_app(service: EngineService) -> web.Application:
         if not isinstance(rid, str):
             raise web.HTTPBadRequest(text="request_id must be a string")
         try:
-            info = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: service.swap(model, ckpt, request_id=rid)
+            info = await _traced_call(
+                request, lambda: service.swap(model, ckpt, request_id=rid)
             )
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -1849,8 +1981,8 @@ def build_app(service: EngineService) -> web.Application:
         if not isinstance(ckpt, str):
             raise web.HTTPBadRequest(text="checkpoint_dir must be a string")
         try:
-            info = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: service.prefetch(model, ckpt)
+            info = await _traced_call(
+                request, lambda: service.prefetch(model, ckpt)
             )
         except (ValueError, FileNotFoundError) as e:
             raise web.HTTPBadRequest(text=str(e))
@@ -2507,6 +2639,52 @@ def build_app(service: EngineService) -> web.Application:
         faults.reset()
         return web.json_response(faults.describe())
 
+    async def traces(request: web.Request) -> web.Response:
+        """Export this process's span ring buffer: Chrome trace-event JSON
+        (Perfetto-loadable, the default) or ``?format=tree`` (human);
+        ``?trace_id=`` filters to one trace, ``?clear=1`` drains after
+        export (docs/tracing.md)."""
+        status, body, ctype = tracing.export_http(
+            request.query.get("format", "chrome"),
+            trace_id=request.query.get("trace_id") or None,
+            clear=request.query.get("clear") in ("1", "true"),
+        )
+        return web.Response(status=status, text=body, content_type=ctype)
+
+    async def profile_start(request: web.Request) -> web.Response:
+        log_dir = ""
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                raise web.HTTPBadRequest(text="invalid JSON body")
+            log_dir = body.get("log_dir") or ""
+            if not isinstance(log_dir, str):
+                raise web.HTTPBadRequest(text="log_dir must be a string")
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: service.start_profile(log_dir)
+            )
+        except ProfileConflict as e:
+            raise web.HTTPConflict(text=str(e))
+        except Exception as e:  # noqa: BLE001 — profiler backend failures
+            raise web.HTTPInternalServerError(text=f"start_trace: {e}")
+        return web.json_response(info)
+
+    async def profile_stop(request: web.Request) -> web.Response:
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, service.stop_profile
+            )
+        except ProfileConflict as e:
+            raise web.HTTPConflict(text=str(e))
+        except Exception as e:  # noqa: BLE001 — profiler backend failures
+            raise web.HTTPInternalServerError(text=f"stop_trace: {e}")
+        return web.json_response(info)
+
+    async def profile_status(request: web.Request) -> web.Response:
+        return web.json_response(service.profile_status())
+
     app.router.add_post("/v1/swap", swap)
     app.router.add_get("/v1/swap", last_swap)
     app.router.add_get("/v1/faults", faults_get)
@@ -2515,6 +2693,10 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_post("/v1/prefetch", prefetch)
     app.router.add_get("/v1/prefetch", prefetch_status)
     app.router.add_delete("/v1/prefetch", prefetch_abort)
+    app.router.add_get("/v1/traces", traces)
+    app.router.add_post("/v1/profile", profile_start)
+    app.router.add_delete("/v1/profile", profile_stop)
+    app.router.add_get("/v1/profile", profile_status)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)

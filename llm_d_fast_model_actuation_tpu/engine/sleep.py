@@ -50,7 +50,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-from ..utils import faults
+from ..utils import faults, tracing
 from .device import (
     rebuild_spec,
     reacquire_devices,
@@ -199,29 +199,55 @@ class SleepManager:
         buckets = partition_buckets(
             [x.nbytes for x in leaves], self.bucket_bytes
         )
+        # tracing hoisted out of the bucket loop: disabled = zero per-chunk
+        # allocations on this hot path (utils/tracing.py)
+        traced = tracing.enabled()
+        parent = tracing.current_context() if traced else None
         for bucket in buckets:
-            if to_numpy:
-                # force materialized copies: device_get can return views
-                # aliasing the device buffer on CPU-family backends, and a
-                # staging buffer must survive the buffer delete below (and
-                # client destruction on the release path) on its own
-                copies = [
-                    np.array(h, copy=True)
-                    for h in jax.device_get([leaves[i] for i in bucket])
-                ]
-            else:
-                copies = jax.device_put(
-                    [leaves[i] for i in bucket],
-                    [
-                        leaves[i].sharding.with_memory_kind("pinned_host")
-                        for i in bucket
-                    ],
+            sp = None
+            if traced:
+                sp = tracing.begin(
+                    "sleep.d2h", parent=parent, activate=False,
+                    bytes=sum(leaves[i].nbytes for i in bucket),
+                    leaves=len(bucket),
                 )
-                copies = jax.block_until_ready(copies)
+            try:
+                if to_numpy:
+                    # force materialized copies: device_get can return
+                    # views aliasing the device buffer on CPU-family
+                    # backends, and a staging buffer must survive the
+                    # buffer delete below (and client destruction on the
+                    # release path) on its own
+                    copies = [
+                        np.array(h, copy=True)
+                        for h in jax.device_get(
+                            [leaves[i] for i in bucket]
+                        )
+                    ]
+                else:
+                    copies = jax.device_put(
+                        [leaves[i] for i in bucket],
+                        [
+                            leaves[i].sharding.with_memory_kind(
+                                "pinned_host"
+                            )
+                            for i in bucket
+                        ],
+                    )
+                    copies = jax.block_until_ready(copies)
+            except BaseException as e:
+                # the failing bucket is what a failed-sleep trace must
+                # show (same discipline as the swap/coldload paths)
+                if sp is not None:
+                    sp.set(error=f"{type(e).__name__}: {e}")
+                    sp.end()
+                raise
             for i, h in zip(bucket, copies):
                 host[i] = h
             for i in bucket:
                 leaves[i].delete()
+            if sp is not None:
+                sp.end()
         return host
 
     def _restore_leaves(
@@ -234,16 +260,34 @@ class SleepManager:
         buckets = partition_buckets(
             [x.nbytes for x in leaves], self.bucket_bytes
         )
+        traced = tracing.enabled()
+        parent = tracing.current_context() if traced else None
         for bucket in buckets:
-            restored = jax.device_put(
-                [leaves[i] for i in bucket], [targets[i] for i in bucket]
-            )
-            restored = jax.block_until_ready(restored)
+            sp = None
+            if traced:
+                sp = tracing.begin(
+                    "wake.h2d", parent=parent, activate=False,
+                    bytes=sum(leaves[i].nbytes for i in bucket),
+                    leaves=len(bucket),
+                )
+            try:
+                restored = jax.device_put(
+                    [leaves[i] for i in bucket],
+                    [targets[i] for i in bucket],
+                )
+                restored = jax.block_until_ready(restored)
+            except BaseException as e:
+                if sp is not None:
+                    sp.set(error=f"{type(e).__name__}: {e}")
+                    sp.end()
+                raise
             for i, d in zip(bucket, restored):
                 out[i] = d
             if free_host:
                 for i in bucket:
                     leaves[i].delete()
+            if sp is not None:
+                sp.end()
         return out
 
     # -- edges ---------------------------------------------------------------
@@ -489,6 +533,14 @@ def swap_states(
         raise ValueError("hot-swap is not supported for multi-host gangs")
     bucket_bytes = bucket_bytes or DEFAULT_SWAP_BUCKET_BYTES
     use_mk = out_mgr._use_memory_kind
+    # Root span for the transfer phase; per-bucket child spans are created
+    # only when tracing is enabled (`traced` hoisted out of the hot loop:
+    # the disabled path adds no per-chunk allocations). activate=False:
+    # begin/end straddle exception paths, and a leaked ContextVar token
+    # would misparent later spans on this (reused executor) thread.
+    root = tracing.begin("swap.transfer", activate=False, overlapped=overlapped)
+    traced = root is not tracing.NOOP_SPAN
+    root_ctx = root.context() if traced else None
     t_begin = time.monotonic()
 
     state_out = out_mgr._get_state()
@@ -522,26 +574,45 @@ def swap_states(
     #: (deferred so a rollback can re-pool the incoming entry intact)
     deferred_in_frees: List[int] = []
 
+    def _fail_span(sp, e) -> None:
+        """Record a bucket span whose transfer raised: the failing bucket
+        is exactly the one a fault-drill trace must show."""
+        if sp is not None:
+            sp.set(error=f"{type(e).__name__}: {e}")
+            sp.end()
+
     def _issue_d2h(k):
         nonlocal in_flight, peak_in_flight
-        faults.fire("swap.d2h")
-        bucket = buckets_out[k]
-        if use_mk:
-            copies = jax.device_put(
-                [leaves_out[i] for i in bucket],
-                [
-                    shard_out[i].with_memory_kind("pinned_host")
-                    for i in bucket
-                ],
+        sp = None
+        if traced:
+            sp = tracing.begin(
+                "swap.d2h", parent=root_ctx, activate=False,
+                bucket=k, bytes=bsize_out[k],
             )
-        else:
-            # real copies (not views of the buffers deleted below), same
-            # as the SleepManager staging path
-            copies = [np.array(leaves_out[i], copy=True) for i in bucket]
+        try:
+            faults.fire("swap.d2h")
+            bucket = buckets_out[k]
+            if use_mk:
+                copies = jax.device_put(
+                    [leaves_out[i] for i in bucket],
+                    [
+                        shard_out[i].with_memory_kind("pinned_host")
+                        for i in bucket
+                    ],
+                )
+            else:
+                # real copies (not views of the buffers deleted below),
+                # same as the SleepManager staging path
+                copies = [
+                    np.array(leaves_out[i], copy=True) for i in bucket
+                ]
+        except BaseException as e:
+            _fail_span(sp, e)
+            raise
         in_flight += bsize_out[k]
         if in_flight > peak_in_flight:
             peak_in_flight = in_flight
-        return k, copies
+        return k, copies, sp
 
     #: threaded (numpy-staging) mode: outgoing buffer deletes are deferred
     #: to the commit phase so the main thread never mutates client buffer
@@ -551,10 +622,14 @@ def swap_states(
 
     def _finish_d2h(pending):
         nonlocal in_flight
-        k, copies = pending
+        k, copies, sp = pending
         bucket = buckets_out[k]
         if use_mk:
-            copies = jax.block_until_ready(copies)
+            try:
+                copies = jax.block_until_ready(copies)
+            except BaseException as e:
+                _fail_span(sp, e)
+                raise
         for i, h in zip(bucket, copies):
             host_out[i] = h
         if h2d_pool is None:
@@ -564,6 +639,8 @@ def swap_states(
         else:
             deferred_deletes.extend(bucket)
         in_flight -= bsize_out[k]
+        if sp is not None:
+            sp.end()
 
     # The incoming direction: async transfer dispatch where the backend
     # has it (memory kinds); a single worker thread where transfers are
@@ -594,25 +671,39 @@ def swap_states(
 
     def _issue_h2d(j):
         nonlocal in_flight, peak_in_flight, h2d_t0
-        faults.fire("swap.h2d")
-        if h2d_t0 is None:
-            h2d_t0 = time.monotonic()
-        if h2d_pool is not None:
-            restored = h2d_pool.submit(_h2d_transfer, j)
-        else:
-            restored = _h2d_transfer(j)
+        sp = None
+        if traced:
+            sp = tracing.begin(
+                "swap.h2d", parent=root_ctx, activate=False,
+                bucket=j, bytes=bsize_in[j],
+            )
+        try:
+            faults.fire("swap.h2d")
+            if h2d_t0 is None:
+                h2d_t0 = time.monotonic()
+            if h2d_pool is not None:
+                restored = h2d_pool.submit(_h2d_transfer, j)
+            else:
+                restored = _h2d_transfer(j)
+        except BaseException as e:
+            _fail_span(sp, e)
+            raise
         in_flight += bsize_in[j]
         if in_flight > peak_in_flight:
             peak_in_flight = in_flight
-        return j, restored
+        return j, restored, sp
 
     def _finish_h2d(pending):
         nonlocal in_flight
-        j, restored = pending
+        j, restored, sp = pending
         bucket = buckets_in[j]
-        if h2d_pool is not None:
-            restored = restored.result()
-        restored = jax.block_until_ready(restored)
+        try:
+            if h2d_pool is not None:
+                restored = restored.result()
+            restored = jax.block_until_ready(restored)
+        except BaseException as e:
+            _fail_span(sp, e)
+            raise
         for i, d in zip(bucket, restored):
             dev_in[i] = d
         if use_mk:
@@ -621,6 +712,8 @@ def swap_states(
             # back untouched
             deferred_in_frees.extend(bucket)
         in_flight -= bsize_in[j]
+        if sp is not None:
+            sp.end()
 
     # Double-buffered main loop: while outgoing bucket k drains, incoming
     # bucket k-1 rides the opposite direction into the space k-1 freed.
@@ -638,7 +731,13 @@ def swap_states(
         # quiesce the in-flight incoming transfer first: its device_put
         # must land (or fail) before any buffer it touches is reclaimed
         if pend_h2d is not None:
-            _, restored = pend_h2d
+            _, restored, _sp = pend_h2d
+            if _sp is not None and not _sp.ended:
+                # a span already failed by _finish_h2d keeps its error
+                # attr; a genuinely in-flight one is recorded as cut
+                # short by the rollback
+                _sp.set(error="rolled_back")
+                _sp.end()
             try:
                 if h2d_pool is not None:
                     restored = restored.result()
@@ -652,7 +751,10 @@ def swap_states(
         # (its device leaves are only deleted by _finish_d2h, which did
         # not run for a still-pending bucket)
         if pend_d2h is not None:
-            k, copies = pend_d2h
+            k, copies, _sp = pend_d2h
+            if _sp is not None and not _sp.ended:
+                _sp.set(error="rolled_back")
+                _sp.end()
             try:
                 if use_mk:
                     copies = jax.block_until_ready(copies)
@@ -711,15 +813,26 @@ def swap_states(
             _finish_h2d(pend_h2d)
             pend_h2d = None
     except Exception as exc:
+        rb_sp = tracing.begin(
+            "swap.rollback", parent=root_ctx,
+            error=f"{type(exc).__name__}: {exc}",
+        )
         try:
             _rollback()
         except Exception as rb_exc:
+            rb_sp.set(rollback_failed=True)
+            rb_sp.end()
+            root.set(error="rollback_failed")
+            root.end()
             raise SwapRollbackFailed(
                 f"hot-swap transfer failed "
                 f"({type(exc).__name__}: {exc}) and the rollback failed "
                 f"({type(rb_exc).__name__}: {rb_exc}); device state is "
                 "partially moved"
             ) from rb_exc
+        rb_sp.end()
+        root.set(error="rolled_back")
+        root.end()
         raise SwapRolledBack(
             f"hot-swap transfer failed mid-flight; rolled back "
             f"({type(exc).__name__}: {exc})"
@@ -763,6 +876,15 @@ def swap_states(
     # windows. Positive whenever an h2d was issued before the last d2h
     # completed — i.e. for any >= 2-bucket swap, by construction.
     overlap = max(0.0, min(d2h_t1, h2d_t1) - max(d2h_t0, h2d_t0))
+    root.set(
+        bytes_out=bytes_out,
+        bytes_in=bytes_in,
+        buckets_out=len(buckets_out),
+        buckets_in=len(buckets_in),
+        overlap_frac=round(overlap / total, 6) if total > 0 else 0.0,
+        peak_bytes_in_flight=peak_in_flight,
+    )
+    root.end()
     return {
         "swap_total_s": total,
         "d2h_s": d2h_t1 - d2h_t0,

@@ -20,6 +20,7 @@ import multiprocessing
 import os
 import shlex
 import signal
+import threading
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -28,6 +29,12 @@ from .chiptranslator import ChipTranslator
 logger = logging.getLogger(__name__)
 
 MAX_LOG_RESPONSE_BYTES = 1 << 20  # 1 MiB per ranged-log response
+
+#: serializes the FMA_TRACEPARENT stamp -> fork -> restore window in
+#: start(): os.environ is process-global, and concurrent creates (REST
+#: handlers run manager verbs on the executor pool) would otherwise
+#: cross-wire children's trace parents or leave a stale one behind
+_FORK_ENV_LOCK = threading.Lock()
 
 
 def replace_model_option(
@@ -155,9 +162,26 @@ def engine_kickoff(config: InstanceConfig, log_path: str) -> None:
     for k, v in (config.env_vars or {}).items():
         os.environ[k] = str(v)
     # per-instance FMA_FAULTS must win over (latched) launcher-level state
-    from ..utils import faults
+    from ..utils import faults, tracing
 
     faults.load_env(force=True)
+    # forked-child hygiene: drop the ring-buffer copy inherited from the
+    # launcher and re-read FMA_TRACING/FMA_TRACE_BUFFER (per-instance
+    # env_vars win); FMA_TRACEPARENT stays for the engine.start span
+    tracing.reset_after_fork()
+    # same hygiene for prometheus: the fork duplicated the launcher's
+    # registered fma_launcher_rpc_seconds (frozen at fork time) into this
+    # child's default registry — without this the engine's GET /metrics
+    # would export stale launcher-family samples (docs/metrics.md pins
+    # the family to the launcher port)
+    try:
+        from prometheus_client import REGISTRY
+
+        from .manager import LAUNCHER_RPC_SECONDS
+
+        REGISTRY.unregister(LAUNCHER_RPC_SECONDS)
+    except (ImportError, KeyError):
+        pass
     from ..engine.server import parse_engine_options, run_server
 
     args = parse_engine_options(config.options)
@@ -226,7 +250,26 @@ class EngineInstance:
         self.process = multiprocessing.get_context("fork").Process(
             target=self._kickoff, args=(self.config, self._log_file_path)
         )
-        self.process.start()
+        # Cross-fork trace propagation: stamp the caller's span context
+        # (the launcher's create/restart span) into the env the fork
+        # inherits, so the child's engine.start span joins the trace
+        # (utils/tracing.py; restored right after the fork — the env of a
+        # long-lived launcher must not carry a stale parent).
+        from ..utils import tracing
+
+        tp = tracing.current_traceparent()
+        with _FORK_ENV_LOCK:
+            prev_tp = os.environ.get(tracing.TRACEPARENT_ENV)
+            if tp:
+                os.environ[tracing.TRACEPARENT_ENV] = tp
+            try:
+                self.process.start()
+            finally:
+                if tp:
+                    if prev_tp is None:
+                        os.environ.pop(tracing.TRACEPARENT_ENV, None)
+                    else:
+                        os.environ[tracing.TRACEPARENT_ENV] = prev_tp
         return self._make_state("started")
 
     def stop(self, timeout: float = 10) -> Dict[str, Any]:

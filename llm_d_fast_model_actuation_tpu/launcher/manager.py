@@ -31,12 +31,27 @@ import uuid as uuidlib
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from ..utils import faults
+from prometheus_client import Histogram
+
+from ..utils import faults, tracing
 from ..utils.events import EventBroadcaster
 from .chiptranslator import ChipTranslator
 from .instance import EngineInstance, InstanceConfig
 
 logger = logging.getLogger(__name__)
+
+#: Launcher -> engine-child admin RPC latency (the hop between the
+#: controller-visible fma_http_latency_seconds and the engine's own verb
+#: histograms — without it a slow actuation cannot be attributed to this
+#: leg). One observation per HTTP attempt; `outcome` separates the retry
+#: vocabulary: ok / http_<code> / refused (retried) / timeout /
+#: unreachable. Exposed by the launcher's GET /metrics (docs/metrics.md).
+LAUNCHER_RPC_SECONDS = Histogram(
+    "fma_launcher_rpc_seconds",
+    "Latency of launcher -> engine-child admin RPCs",
+    ["verb", "outcome"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2, 5, 15, 60, 300),
+)
 
 STATUS_STOPPED = "stopped"
 STATUS_RUNNING = "running"
@@ -304,6 +319,17 @@ class EngineProcessManager:
     def create_instance(
         self, config: InstanceConfig, instance_id: Optional[str] = None
     ) -> Dict[str, Any]:
+        """Traced entry: the span is active across the fork, so the child
+        inherits it via FMA_TRACEPARENT (instance.start stamps the env)
+        and its engine.start span joins this trace."""
+        with tracing.span(
+            "launcher.create_instance", instance=instance_id or ""
+        ):
+            return self._create_instance_impl(config, instance_id)
+
+    def _create_instance_impl(
+        self, config: InstanceConfig, instance_id: Optional[str] = None
+    ) -> Dict[str, Any]:
         iid = instance_id or str(uuidlib.uuid4())
         if iid in self.instances:
             raise ValueError(f"instance {iid} already exists")
@@ -473,10 +499,15 @@ class EngineProcessManager:
             if instance.process is not None and instance.process.is_alive():
                 return  # never restart a live child (manual intervention)
             try:
-                faults.fire("instance.spawn")
-                # append to the existing log: the crash forensics above
-                # the restart marker are exactly what the operator needs
-                instance.start(fresh_log=False)
+                with tracing.span(
+                    "launcher.restart",
+                    instance=instance_id,
+                    attempt=attempt,
+                ):
+                    faults.fire("instance.spawn")
+                    # append to the existing log: the crash forensics above
+                    # the restart marker are exactly what the operator needs
+                    instance.start(fresh_log=False)
             except Exception as e:  # noqa: BLE001 — spawn failed: retry
                 logger.warning(
                     "instance %s restart attempt %d failed to spawn: %s",
@@ -547,6 +578,22 @@ class EngineProcessManager:
         return result
 
     def swap_instance(
+        self,
+        instance_id: str,
+        model: str,
+        checkpoint_dir: str = "",
+        timeout: float = 300,
+    ) -> Dict[str, Any]:
+        """Traced entry for the launcher swap verb (the engine-side tree
+        hangs off the launcher.rpc child span via traceparent)."""
+        with tracing.span(
+            "launcher.swap", instance=instance_id, model=model
+        ):
+            return self._swap_instance_impl(
+                instance_id, model, checkpoint_dir, timeout
+            )
+
+    def _swap_instance_impl(
         self,
         instance_id: str,
         model: str,
@@ -708,23 +755,46 @@ class EngineProcessManager:
                 instance_id, 400,
                 f"stored options are not engine options: {e}",
             )
+        verb = f"{method} {api_path}"
+        # The RPC span: the engine-side handler adopts the traceparent we
+        # send, so the child's swap/sleep tree hangs off this span in one
+        # coherent trace across the process boundary (docs/tracing.md).
+        rpc_sp = tracing.begin("launcher.rpc", instance=instance_id, verb=verb)
         req = urllib.request.Request(
             f"http://127.0.0.1:{opts.port}{api_path}",
             data=None if body is None else json.dumps(body).encode(),
             headers={"Content-Type": "application/json"},
             method=method,
         )
+        tp = rpc_sp.traceparent()
+        if tp:
+            req.add_header("Traceparent", tp)
         attempt = 0
         while True:
+            t0 = time.monotonic()
             try:
                 faults.fire("launcher.rpc")
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    return json.loads(resp.read() or b"{}")
+                    out = json.loads(resp.read() or b"{}")
+                LAUNCHER_RPC_SECONDS.labels(
+                    verb=verb, outcome="ok"
+                ).observe(time.monotonic() - t0)
+                rpc_sp.set(outcome="ok", attempts=attempt + 1)
+                rpc_sp.end()
+                return out
             except urllib.error.HTTPError as e:
                 detail = e.read().decode(errors="replace")[:500]
+                LAUNCHER_RPC_SECONDS.labels(
+                    verb=verb, outcome=f"http_{e.code}"
+                ).observe(time.monotonic() - t0)
+                rpc_sp.set(outcome=f"http_{e.code}")
+                rpc_sp.end()
                 raise exc_cls(instance_id, e.code, detail)
             except Exception as e:  # noqa: BLE001 — refused, timeout, ...
                 if self._is_connection_refused(e) and attempt < retries:
+                    LAUNCHER_RPC_SECONDS.labels(
+                        verb=verb, outcome="refused"
+                    ).observe(time.monotonic() - t0)
                     attempt += 1
                     delay = retry_backoff_s * (2 ** (attempt - 1))
                     delay *= 1.0 + random.random()  # jitter: no lockstep
@@ -737,7 +807,17 @@ class EngineProcessManager:
                     time.sleep(min(delay, 2.0))
                     continue
                 if self._is_timeout(e):
+                    LAUNCHER_RPC_SECONDS.labels(
+                        verb=verb, outcome="timeout"
+                    ).observe(time.monotonic() - t0)
+                    rpc_sp.set(outcome="timeout")
+                    rpc_sp.end()
                     raise exc_cls(instance_id, 504, f"engine timed out: {e}")
+                LAUNCHER_RPC_SECONDS.labels(
+                    verb=verb, outcome="unreachable"
+                ).observe(time.monotonic() - t0)
+                rpc_sp.set(outcome="unreachable")
+                rpc_sp.end()
                 raise exc_cls(instance_id, 502, f"engine unreachable: {e}")
 
     def prefetch_instance(

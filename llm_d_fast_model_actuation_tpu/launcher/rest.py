@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 
 from aiohttp import web
 
+from ..utils import tracing
 from ..utils.events import RevisionTooOld
 from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
 from .manager import ChipConflict
@@ -47,8 +48,27 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     app = web.Application()
     app["manager"] = manager
 
+    def _traced_call(request: web.Request, fn):
+        """Run a blocking manager verb on the executor with the caller's
+        ``traceparent`` (if any) as the current context — the launcher's
+        create/swap spans then join the controller's actuation trace
+        (docs/tracing.md), and the engine hop + fork env carry it on."""
+        return tracing.run_traced(
+            asyncio.get_running_loop(), request.headers, fn
+        )
+
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "OK"})
+
+    async def metrics(request: web.Request) -> web.Response:
+        """Launcher-process prometheus exposition: the launcher RPC
+        latency family (fma_launcher_rpc_seconds) lives in THIS process —
+        without this route it would be registered but unscrapeable."""
+        from prometheus_client import generate_latest
+
+        return web.Response(
+            body=generate_latest(), content_type="text/plain"
+        )
 
     async def index(request: web.Request) -> web.Response:
         return web.json_response(
@@ -58,6 +78,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                 "endpoints": {
                     "index": "GET /",
                     "health": "GET /health",
+                    "metrics": "GET /metrics",
                     "create_instance": "POST /v2/vllm/instances",
                     "create_named_instance": "PUT /v2/vllm/instances/{instance_id}",
                     "delete_instance": "DELETE /v2/vllm/instances/{instance_id}",
@@ -71,6 +92,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "abort_prefetch": "DELETE /v2/vllm/instances/{instance_id}/prefetch",
                     "watch_instances": "GET /v2/vllm/instances/watch",
                     "faults": "GET/POST/DELETE /v2/vllm/faults",
+                    "traces": "GET /v2/vllm/traces",
                 },
             }
         )
@@ -87,8 +109,8 @@ def build_app(manager: EngineProcessManager) -> web.Application:
         try:
             # create forks + may probe overlapping engines over HTTP (2 s
             # timeout each) — keep the event loop free
-            result = await asyncio.get_running_loop().run_in_executor(
-                None, manager.create_instance, config
+            result = await _traced_call(
+                request, lambda: manager.create_instance(config)
             )
         except InvalidInstanceConfig as e:
             raise web.HTTPUnprocessableEntity(text=str(e))
@@ -104,8 +126,8 @@ def build_app(manager: EngineProcessManager) -> web.Application:
         instance_id = request.match_info["instance_id"]
         config = await _parse_config(request)
         try:
-            result = await asyncio.get_running_loop().run_in_executor(
-                None,
+            result = await _traced_call(
+                request,
                 lambda: manager.create_instance(config, instance_id=instance_id),
             )
         except InvalidInstanceConfig as e:
@@ -217,8 +239,8 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             )
         try:
             # the swap streams model state for seconds; keep the loop free
-            result = await asyncio.get_running_loop().run_in_executor(
-                None,
+            result = await _traced_call(
+                request,
                 lambda: manager.swap_instance(
                     instance_id, model, checkpoint_dir=checkpoint_dir
                 ),
@@ -268,8 +290,8 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                 text="checkpoint_dir must be a string"
             )
         try:
-            result = await asyncio.get_running_loop().run_in_executor(
-                None,
+            result = await _traced_call(
+                request,
                 lambda: manager.prefetch_instance(
                     instance_id, model, checkpoint_dir=checkpoint_dir
                 ),
@@ -376,7 +398,21 @@ def build_app(manager: EngineProcessManager) -> web.Application:
         faults.reset()
         return web.json_response(faults.describe())
 
+    async def traces(request: web.Request) -> web.Response:
+        """Export the LAUNCHER process's span ring buffer (create/swap/
+        restart verbs + launcher.rpc hops). The engine children export
+        their own via GET /v1/traces; together the per-process Chrome
+        JSONs merge into one Perfetto timeline (docs/tracing.md)."""
+        status, body, ctype = tracing.export_http(
+            request.query.get("format", "chrome"),
+            trace_id=request.query.get("trace_id") or None,
+            clear=request.query.get("clear") in ("1", "true"),
+        )
+        return web.Response(status=status, text=body, content_type=ctype)
+
     app.router.add_get("/health", health)
+    app.router.add_get("/v2/vllm/traces", traces)
+    app.router.add_get("/metrics", metrics)
     app.router.add_get("/", index)
     app.router.add_get("/v2/vllm/faults", faults_get)
     app.router.add_post("/v2/vllm/faults", faults_arm)

@@ -36,7 +36,7 @@ from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ..utils import faults
+from ..utils import faults, tracing
 from .llama import LlamaConfig
 
 #: HF `architectures[0]` -> config-knob overrides for our shared forward
@@ -503,6 +503,21 @@ def load_params(
     streaming = bool(streaming and place)
     inflight_bound = int(max_inflight_bytes or DEFAULT_LOAD_INFLIGHT_BYTES)
     st.workers, st.shards, st.streaming = workers, len(files), streaming
+    # Cold-load tracing (utils/tracing.py): one root span for the whole
+    # load; shard reads and H2D buckets are child spans. Reader threads
+    # and the transfer thread get the parent EXPLICITLY — ContextVars do
+    # not cross thread starts.
+    load_sp = tracing.begin(
+        "coldload.load",
+        activate=False,
+        path=path,
+        shards=len(files),
+        workers=workers,
+        streaming=streaming,
+        place=place,
+    )
+    traced = load_sp is not tracing.NOOP_SPAN
+    load_ctx = load_sp.context() if traced else None
 
     flat_shapes = {"/".join(p): n for p, n in _flatten(shapes)}
     n_experts = int(getattr(cfg, "num_experts", 0) or 0)
@@ -587,16 +602,34 @@ def load_params(
     throttle_t0 = time.monotonic()
 
     def read_shard(fname: str) -> None:
+        sp = (
+            tracing.begin(
+                "coldload.read_shard", parent=load_ctx, activate=False,
+                shard=fname,
+            )
+            if traced
+            else None
+        )
         try:
             _read_shard(fname)
+            if sp is not None:
+                sp.end()
         except LoadAborted:
+            # the failing shard is exactly what a failed-load trace must
+            # show: record it with the error before unwinding
+            if sp is not None:
+                sp.set(error="aborted")
+                sp.end()
             raise
-        except BaseException:
+        except BaseException as e:
             # fail fast from INSIDE the failing worker: the main thread
             # collects futures in submission order, so without this a
             # wrong tensor in the last shard would let every earlier
             # shard read (and stream to device) to completion first
             stop.set()
+            if sp is not None:
+                sp.set(error=f"{type(e).__name__}: {e}")
+                sp.end()
             raise
 
     def _read_shard(fname: str) -> None:
@@ -630,11 +663,17 @@ def load_params(
         # double-buffered: bucket k+1 is issued while bucket k drains, so
         # in-flight bytes stay ~<= inflight_bound (two buckets)
         bucket_bytes = max(1, inflight_bound // 2)
-        pending = None  # (flats, puts, nbytes)
+        pending = None  # (flats, puts, nbytes, span)
 
         def finish(p) -> None:
-            flats, puts, nb = p
-            puts = jax.block_until_ready(puts)
+            flats, puts, nb, sp = p
+            try:
+                puts = jax.block_until_ready(puts)
+            except BaseException as e:
+                if sp is not None:
+                    sp.set(error=f"{type(e).__name__}: {e}")
+                    sp.end()
+                raise
             with mu:
                 for f, a in zip(flats, puts):
                     placed[f] = a
@@ -642,6 +681,8 @@ def load_params(
             h2d_counts[0] += 1
             h2d_counts[1] += nb
             h2d_win[1] = time.monotonic()
+            if sp is not None:
+                sp.end()
 
         try:
             draining = False
@@ -664,14 +705,30 @@ def load_params(
                 nbs = [arrs[f].nbytes for f in flats]
                 for bucket in partition_buckets(nbs, bucket_bytes):
                     bflats = [flats[i] for i in bucket]
-                    faults.fire("coldload.h2d")
-                    if h2d_win[0] is None:
-                        h2d_win[0] = time.monotonic()
-                    puts = jax.device_put(
-                        [arrs[f] for f in bflats],
-                        [targets[f] for f in bflats],
+                    bsp = (
+                        tracing.begin(
+                            "coldload.h2d", parent=load_ctx,
+                            activate=False,
+                            bytes=sum(nbs[i] for i in bucket),
+                            leaves=len(bflats),
+                        )
+                        if traced
+                        else None
                     )
-                    cur = (bflats, puts, sum(nbs[i] for i in bucket))
+                    try:
+                        faults.fire("coldload.h2d")
+                        if h2d_win[0] is None:
+                            h2d_win[0] = time.monotonic()
+                        puts = jax.device_put(
+                            [arrs[f] for f in bflats],
+                            [targets[f] for f in bflats],
+                        )
+                    except BaseException as e:
+                        if bsp is not None:
+                            bsp.set(error=f"{type(e).__name__}: {e}")
+                            bsp.end()
+                        raise
+                    cur = (bflats, puts, sum(nbs[i] for i in bucket), bsp)
                     if pending is not None:
                         finish(pending)
                     pending = cur
@@ -679,6 +736,16 @@ def load_params(
                 pending_, pending = pending, None
                 finish(pending_)
         except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            if (
+                pending is not None
+                and pending[3] is not None
+                and not pending[3].ended
+            ):
+                # the double-buffered predecessor never finished: record
+                # it as cut short so the failed load's trace is complete
+                # (a span finish() already failed keeps its real error)
+                pending[3].set(error="aborted by transfer failure")
+                pending[3].end()
             xfer_err.append(e)
 
     xfer_thread = None
@@ -752,10 +819,14 @@ def load_params(
     st.convert_s = convert_s[0]
     st.bytes_read = bytes_read[0]
     if err is not None:
+        load_sp.set(error=f"{type(err).__name__}: {err}")
+        load_sp.end()
         raise err
 
     if not place:
         st.total_s = time.monotonic() - t_begin
+        load_sp.set(bytes_read=st.bytes_read)
+        load_sp.end()
         return _unflatten(dict(buffers))
 
     st.h2d_s = (
@@ -771,6 +842,13 @@ def load_params(
             0.0, min(read_t1, h2d_win[1]) - max(t_begin, h2d_win[0])
         )
     st.overlap_frac = st.overlap_s / st.total_s if st.total_s > 0 else 0.0
+    load_sp.set(
+        bytes_read=st.bytes_read,
+        bytes_h2d=st.bytes_h2d,
+        buckets_h2d=st.buckets_h2d,
+        overlap_frac=round(st.overlap_frac, 6),
+    )
+    load_sp.end()
     return params
 
 
@@ -806,30 +884,72 @@ def place_staged_params(
     )
     placed: Dict[str, Any] = {}
     pending = None
+    stage_sp = tracing.begin(
+        "coldload.place_staged", activate=False, leaves=len(keys)
+    )
+    traced = stage_sp is not tracing.NOOP_SPAN
+    stage_ctx = stage_sp.context() if traced else None
 
     def finish(p) -> None:
-        bkeys, puts, nb = p
-        puts = jax.block_until_ready(puts)
+        bkeys, puts, nb, sp = p
+        try:
+            puts = jax.block_until_ready(puts)
+        except BaseException as e:
+            if sp is not None:
+                sp.set(error=f"{type(e).__name__}: {e}")
+                sp.end()
+            raise
         for k, a in zip(bkeys, puts):
             placed[k] = a
         st.buckets_h2d += 1
         st.bytes_h2d += nb
+        if sp is not None:
+            sp.end()
 
-    for bucket in partition_buckets(nbs, bucket_bytes):
-        bkeys = [keys[i] for i in bucket]
-        faults.fire("coldload.h2d")
-        puts = jax.device_put(
-            [flat[k] for k in bkeys], [targets[k] for k in bkeys]
-        )
-        cur = (bkeys, puts, sum(nbs[i] for i in bucket))
+    try:
+        for bucket in partition_buckets(nbs, bucket_bytes):
+            bkeys = [keys[i] for i in bucket]
+            bsp = (
+                tracing.begin(
+                    "coldload.h2d", parent=stage_ctx, activate=False,
+                    bytes=sum(nbs[i] for i in bucket), leaves=len(bkeys),
+                )
+                if traced
+                else None
+            )
+            try:
+                faults.fire("coldload.h2d")
+                puts = jax.device_put(
+                    [flat[k] for k in bkeys], [targets[k] for k in bkeys]
+                )
+            except BaseException as e:
+                if bsp is not None:
+                    bsp.set(error=f"{type(e).__name__}: {e}")
+                    bsp.end()
+                raise
+            cur = (bkeys, puts, sum(nbs[i] for i in bucket), bsp)
+            if pending is not None:
+                finish(pending)
+            pending = cur
         if pending is not None:
-            finish(pending)
-        pending = cur
-    if pending is not None:
-        finish(pending)
+            pending_, pending = pending, None
+            finish(pending_)
+    except BaseException as e:
+        if (
+            pending is not None
+            and pending[3] is not None
+            and not pending[3].ended
+        ):
+            pending[3].set(error="aborted by transfer failure")
+            pending[3].end()
+        stage_sp.set(error=f"{type(e).__name__}: {e}")
+        stage_sp.end()
+        raise
 
     params = _quantize_and_repin(cfg, _unflatten(placed), mesh)
     st.h2d_s = st.total_s = time.monotonic() - t_begin
+    stage_sp.set(bytes_h2d=st.bytes_h2d, buckets_h2d=st.buckets_h2d)
+    stage_sp.end()
     return params
 
 

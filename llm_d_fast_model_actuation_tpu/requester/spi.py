@@ -19,6 +19,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from aiohttp import web
 
 from ..api import spi as spiapi
+from ..utils import tracing
 
 
 class LogSink:
@@ -90,11 +91,22 @@ class SpiServer:
             return web.json_response({k: int(v) for k, v in usage.items()})
 
         async def become_ready(request: web.Request) -> web.Response:
-            self.ready.set(True)
+            # the readiness relay closes the actuation envelope the
+            # controller measures — record it as a span of THAT trace
+            # (the controller's traceparent rides the SPI call)
+            with tracing.span(
+                "spi.become_ready",
+                parent=tracing.context_from_headers(request.headers),
+            ):
+                self.ready.set(True)
             return web.Response(text="OK\n")
 
         async def become_unready(request: web.Request) -> web.Response:
-            self.ready.set(False)
+            with tracing.span(
+                "spi.become_unready",
+                parent=tracing.context_from_headers(request.headers),
+            ):
+                self.ready.set(False)
             return web.Response(text="OK\n")
 
         async def set_log(request: web.Request) -> web.Response:

@@ -6,7 +6,11 @@ The reference serves prometheus and Go pprof from one mux
   GET /metrics       — prometheus exposition (default registry)
   GET /debug/stacks  — current traceback of every thread (the goroutine-dump
                        equivalent; what you want from a wedged controller)
-  GET /debug/vars    — process vitals: rss, fds, gc counts, thread count
+  GET /debug/vars    — process vitals: rss, fds, gc counts, thread count,
+                       process uptime
+  GET /debug/traces  — this process's actuation-span ring buffer
+                       (utils/tracing.py): Chrome trace-event JSON
+                       (Perfetto-loadable) or ?format=tree
 
 Runs on a daemon thread with the stdlib ThreadingHTTPServer — zero extra
 dependencies, safe to import before an event loop exists.
@@ -19,9 +23,29 @@ import json
 import os
 import sys
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+
+#: import-time anchor: the fallback for uptime_s when /proc is unavailable
+#: (this module is imported early in every process that serves it)
+_IMPORT_MONO = time.monotonic()
+
+
+def _uptime_s() -> float:
+    """Seconds since the PROCESS started (not since this module imported),
+    via /proc where available — stuck-thread triage wants "has this
+    controller been up 30 s or 30 days" without diffing /debug/stacks."""
+    try:
+        with open("/proc/self/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+        start_ticks = float(fields[19])  # starttime: field 22 overall
+        with open("/proc/uptime") as f:
+            sys_uptime = float(f.read().split()[0])
+        return max(0.0, sys_uptime - start_ticks / os.sysconf("SC_CLK_TCK"))
+    except (OSError, IndexError, ValueError):
+        return time.monotonic() - _IMPORT_MONO
 
 
 def _dump_stacks() -> str:
@@ -37,6 +61,7 @@ def _dump_stacks() -> str:
 def _vars() -> dict:
     info = {
         "pid": os.getpid(),
+        "uptime_s": round(_uptime_s(), 3),
         "threads": threading.active_count(),
         "gc_counts": gc.get_count(),
         "gc_objects": len(gc.get_objects()),
@@ -64,7 +89,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             from prometheus_client import generate_latest
 
@@ -75,6 +100,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(
                 200, json.dumps(_vars(), default=str).encode(), "application/json"
             )
+        elif path == "/debug/traces":
+            from urllib.parse import parse_qs
+
+            from . import tracing
+
+            q = parse_qs(query)
+            status, body, ctype = tracing.export_http(
+                (q.get("format") or ["chrome"])[0],
+                trace_id=(q.get("trace_id") or [None])[0],
+                clear=(q.get("clear") or [""])[0] in ("1", "true"),
+            )
+            self._send(status, body.encode(), ctype)
         else:
             self._send(404, b"not found\n", "text/plain")
 

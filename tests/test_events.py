@@ -1,0 +1,117 @@
+"""EventBroadcaster resume edges (utils/events.py).
+
+Two contracts a watcher depends on and nothing else pinned:
+
+  * the exact RevisionTooOld boundary — resuming from ``oldest-1`` means
+    "from the beginning of retention" and is allowed; anything older has
+    provably missed evicted events and must 410;
+  * ``publish_nowait`` from a non-loop thread (an executor running a
+    blocking instance stop) wakes a subscriber parked in ``cond.wait``.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.utils.events import (
+    EventBroadcaster,
+    RevisionTooOld,
+)
+
+
+def _filled_broadcaster():
+    """max_buffer=3 after publishing revisions 1..5: retains (3,4,5)."""
+    b = EventBroadcaster(max_buffer=3)
+
+    async def fill():
+        for rev in range(1, 6):
+            await b.publish(rev, f"e{rev}")
+
+    return b, fill
+
+
+def test_resume_at_exact_boundary_is_allowed():
+    """cursor == oldest-1: nothing was missed (the cursor's own event was
+    the last evicted one's predecessor... the first retained event is the
+    next one) — replays the full retained buffer."""
+
+    async def scenario():
+        b, fill = _filled_broadcaster()
+        await fill()
+        assert b.oldest_revision == 3
+        got = []
+
+        async def consume():
+            async for e in b.subscribe(since_revision=2):
+                got.append(e)
+                if len(got) == 3:
+                    return
+
+        await asyncio.wait_for(consume(), timeout=5)
+        assert got == ["e3", "e4", "e5"]
+
+    asyncio.run(scenario())
+
+
+def test_resume_below_boundary_raises_revision_too_old():
+    """cursor < oldest-1: at least one event was evicted unseen — the
+    watcher must re-list (HTTP 410 at the REST layer)."""
+
+    async def scenario():
+        b, fill = _filled_broadcaster()
+        await fill()
+        gen = b.subscribe(since_revision=1)
+        with pytest.raises(RevisionTooOld):
+            await asyncio.wait_for(gen.__anext__(), timeout=5)
+
+    asyncio.run(scenario())
+
+
+def test_resume_zero_means_from_start_never_raises():
+    async def scenario():
+        b, fill = _filled_broadcaster()
+        await fill()
+        gen = b.subscribe(since_revision=0)
+        assert await asyncio.wait_for(gen.__anext__(), timeout=5) == "e3"
+
+    asyncio.run(scenario())
+
+
+def test_publish_nowait_from_thread_wakes_parked_subscriber():
+    """The cross-thread publish path: a subscriber awaiting cond.wait()
+    on the loop is woken by a publish_nowait issued from a plain thread
+    (no running loop there), via call_soon_threadsafe."""
+
+    async def scenario():
+        b = EventBroadcaster()
+        received = asyncio.Event()
+        events = []
+
+        async def consume():
+            async for e in b.subscribe():
+                events.append(e)
+                received.set()
+                return
+
+        task = asyncio.ensure_future(consume())
+        # let the subscriber bind the condition and park in cond.wait
+        for _ in range(50):
+            await asyncio.sleep(0.01)
+            if b._cond is not None:
+                break
+        assert not task.done()
+
+        def publisher():
+            # no event loop on this thread — the other-thread branch
+            b.publish_nowait(1, "from-thread")
+
+        t = threading.Thread(target=publisher, name="nowait-publisher")
+        t.start()
+        await asyncio.wait_for(received.wait(), timeout=5)
+        t.join(timeout=5)
+        await asyncio.wait_for(task, timeout=5)
+        assert events == ["from-thread"]
+        assert b.latest_revision == 1
+
+    asyncio.run(scenario())

@@ -113,6 +113,26 @@ def test_debug_server_endpoints():
         with urllib.request.urlopen(base + "/debug/vars", timeout=5) as r:
             vitals = json.loads(r.read())
         assert vitals["threads"] >= 1 and "pid" in vitals
+        # stuck-thread triage vitals: uptime + thread count are first-class
+        assert isinstance(vitals["uptime_s"], (int, float))
+        assert 0 <= vitals["uptime_s"] < 7 * 24 * 3600  # sane, not epoch
+
+        # /debug/traces: the controller-port export of the span ring
+        # buffer (utils/tracing.py) — chrome (Perfetto) and tree formats
+        from llm_d_fast_model_actuation_tpu.utils import tracing
+
+        tracing.enable()
+        with tracing.span("test.debug_traces", probe=1):
+            pass
+        with urllib.request.urlopen(base + "/debug/traces", timeout=5) as r:
+            trace = json.loads(r.read())
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "test.debug_traces" in names
+        with urllib.request.urlopen(
+            base + "/debug/traces?format=tree", timeout=5
+        ) as r:
+            tree = r.read().decode()
+        assert "test.debug_traces" in tree and "probe=1" in tree
 
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(base + "/nope", timeout=5)

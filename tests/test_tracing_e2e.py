@@ -1,0 +1,199 @@
+"""End-to-end actuation tracing: ONE trace across three processes.
+
+The acceptance cut of docs/tracing.md: an actuation driven over the REAL
+process topology — this test (the controller's seat) -> launcher subprocess
+(REST, W3C ``traceparent`` header) -> forked engine child (launcher RPC
+header + ``FMA_TRACEPARENT`` fork env) — yields a single trace whose merged
+span tree contains the launcher RPC, the engine swap, and device-transfer
+child spans with byte attrs; and both processes export valid Chrome
+trace-event JSON (launcher ``GET /v2/vllm/traces``, engine
+``GET /v1/traces``).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from conftest import cpu_subprocess_env, free_port
+from llm_d_fast_model_actuation_tpu.utils import tracing
+
+
+def _wait_http(url: str, timeout: float = 120.0) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            if requests.get(url, timeout=2).status_code == 200:
+                return
+        except requests.RequestException as e:
+            last = e
+        time.sleep(0.25)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+def _chrome_spans(url: str):
+    payload = requests.get(url, timeout=30).json()
+    evs = payload["traceEvents"]
+    assert isinstance(evs, list) and evs, url
+    for e in evs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid", "args"} <= set(e), e
+        assert e["ph"] == "X"
+    return tracing.spans_from_chrome(payload)
+
+
+@pytest.mark.e2e
+@pytest.mark.tracing
+def test_single_trace_across_launcher_and_engine(tmp_path):
+    launcher_port, engine_port = free_port(), free_port()
+    log_dir = str(tmp_path)
+    env = cpu_subprocess_env()
+    with open(os.path.join(log_dir, "launcher-stdout.log"), "wb") as out:
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "llm_d_fast_model_actuation_tpu.launcher.main",
+                "--mock-chips", "--mock-chip-count", "2",
+                "--mock-topology", "1x2",
+                "--host", "127.0.0.1", "--port", str(launcher_port),
+                "--log-dir", log_dir,
+            ],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+        )
+    base = f"http://127.0.0.1:{launcher_port}"
+    engine = f"http://127.0.0.1:{engine_port}"
+    try:
+        _wait_http(base + "/health")
+
+        # the "controller" root of the actuation: a local span whose
+        # traceparent rides every REST call (exactly what clients.py does)
+        trace_id = "ab" * 16
+        root_span = "cd" * 8
+        header = {"traceparent": f"00-{trace_id}-{root_span}-01"}
+
+        options = (
+            f"--model tiny --port {engine_port} --num-pages 32 "
+            f"--max-batch 2 --page-size 8 --max-model-len 64 "
+            f"--swap-bucket-mib 1"
+        )
+        r = requests.put(
+            base + "/v2/vllm/instances/tr1",
+            json={"options": options, "env_vars": {"JAX_PLATFORMS": "cpu"}},
+            headers=header, timeout=60,
+        )
+        assert r.status_code == 201, r.text
+        _wait_http(engine + "/health")
+
+        # launcher-family metric stays on the launcher port: the forked
+        # child unregisters the inherited fma_launcher_rpc_seconds copy
+        assert b"fma_launcher_rpc_seconds" in requests.get(
+            base + "/metrics", timeout=30
+        ).content
+        assert b"fma_launcher_rpc_seconds" not in requests.get(
+            engine + "/metrics", timeout=30
+        ).content
+
+        r = requests.post(  # cold build: tiny parks in the pool
+            base + "/v2/vllm/instances/tr1/swap",
+            json={"model": "tiny-gemma"}, headers=header, timeout=300,
+        )
+        assert r.status_code == 200, r.text
+        r = requests.post(  # pool hit: chunked two-direction transfer
+            base + "/v2/vllm/instances/tr1/swap",
+            json={"model": "tiny"}, headers=header, timeout=300,
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["swap"]["pool_hit"] is True
+
+        # per-process exports, both valid Chrome trace-event JSON
+        launcher_spans = _chrome_spans(base + "/v2/vllm/traces")
+        engine_spans = _chrome_spans(engine + "/v1/traces")
+
+        # (1) the REST hop: launcher verbs joined the controller trace
+        creates = [
+            s for s in launcher_spans
+            if s.name == "launcher.create_instance"
+            and s.trace_id == trace_id
+        ]
+        assert creates and creates[0].parent_id == root_span
+        lswaps = [
+            s for s in launcher_spans
+            if s.name == "launcher.swap" and s.trace_id == trace_id
+        ]
+        assert len(lswaps) == 2
+        rpcs = [
+            s for s in launcher_spans
+            if s.name == "launcher.rpc" and s.trace_id == trace_id
+        ]
+        assert rpcs and all(s.attrs.get("outcome") == "ok" for s in rpcs)
+
+        # (2) the launcher->engine hop: engine.swap parents on launcher.rpc
+        eswaps = [
+            s for s in engine_spans
+            if s.name == "engine.swap" and s.trace_id == trace_id
+        ]
+        assert len(eswaps) == 2, sorted({s.name for s in engine_spans})
+        rpc_ids = {s.span_id for s in rpcs}
+        assert all(s.parent_id in rpc_ids for s in eswaps)
+
+        # (3) the fork: FMA_TRACEPARENT carried the create span into the
+        # child — its startup span joined the same trace
+        starts = [s for s in engine_spans if s.name == "engine.start"]
+        assert starts and starts[0].trace_id == trace_id
+        assert starts[0].parent_id == creates[0].span_id
+
+        # (4) device-transfer child spans with byte attrs, reachable from
+        # engine.swap through swap.transfer in the merged tree
+        merged = {
+            s.span_id: s
+            for s in list(launcher_spans) + list(engine_spans)
+        }
+
+        def ancestors(s):
+            names, cur, hops = set(), s, 0
+            while cur.parent_id and cur.parent_id in merged and hops < 32:
+                cur = merged[cur.parent_id]
+                names.add(cur.name)
+                hops += 1
+            return names
+
+        xfers = [
+            s for s in engine_spans
+            if s.name in ("swap.d2h", "swap.h2d")
+            and s.trace_id == trace_id
+        ]
+        assert xfers, sorted({s.name for s in engine_spans})
+        assert all(int(s.attrs.get("bytes", 0)) > 0 for s in xfers)
+        chains = [ancestors(s) for s in xfers]
+        assert any(
+            {"swap.transfer", "engine.swap", "launcher.rpc",
+             "launcher.swap"} <= c
+            for c in chains
+        ), chains
+
+        # (5) one coherent trace end to end, across all three processes
+        assert {
+            s.trace_id
+            for s in creates + lswaps + rpcs + eswaps + starts + xfers
+        } == {trace_id}
+
+        # (6) the merged human tree renders the whole actuation
+        tree = tracing.render_tree(
+            [s for s in merged.values() if s.trace_id == trace_id]
+        )
+        assert "launcher.swap" in tree and "swap.transfer" in tree
+    finally:
+        try:
+            requests.delete(
+                base + "/v2/vllm/instances", timeout=30
+            )
+        except requests.RequestException:
+            pass
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
