@@ -29,6 +29,7 @@ only holds if the hot path is entirely pre-compiled programs.
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq, Tuple
@@ -113,6 +114,18 @@ def resolve_attention_impl(impl: str) -> str:
     if impl == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "grouped"
     return impl
+
+
+def prefill_bucket(n: int, seq_len: int) -> int:
+    """Power-of-two prefill shape bucket (floor 16) clamped to seq_len —
+    the ONE definition shared by live dispatch and the AOT warmup plan
+    (exec_pool.warmup_plan). They must agree bit-for-bit: a divergence
+    would pool executables at buckets the dispatch never asks for, and
+    every lookup would silently miss back to first-touch jit."""
+    b = 16
+    while b < n:
+        b *= 2
+    return min(b, seq_len)
 
 
 @dataclass
@@ -226,6 +239,234 @@ class EngineAsleep(RuntimeError):
     """The engine's device state is offloaded; wake_up() before serving."""
 
 
+class ProgramSet:
+    """The engine's compiled-program surface, built from static config only
+    (model config + sampling/eos scalars) — no params, no device state.
+
+    This is what lets the AOT warmup driver (engine/exec_pool.py) construct
+    and compile the serving programs for a model that is not resident yet,
+    while its weights are still streaming host->device: ``jax.jit`` only
+    needs the traced function and abstract avals, so compilation is pure
+    host-CPU work that overlaps cleanly with the transfer DMA.
+
+    The engine owns one ProgramSet; the warmup driver builds its own for
+    the incoming config and hands the resulting executables over through
+    ``InferenceEngine.install_executable`` — jit caches are keyed by
+    function identity, so the *executable*, not the jitted wrapper, is the
+    unit that crosses between them.
+    """
+
+    def __init__(self, model_cfg, logprobs_topk: int, eos_token_id: int) -> None:
+        self.model_cfg = model_cfg
+        self.alt_k = int(logprobs_topk)
+        self.eos = int(eos_token_id)
+        self.prefill = jax.jit(self._make_prefill(False), donate_argnums=(3,))
+        self.prefill_plp = jax.jit(self._make_prefill(True), donate_argnums=(3,))
+        self.suffix = jax.jit(
+            self._make_suffix_prefill(False), donate_argnums=(5,)
+        )
+        self.suffix_plp = jax.jit(
+            self._make_suffix_prefill(True), donate_argnums=(5,)
+        )
+        self.verify = jax.jit(self._make_verify(), donate_argnums=(4,))
+        self._chunks: Dict[int, Any] = {}
+
+    # -- shared program tails -------------------------------------------------
+
+    def _sample_last(
+        self, logits, lens, temp, topp, counts, pres, freq, skey, bias
+    ):
+        """Shared sampling tail of both prefill programs: take the last
+        valid logit, split the request's OWN key, sample — one definition
+        so the cache-hit path can never diverge from the cold one."""
+        alt_k = self.alt_k
+        last = jnp.take_along_axis(
+            logits, (lens - 1)[:, None, None], axis=1
+        )[:, 0]
+        key = jax.random.wrap_key_data(skey)
+        key, sub = jax.random.split(key)
+        out = sample(
+            last, sub, temp, top_p=topp,
+            counts=counts, presence_penalty=pres, frequency_penalty=freq,
+            alt_k=alt_k, bias=bias,
+        )
+        tok, lp = out[0], out[1]
+        alts = out[2:] if alt_k > 0 else (
+            jnp.zeros((tok.shape[0], 0), jnp.float32),
+            jnp.zeros((tok.shape[0], 0), jnp.int32),
+        )
+        return tok, lp, alts[0], alts[1], jax.random.key_data(key)
+
+    @staticmethod
+    def _prompt_lps(logits, targets):
+        """Per-position logprob of `targets` (the NEXT prompt token at
+        each position) under the model — OpenAI echo+logprobs."""
+        norm = logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True
+        )
+        return jnp.take_along_axis(
+            norm, targets[..., None], axis=-1
+        )[..., 0]
+
+    # -- program factories ----------------------------------------------------
+
+    def _make_prefill(self, with_plp: bool):
+        """Two compiled variants: prompt-logprob scoring is an extra
+        vocab-wide logsumexp over the WHOLE bucket — only echo requests
+        pay for it. Signatures match, so call sites just pick the
+        function."""
+        model_cfg = self.model_cfg
+
+        def _prefill(
+            params, tokens, seq_lens, cache, page_table, temp, topp,
+            counts, pres, freq, skey, bias,
+        ):
+            logits, cache = llama.prefill(
+                params, model_cfg, tokens, seq_lens, cache, page_table
+            )
+            tok, lp, av, ai, skey = self._sample_last(
+                logits, seq_lens, temp, topp, counts, pres, freq, skey,
+                bias,
+            )
+            if with_plp:
+                # position i predicts token i+1: shift the prompt left
+                targets = jnp.roll(tokens, -1, axis=1)
+                plp = self._prompt_lps(logits, targets)
+            else:
+                plp = jnp.zeros(tokens.shape, jnp.float32)
+            return tok, lp, av, ai, plp, cache, skey
+
+        return _prefill
+
+    def _make_suffix_prefill(self, with_plp: bool):
+        model_cfg = self.model_cfg
+
+        def _suffix_prefill(
+            params, tokens, targets, start, suffix_lens, cache,
+            page_table, temp, topp, counts, pres, freq, skey, bias,
+        ):
+            logits, cache = llama.prefill_continue(
+                params, model_cfg, tokens, start, suffix_lens, cache,
+                page_table,
+            )
+            tok, lp, av, ai, skey = self._sample_last(
+                logits, suffix_lens, temp, topp, counts, pres, freq,
+                skey, bias,
+            )
+            if with_plp:
+                # a segment cannot derive its last target (the NEXT
+                # segment's first token) from its own tokens, so
+                # targets come in
+                plp = self._prompt_lps(logits, targets)
+            else:
+                plp = jnp.zeros(tokens.shape, jnp.float32)
+            return tok, lp, av, ai, plp, cache, skey
+
+        return _suffix_prefill
+
+    def _make_verify(self):
+        model_cfg = self.model_cfg
+        alt_k = self.alt_k
+
+        def _verify(params, tokens, start, window_len, cache, page_table):
+            """Speculative verify: run the window [last_token, q1..q_{k-1}]
+            through the continue program and return the model's GREEDY next
+            token at every window position, with its logprob (the logprobs
+            API must not degrade under speculation)."""
+            logits, cache = llama.prefill_continue(
+                params, model_cfg, tokens, start, window_len, cache,
+                page_table,
+            )
+            norm = logits - jax.scipy.special.logsumexp(
+                logits, axis=-1, keepdims=True
+            )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lps = jnp.take_along_axis(norm, toks[..., None], axis=-1)[..., 0]
+            if alt_k > 0:
+                avs, ais = jax.lax.top_k(norm, alt_k)
+            else:
+                b, w = toks.shape
+                avs = jnp.zeros((b, w, 0), jnp.float32)
+                ais = jnp.zeros((b, w, 0), jnp.int32)
+            return toks, lps, avs, ais.astype(jnp.int32), cache
+
+        return _verify
+
+    def _make_chunk(self, T: int):
+        model_cfg = self.model_cfg
+        eos = self.eos
+        alt_k = self.alt_k
+
+        def chunk(
+            params, lt, pos, budget, cache, page_table, temps, topps,
+            counts, pres, freq, skeys, eos_on, bias,
+        ):
+            def body(carry, _):
+                lt, pos, budget, cache, counts, skeys = carry
+                active = budget > 0
+                logits, cache = llama.decode_step(
+                    params, model_cfg, lt, pos, cache, page_table, active
+                )
+                # each slot splits its OWN key — and only while active, so
+                # a request's draw count is a function of its own progress,
+                # not of how long it shared the batch with others
+                keys = jax.random.wrap_key_data(skeys)  # [b] typed keys
+                pairs = jax.vmap(jax.random.split)(keys)  # [b, 2]
+                subs = pairs[:, 1]
+                new_data = jax.random.key_data(pairs[:, 0])
+                skeys = jnp.where(active[:, None], new_data, skeys)
+                out = sample(
+                    logits, subs, temps, top_p=topps,
+                    counts=counts, presence_penalty=pres,
+                    frequency_penalty=freq,
+                    alt_k=alt_k, bias=bias,
+                )
+                nxt, lp = out[0], out[1]
+                if alt_k > 0:
+                    av, ai = out[2], out[3]
+                else:
+                    av = jnp.zeros((nxt.shape[0], 0), jnp.float32)
+                    ai = jnp.zeros((nxt.shape[0], 0), jnp.int32)
+                nxt = jnp.where(active, nxt, lt)
+                a32 = active.astype(jnp.int32)
+                # the emitted token joins the counts the NEXT step penalizes
+                counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(a32)
+                pos = pos + a32
+                budget = budget - a32
+                if eos >= 0:
+                    budget = jnp.where(
+                        active & (nxt == eos) & (eos_on > 0), 0, budget
+                    )
+                return (
+                    (nxt, pos, budget, cache, counts, skeys),
+                    (nxt, lp, av, ai),
+                )
+
+            (
+                (lt, pos, budget, cache, counts, skeys),
+                (toks, lps, avs, ais),
+            ) = jax.lax.scan(
+                body, (lt, pos, budget, cache, counts, skeys), None, length=T
+            )
+            return (
+                toks, lps, avs, ais, lt, pos, budget, cache, counts, skeys,
+            )
+
+        return chunk
+
+    def chunk(self, T: int):
+        """The jitted T-step decode chunk (cached per T). At most two ever
+        compile in serving (T = decode_chunk and T = 1) — compiles are
+        expensive on TPU."""
+        fn = self._chunks.get(T)
+        if fn is None:
+            # donate scheduler state + cache + counts + key data
+            fn = self._chunks[T] = jax.jit(
+                self._make_chunk(T), donate_argnums=(1, 2, 3, 4, 8, 11)
+            )
+        return fn
+
+
 class InferenceEngine:
     def __init__(
         self,
@@ -319,135 +560,32 @@ class InferenceEngine:
         #: single-host or follower.
         self.lockstep: Optional[Any] = None
 
-        model_cfg = m
         self._model_cfg = m
 
-        alt_k = cfg.logprobs_topk
-
-        def _sample_last(
-            logits, lens, temp, topp, counts, pres, freq, skey, bias
-        ):
-            """Shared sampling tail of both prefill programs: take the last
-            valid logit, split the request's OWN key, sample — one
-            definition so the cache-hit path can never diverge from the
-            cold one."""
-            last = jnp.take_along_axis(
-                logits, (lens - 1)[:, None, None], axis=1
-            )[:, 0]
-            key = jax.random.wrap_key_data(skey)
-            key, sub = jax.random.split(key)
-            out = sample(
-                last, sub, temp, top_p=topp,
-                counts=counts, presence_penalty=pres, frequency_penalty=freq,
-                alt_k=alt_k, bias=bias,
-            )
-            tok, lp = out[0], out[1]
-            alts = out[2:] if alt_k > 0 else (
-                jnp.zeros((tok.shape[0], 0), jnp.float32),
-                jnp.zeros((tok.shape[0], 0), jnp.int32),
-            )
-            return tok, lp, alts[0], alts[1], jax.random.key_data(key)
-
-        def _prompt_lps(logits, targets):
-            """Per-position logprob of `targets` (the NEXT prompt token at
-            each position) under the model — OpenAI echo+logprobs."""
-            norm = logits - jax.scipy.special.logsumexp(
-                logits, axis=-1, keepdims=True
-            )
-            return jnp.take_along_axis(
-                norm, targets[..., None], axis=-1
-            )[..., 0]
-
-        def _make_prefill(with_plp: bool):
-            """Two compiled variants: prompt-logprob scoring is an extra
-            vocab-wide logsumexp over the WHOLE bucket — only echo
-            requests pay for it. Signatures match, so call sites just
-            pick the function."""
-
-            def _prefill(
-                params, tokens, seq_lens, cache, page_table, temp, topp,
-                counts, pres, freq, skey, bias,
-            ):
-                logits, cache = llama.prefill(
-                    params, model_cfg, tokens, seq_lens, cache, page_table
-                )
-                tok, lp, av, ai, skey = _sample_last(
-                    logits, seq_lens, temp, topp, counts, pres, freq, skey,
-                    bias,
-                )
-                if with_plp:
-                    # position i predicts token i+1: shift the prompt left
-                    targets = jnp.roll(tokens, -1, axis=1)
-                    plp = _prompt_lps(logits, targets)
-                else:
-                    plp = jnp.zeros(tokens.shape, jnp.float32)
-                return tok, lp, av, ai, plp, cache, skey
-
-            return _prefill
-
-        # cache (arg 3) donated: prefill updates pages in place.
-        self._prefill_fn = jax.jit(_make_prefill(False), donate_argnums=(3,))
-        self._prefill_plp_fn = jax.jit(_make_prefill(True), donate_argnums=(3,))
-
-        def _make_suffix_prefill(with_plp: bool):
-            def _suffix_prefill(
-                params, tokens, targets, start, suffix_lens, cache,
-                page_table, temp, topp, counts, pres, freq, skey, bias,
-            ):
-                logits, cache = llama.prefill_continue(
-                    params, model_cfg, tokens, start, suffix_lens, cache,
-                    page_table,
-                )
-                tok, lp, av, ai, skey = _sample_last(
-                    logits, suffix_lens, temp, topp, counts, pres, freq,
-                    skey, bias,
-                )
-                if with_plp:
-                    # a segment cannot derive its last target (the NEXT
-                    # segment's first token) from its own tokens, so
-                    # targets come in
-                    plp = _prompt_lps(logits, targets)
-                else:
-                    plp = jnp.zeros(tokens.shape, jnp.float32)
-                return tok, lp, av, ai, plp, cache, skey
-
-            return _suffix_prefill
-
-        self._suffix_prefill_fn = jax.jit(
-            _make_suffix_prefill(False), donate_argnums=(5,)
-        )
-        self._suffix_prefill_plp_fn = jax.jit(
-            _make_suffix_prefill(True), donate_argnums=(5,)
-        )
-
-        def _verify(params, tokens, start, window_len, cache, page_table):
-            """Speculative verify: run the window [last_token, q1..q_{k-1}]
-            through the continue program and return the model's GREEDY next
-            token at every window position, with its logprob (the logprobs
-            API must not degrade under speculation)."""
-            logits, cache = llama.prefill_continue(
-                params, model_cfg, tokens, start, window_len, cache, page_table
-            )
-            norm = logits - jax.scipy.special.logsumexp(
-                logits, axis=-1, keepdims=True
-            )
-            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            lps = jnp.take_along_axis(norm, toks[..., None], axis=-1)[..., 0]
-            if cfg.logprobs_topk > 0:
-                avs, ais = jax.lax.top_k(norm, cfg.logprobs_topk)
-            else:
-                b, w = toks.shape
-                avs = jnp.zeros((b, w, 0), jnp.float32)
-                ais = jnp.zeros((b, w, 0), jnp.int32)
-            return toks, lps, avs, ais.astype(jnp.int32), cache
-
-        self._verify_fn = jax.jit(_verify, donate_argnums=(4,))
+        # One ProgramSet per engine (jit caches key on function identity,
+        # so two engines never share a cache); the flat _*_fn attributes
+        # keep the historical names the lockstep follower replays through.
+        self.programs = ProgramSet(m, cfg.logprobs_topk, cfg.eos_token_id)
+        self._prefill_fn = self.programs.prefill
+        self._prefill_plp_fn = self.programs.prefill_plp
+        self._suffix_prefill_fn = self.programs.suffix
+        self._suffix_prefill_plp_fn = self.programs.suffix_plp
+        self._verify_fn = self.programs.verify
+        self._jit_programs = {
+            "prefill": self.programs.prefill,
+            "prefill_plp": self.programs.prefill_plp,
+            "suffix": self.programs.suffix,
+            "suffix_plp": self.programs.suffix_plp,
+        }
+        #: AOT-warmed executables keyed by (program, shape bucket / chunk
+        #: T), installed by the exec-pool warmup driver; dispatch prefers
+        #: them, a missing entry just means first-touch jit compile
+        self._aot: Dict[Tuple[str, int], Any] = {}
         #: speculative decoding counters (observability)
         self.spec_proposed = 0
         self.spec_accepted = 0
         self._spec_miss_streak = 0
         self._spec_cooldown = 0
-        self._chunk_fns: Dict[int, Any] = {}
         # resolve the drain-tail policy once (mirrors
         # resolve_attention_impl): a typo must fail loudly, not silently
         # behave as "single"
@@ -467,75 +605,47 @@ class InferenceEngine:
         #: handed back by the next step() so the service resolves futures
         self._orphan_finished: List[Request] = []
 
-    # -- compiled decode chunk ----------------------------------------------
+    # -- compiled-program dispatch (AOT executables > lazy jit) --------------
 
-    def _make_chunk_fn(self, T: int):
-        model_cfg = self._model_cfg
-        eos = self.cfg.eos_token_id
+    def install_executable(self, program: str, bucket: int, compiled: Any) -> None:
+        """Adopt an AOT-compiled executable for (program, shape bucket /
+        chunk T) — the exec-pool warmup's delivery point (engine/
+        exec_pool.py). Dispatch prefers installed executables; a missing
+        entry just means first-touch jit compile, exactly as before."""
+        self._aot[(program, int(bucket))] = compiled
 
-        def chunk(
-            params, lt, pos, budget, cache, page_table, temps, topps,
-            counts, pres, freq, skeys, eos_on, bias,
-        ):
-            def body(carry, _):
-                lt, pos, budget, cache, counts, skeys = carry
-                active = budget > 0
-                logits, cache = llama.decode_step(
-                    params, model_cfg, lt, pos, cache, page_table, active
-                )
-                # each slot splits its OWN key — and only while active, so
-                # a request's draw count is a function of its own progress,
-                # not of how long it shared the batch with others
-                keys = jax.random.wrap_key_data(skeys)  # [b] typed keys
-                pairs = jax.vmap(jax.random.split)(keys)  # [b, 2]
-                subs = pairs[:, 1]
-                new_data = jax.random.key_data(pairs[:, 0])
-                skeys = jnp.where(active[:, None], new_data, skeys)
-                out = sample(
-                    logits, subs, temps, top_p=topps,
-                    counts=counts, presence_penalty=pres,
-                    frequency_penalty=freq,
-                    alt_k=self.cfg.logprobs_topk, bias=bias,
-                )
-                nxt, lp = out[0], out[1]
-                if self.cfg.logprobs_topk > 0:
-                    av, ai = out[2], out[3]
-                else:
-                    av = jnp.zeros((nxt.shape[0], 0), jnp.float32)
-                    ai = jnp.zeros((nxt.shape[0], 0), jnp.int32)
-                nxt = jnp.where(active, nxt, lt)
-                a32 = active.astype(jnp.int32)
-                # the emitted token joins the counts the NEXT step penalizes
-                counts = counts.at[jnp.arange(counts.shape[0]), nxt].add(a32)
-                pos = pos + a32
-                budget = budget - a32
-                if eos >= 0:
-                    budget = jnp.where(
-                        active & (nxt == eos) & (eos_on > 0), 0, budget
-                    )
-                return (
-                    (nxt, pos, budget, cache, counts, skeys),
-                    (nxt, lp, av, ai),
-                )
+    def clear_executables(self) -> None:
+        """Forget installed AOT executables. Device release destroys the
+        PJRT client that owns them; the service re-validates pool entries
+        (or recompiles lazily) on wake."""
+        self._aot.clear()
 
-            (
-                (lt, pos, budget, cache, counts, skeys),
-                (toks, lps, avs, ais),
-            ) = jax.lax.scan(
-                body, (lt, pos, budget, cache, counts, skeys), None, length=T
-            )
-            return (
-                toks, lps, avs, ais, lt, pos, budget, cache, counts, skeys,
-            )
-
-        # donate scheduler state + cache + counts + key data
-        return jax.jit(chunk, donate_argnums=(1, 2, 3, 4, 8, 11))
+    def _call_program(self, program: str, bucket: int, *args):
+        """Dispatch one compiled program: the AOT-warmed executable when
+        the warmup installed one for this (program, bucket), else the
+        lazily-jitted default. An aval/sharding mismatch from the
+        executable (e.g. a level-2 wake rebuilt params uncommitted) raises
+        TypeError BEFORE execution starts, so the donated cache is
+        untouched — drop the stale entry and re-dispatch through jit."""
+        comp = self._aot.get((program, bucket))
+        if comp is not None:
+            try:
+                return comp(*args)
+            except TypeError:
+                self._aot.pop((program, bucket), None)
+        if program == "chunk":
+            return self.programs.chunk(bucket)(*args)
+        return self._jit_programs[program](*args)
 
     def _chunk_fn(self, T: int):
-        fn = self._chunk_fns.get(T)
-        if fn is None:
-            fn = self._chunk_fns[T] = self._make_chunk_fn(T)
-        return fn
+        """The T-step decode dispatch target. Gang followers replay this
+        name directly (engine/multihost.py) — they never carry AOT
+        entries (warmup skips meshes), so they get the bare jit program;
+        a single-host engine with an installed chunk executable routes
+        through _call_program's AOT-prefer/TypeError-drop dispatch."""
+        if ("chunk", T) not in self._aot:
+            return self.programs.chunk(T)
+        return functools.partial(self._call_program, "chunk", T)
 
     # -- device scheduler state ---------------------------------------------
 
@@ -573,7 +683,10 @@ class InferenceEngine:
         """After a device-releasing sleep, the PJRT client was re-created:
         rebuild the engine's device-bound objects (its mesh) on the new
         device handles. Compiled programs re-lower lazily through the
-        persistent compile cache."""
+        persistent compile cache; installed AOT executables belonged to
+        the destroyed client and are dropped (the service re-validates
+        the executable pool on wake)."""
+        self.clear_executables()
         if self.mesh is not None:
             from .device import rebuild_mesh
 
@@ -748,10 +861,7 @@ class InferenceEngine:
             return self.allocator.alloc(n)
 
     def _prefill_bucket(self, n: int) -> int:
-        b = 16
-        while b < n:
-            b *= 2
-        return min(b, self.cfg.seq_len)
+        return prefill_bucket(n, self.cfg.seq_len)
 
     def _run_suffix_segment(
         self, req: Request, start_pos: int, seg: List[int], temp, topp,
@@ -782,12 +892,9 @@ class InferenceEngine:
                 req, bucket, start_pos, len(seg), advance_key=final,
                 want_plp=req.want_prompt_logprobs,
             )
-        fn = (
-            self._suffix_prefill_plp_fn
-            if req.want_prompt_logprobs
-            else self._suffix_prefill_fn
-        )
-        tok, lp, av, ai, plp, cache, new_key = fn(
+        tok, lp, av, ai, plp, cache, new_key = self._call_program(
+            "suffix_plp" if req.want_prompt_logprobs else "suffix",
+            bucket,
             self.params,
             tokens,
             targets,
@@ -827,12 +934,9 @@ class InferenceEngine:
                 self.lockstep.prefill(
                     req, bucket, want_plp=req.want_prompt_logprobs
                 )
-            fn = (
-                self._prefill_plp_fn
-                if req.want_prompt_logprobs
-                else self._prefill_fn
-            )
-            tok, lp, av, ai, plp, cache, new_key = fn(
+            tok, lp, av, ai, plp, cache, new_key = self._call_program(
+                "prefill_plp" if req.want_prompt_logprobs else "prefill",
+                bucket,
                 self.params,
                 tokens,
                 seq_lens,

@@ -1,0 +1,763 @@
+"""Executable pool + AOT warmup: compile programs while weights move.
+
+The only real-TPU run to date put `ttft_after_wake` at 6.59 s and blamed
+first-touch JIT compilation of the prefill/suffix/decode programs: the
+persistent XLA disk cache only amortizes *repeat* compiles and still pays
+deserialization + dispatch on the critical path. This module moves ALL of
+that off the first-request path, the same way the streaming loader moved
+weight movement off it (docs/perf.md):
+
+  * :class:`ExecutablePool` — a bounded LRU of AOT-compiled executables
+    keyed by (engine-config hash, mesh shape, dtype/quant, program, shape
+    bucket), sitting beside the host model pool in the engine service.
+    Entries optionally *spill* as serialized executables into the
+    launcher's persistent compile-cache directory, so a pool entry
+    survives an instance restart (TPU only by default: the XLA CPU
+    backend has produced numerically different executables when
+    deserialized across clients — the same reason the persistent cache is
+    TPU-only in bench.py; set ``FMA_EXEC_SPILL=1`` to force).
+
+  * :class:`WarmupTask` — a background thread that AOT-compiles the
+    incoming model's programs via ``jax.jit(...).lower(...).compile()``
+    concurrently with its weight transfer. Lowering + compilation is pure
+    host-CPU work over abstract avals (no params, no device buffers), so
+    it overlaps cleanly with the H2D/D2H DMA of a swap, prefetch staging,
+    or a cold checkpoint load. The engine service kicks a task before the
+    transfer starts and installs the results into the new engine's AOT
+    table (``InferenceEngine.install_executable``) once both finish.
+
+Trace spans: one ``warmup.overlap`` root per task with a ``warmup.compile``
+child per compiled program, wall-anchored like every other span — the
+Perfetto timeline shows compile riding under the ``swap.d2h``/
+``coldload.h2d`` transfer spans (docs/tracing.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import pickle
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils import tracing
+from ..utils.hashing import canonical_json, sha256_hex
+
+logger = logging.getLogger(__name__)
+
+#: default pool entry size when XLA's memory analysis reports nothing —
+#: generated code for these programs is typically O(100 KiB..MiB)
+DEFAULT_EXEC_NBYTES = 1 << 20
+
+#: programs the warmup driver knows how to compile; "chunk"'s bucket is the
+#: fused step count T, the others' is the prefill token bucket
+WARM_PROGRAMS = ("prefill", "suffix", "chunk")
+
+
+def default_spill_dir() -> str:
+    """Where spilled executables live: the launcher exports
+    ``FMA_EXEC_SPILL_DIR`` next to its persistent XLA compile cache
+    (launcher/main.py preload), so children of one launcher share spilled
+    entries across restarts; standalone engines derive the same location
+    from ``JAX_COMPILATION_CACHE_DIR``."""
+    explicit = os.environ.get("FMA_EXEC_SPILL_DIR", "")
+    if explicit:
+        return explicit
+    cache = os.environ.get("JAX_COMPILATION_CACHE_DIR", "")
+    return os.path.join(cache, "exec-pool") if cache else ""
+
+
+def spill_supported() -> bool:
+    """Serialized-executable reload is trusted on TPU; on other backends
+    deserialization across clients has flipped numerics (see module
+    docstring), so spill is opt-in via ``FMA_EXEC_SPILL=1``."""
+    forced = os.environ.get("FMA_EXEC_SPILL", "")
+    if forced == "1":
+        return True
+    if forced == "0":
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def parse_warmup_buckets(spec: str) -> Tuple[int, ...]:
+    """``--warmup-buckets`` parser: comma-separated positive prefill token
+    buckets (rounded up to the engine's power-of-two buckets at plan
+    time). Empty disables AOT warmup."""
+    spec = (spec or "").strip()
+    if not spec:
+        return ()
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            v = int(part)
+        except ValueError:
+            raise ValueError(f"--warmup-buckets entry {part!r} is not an int")
+        if v <= 0:
+            raise ValueError(f"--warmup-buckets entries must be > 0, got {v}")
+        out.append(v)
+    return tuple(out)
+
+
+# -- identity -----------------------------------------------------------------
+
+
+def _normalize_cfg(cfg):
+    """Thread the resolved attention impl into the model config exactly
+    like InferenceEngine.__init__ does, so a signature computed from the
+    service's pre-build config equals one computed from the live
+    engine.cfg."""
+    from .engine import resolve_attention_impl
+
+    impl = resolve_attention_impl(cfg.attention_impl)
+    m = cfg.model
+    if m.attention_impl != impl:
+        m = dataclasses.replace(m, attention_impl=impl)
+        cfg = dataclasses.replace(cfg, model=m)
+    return cfg
+
+
+def exec_signature(cfg, mesh_shape: Optional[Tuple[int, ...]] = None) -> str:
+    """Identity of a compiled-program family: everything that changes the
+    lowered program — the full model config (dtype/quantization included),
+    batch/page geometry, sampling top-k, eos wiring, attention impl, mesh
+    shape, backend, device generation, and the jax version the executable
+    was built by. Device *kind* (v4 vs v5e, not just "tpu") matters because
+    the spill dir can live on storage shared across a heterogeneous fleet —
+    an executable must never deserialize onto a different TPU generation."""
+    import jax
+
+    cfg = _normalize_cfg(cfg)
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — no devices = signature still usable
+        device_kind = ""
+    body = {
+        "model": dataclasses.asdict(cfg.model),
+        "max_batch": cfg.max_batch,
+        "page_size": cfg.page_size,
+        "num_pages": cfg.num_pages,
+        "seq_len": cfg.seq_len,
+        "eos": cfg.eos_token_id,
+        "extra_eos": list(cfg.extra_eos_ids),
+        "logprobs_topk": cfg.logprobs_topk,
+        "mesh": list(mesh_shape) if mesh_shape else None,
+        "backend": jax.default_backend(),
+        "device": device_kind,
+        "jax": jax.__version__,
+    }
+    return sha256_hex(canonical_json(body))[:16]
+
+
+def exec_key(signature: str, program: str, bucket: int) -> str:
+    return f"{signature}/{program}@{int(bucket)}"
+
+
+def warmup_plan(cfg, buckets) -> List[Tuple[str, int]]:
+    """(program, bucket) pairs a warmup covers: the prefill AND
+    suffix-prefill programs at each requested shape bucket (rounded up to
+    the engine's power-of-two buckets), plus the decode chunk at
+    T=decode_chunk — and T=1 where the drain-tail policy dispatches
+    single steps."""
+    import jax
+
+    from .engine import prefill_bucket
+
+    def _bucket(n: int) -> int:
+        # the live dispatch's rounding, by construction: one shared
+        # definition (engine.prefill_bucket) or warmed executables would
+        # pool at buckets the engine never looks up
+        return prefill_bucket(n, cfg.seq_len)
+
+    plan: List[Tuple[str, int]] = []
+    for b in sorted({_bucket(int(x)) for x in buckets}):
+        plan.append(("prefill", b))
+        plan.append(("suffix", b))
+    if buckets:
+        plan.append(("chunk", cfg.decode_chunk))
+        dt = cfg.drain_tail
+        if dt == "auto":
+            dt = "chunk" if jax.default_backend() == "tpu" else "single"
+        if dt == "single":
+            plan.append(("chunk", 1))
+    return plan
+
+
+# -- abstract avals -----------------------------------------------------------
+
+
+def _abstract_state(cfg, sharding):
+    """Param-tree and KV-pool avals for `cfg`, with the single-device
+    committed sharding the engine actually uses — shapes come from the
+    registry's init (the same source of truth as the HF loader), so no
+    weights are touched."""
+    import jax
+
+    from ..models.registry import init_params_for
+
+    m = cfg.model
+    params = jax.eval_shape(
+        lambda k: init_params_for(k, m), jax.random.key(0)
+    )
+    params = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
+        params,
+    )
+    kv = jax.ShapeDtypeStruct(
+        (m.num_layers, cfg.num_pages, cfg.page_size, m.num_kv_heads,
+         m.head_dim),
+        m.dtype,
+        sharding=sharding,
+    )
+    return params, (kv, kv)
+
+
+def abstract_args(cfg, program: str, bucket: int) -> list:
+    """The abstract call signature of one engine program, matching the
+    live engine's dispatch exactly: params/cache/scheduler arrays are
+    committed device arrays (sharded avals); per-request host mirrors
+    (tokens, temps, counts rows, keys) arrive as numpy and stay
+    placement-free."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    sharding = SingleDeviceSharding(jax.devices()[0])
+    m = cfg.model
+    V = m.vocab_size
+    b, p = cfg.max_batch, cfg.pages_per_seq
+    params, cache = _abstract_state(cfg, sharding)
+    A = jax.ShapeDtypeStruct
+    f32, i32, u32 = jnp.float32, jnp.int32, jnp.uint32
+    if program in ("prefill", "prefill_plp"):
+        return [
+            params, A((1, bucket), i32), A((1,), i32), cache, A((1, p), i32),
+            A((1,), f32), A((1,), f32), A((1, V), i32), A((1,), f32),
+            A((1,), f32), A((2,), u32), A((1, V), f32),
+        ]
+    if program in ("suffix", "suffix_plp"):
+        return [
+            params, A((1, bucket), i32), A((1, bucket), i32), A((1,), i32),
+            A((1,), i32), cache, A((1, p), i32), A((1,), f32), A((1,), f32),
+            A((1, V), i32), A((1,), f32), A((1,), f32), A((2,), u32),
+            A((1, V), f32),
+        ]
+    if program == "chunk":
+        def S(shape, dt):
+            return A(shape, dt, sharding=sharding)
+
+        return [
+            params, S((b,), i32), S((b,), i32), S((b,), i32), cache,
+            S((b, p), i32), S((b,), f32), S((b,), f32), S((b, V), i32),
+            S((b,), f32), S((b,), f32), S((b, 2), u32), S((b,), i32),
+            S((b, V), f32),
+        ]
+    raise ValueError(f"unknown warmup program {program!r}")
+
+
+def compile_program(cfg, program: str, bucket: int, programs=None):
+    """AOT-compile one engine program for `cfg` at `bucket`:
+    ``jit(fn).lower(*avals).compile()`` — host-CPU work only. Returns the
+    ``jax.stages.Compiled`` executable."""
+    from .engine import ProgramSet
+
+    cfg = _normalize_cfg(cfg)
+    ps = programs or ProgramSet(
+        cfg.model, cfg.logprobs_topk, cfg.eos_token_id
+    )
+    if program == "chunk":
+        fn = ps.chunk(int(bucket))
+    else:
+        fn = {
+            "prefill": ps.prefill,
+            "prefill_plp": ps.prefill_plp,
+            "suffix": ps.suffix,
+            "suffix_plp": ps.suffix_plp,
+        }[program]
+    return fn.lower(*abstract_args(cfg, program, bucket)).compile()
+
+
+def executable_nbytes(compiled, default: int = DEFAULT_EXEC_NBYTES) -> int:
+    """Host footprint estimate for pool accounting: XLA's generated-code
+    size when the backend reports one (CPU reports 0), else a nominal
+    default — the budget bounds entry COUNT honestly either way."""
+    try:
+        ma = compiled.memory_analysis()
+        nb = int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        return nb if nb > 0 else default
+    except Exception:  # noqa: BLE001 — backend-optional API
+        return default
+
+
+# -- the pool -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ExecEntry:
+    key: str
+    compiled: Any
+    nbytes: int
+    compile_s: float = 0.0
+    stored_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class ExecutablePool:
+    """Bounded LRU of AOT-compiled executables (see module docstring).
+
+    ``budget_bytes <= 0`` disables pooling (every ``put`` is dropped, every
+    ``get`` is a miss) — warmup still hands executables straight to the
+    engine being built, the pool only adds reuse across builds.
+
+    ``on_event(kind)`` (kind in hit|miss|eviction) lets the owning service
+    mirror pool traffic into Prometheus counters without this module
+    importing prometheus. Thread-safe: warmup threads put while /metrics
+    reads."""
+
+    def __init__(
+        self,
+        budget_bytes: int = 0,
+        spill_dir: str = "",
+        on_event: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.budget_bytes = int(budget_bytes)
+        self.spill_dir = spill_dir or ""
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[str, ExecEntry]" = OrderedDict()
+        self._on_event = on_event or (lambda kind: None)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.spill_hits = 0
+        self.spill_errors = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._entries
+
+    @property
+    def bytes_used(self) -> int:
+        with self._mu:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def keys(self) -> List[str]:
+        with self._mu:
+            return list(self._entries)
+
+    # -- get / put -----------------------------------------------------------
+
+    def get(self, key: str) -> Optional[Any]:
+        """Executable for `key` (LRU-touched), trying a spill reload on an
+        in-memory miss; None = genuine miss (the caller compiles)."""
+        with self._mu:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                self._on_event("hit")
+                return entry.compiled
+        # a disabled pool (budget <= 0) must not serve spilled blobs from
+        # prior runs either — every get is a genuine miss
+        compiled, nbytes = (
+            self._load_spilled(key) if self.budget_bytes > 0 else (None, 0)
+        )
+        if compiled is not None:
+            with self._mu:
+                self.hits += 1
+                self.spill_hits += 1
+                self._on_event("hit")
+            # re-registers as MRU (re-spilling skipped: the file exists).
+            # A blob bigger than the budget — it shrank across a restart —
+            # is served this once but not re-registered: a bounce per get
+            # would grow the eviction counter without any budget churn.
+            if nbytes <= self.budget_bytes:
+                self.put(key, compiled, nbytes, spill=False)
+            return compiled
+        with self._mu:
+            self.misses += 1
+            self._on_event("miss")
+        return None
+
+    def put(
+        self,
+        key: str,
+        compiled: Any,
+        nbytes: Optional[int] = None,
+        compile_s: float = 0.0,
+        spill: bool = True,
+    ) -> List[ExecEntry]:
+        """Register an executable as MRU and evict LRU entries until the
+        byte budget holds; write-through spill (when supported) so the
+        entry survives an instance restart. Returns the evicted entries."""
+        nb = int(nbytes if nbytes is not None else executable_nbytes(compiled))
+        entry = ExecEntry(key=key, compiled=compiled, nbytes=nb,
+                          compile_s=compile_s)
+        if self.budget_bytes <= 0:
+            # pooling disabled: drop outright — no write-through spill (a
+            # spilled blob would come back as a disk hit on the next get,
+            # contradicting the "0 disables pooling" contract) and no
+            # eviction count (that metric means budget pressure / device
+            # release, not a disabled pool)
+            return [entry]
+        if nb > self.budget_bytes:
+            # an entry that can never fit bounces itself — and is NOT
+            # spilled: a persisted blob would reload, re-bounce, and
+            # re-count an eviction on every later get of the same key
+            with self._mu:
+                self._entries.pop(key, None)
+                self.evictions += 1
+                self._on_event("eviction")
+            return [entry]
+        if spill:
+            self._spill(entry)
+        evicted: List[ExecEntry] = []
+        with self._mu:
+            # a same-key re-put is a refresh, not an eviction: the old
+            # entry is replaced silently (no counter, not returned) — the
+            # eviction metric means budget pressure / device release only
+            self._entries.pop(key, None)
+            self._entries[key] = entry
+            while (
+                sum(e.nbytes for e in self._entries.values())
+                > self.budget_bytes
+            ):
+                _, victim = self._entries.popitem(last=False)
+                evicted.append(victim)
+            self.evictions += len(evicted)
+            for _ in evicted:
+                self._on_event("eviction")
+        return evicted
+
+    def drop_live(self) -> int:
+        """Drop every in-memory executable (device release: they belong to
+        the client being destroyed). Spilled copies stay on disk — a later
+        ``get`` re-validates by reloading them on backends where spill is
+        trusted."""
+        with self._mu:
+            n = len(self._entries)
+            self._entries.clear()
+            self.evictions += n
+            for _ in range(n):
+                self._on_event("eviction")
+            return n
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "entries": len(self._entries),
+            "bytes_used": self.bytes_used,
+            "budget_bytes": self.budget_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "spill_hits": self.spill_hits,
+            "spill_errors": self.spill_errors,
+            "spill_dir": self.spill_dir if self._spill_enabled() else "",
+        }
+
+    # -- spill ----------------------------------------------------------------
+
+    def _spill_enabled(self) -> bool:
+        return bool(self.spill_dir) and spill_supported()
+
+    def _spill_path(self, key: str) -> str:
+        return os.path.join(self.spill_dir, sha256_hex(key) + ".exec")
+
+    def _spill(self, entry: ExecEntry) -> bool:
+        if not self._spill_enabled():
+            return False
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                entry.compiled
+            )
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = self._spill_path(entry.key)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    {
+                        "key": entry.key,
+                        "nbytes": entry.nbytes,
+                        "payload": payload,
+                        "in_tree": in_tree,
+                        "out_tree": out_tree,
+                    },
+                    f,
+                )
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+            return True
+        except Exception:  # noqa: BLE001 — spill is best-effort
+            self.spill_errors += 1
+            logger.warning(
+                "executable spill failed for %s", entry.key, exc_info=True
+            )
+            return False
+
+    def _load_spilled(self, key: str) -> Tuple[Optional[Any], int]:
+        if not self._spill_enabled():
+            return None, 0
+        path = self._spill_path(key)
+        if not os.path.isfile(path):
+            return None, 0
+        try:
+            from jax.experimental import serialize_executable
+
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            if blob.get("key") != key:  # hash collision paranoia
+                return None, 0
+            compiled = serialize_executable.deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"]
+            )
+            return compiled, int(blob.get("nbytes", DEFAULT_EXEC_NBYTES))
+        except Exception:  # noqa: BLE001 — a stale/corrupt spill is a miss
+            self.spill_errors += 1
+            logger.warning(
+                "spilled executable reload failed for %s", key,
+                exc_info=True,
+            )
+            return None, 0
+
+
+# -- the warmup driver --------------------------------------------------------
+
+
+class WarmupTask:
+    """Background AOT warmup for one incoming engine config.
+
+    Kicked by the service *before* the swap/prefetch/cold-load transfer
+    starts; compiles (or pool-fetches) every (program, bucket) in
+    ``warmup_plan`` on a daemon thread, then the service joins it via
+    ``install(engine)`` once the weights have landed. ``abort()`` stops it
+    between compiles (swap cancellation).
+
+    ``overlap_stats(window)`` reports how much of the compile work rode
+    under a transfer window — ``hidden_frac`` is compile seconds hidden
+    under transfer ÷ total compile seconds, the headline the swap bench
+    emits as ``overlap_hidden_compile_frac``.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        buckets,
+        pool: Optional[ExecutablePool] = None,
+        mesh=None,
+        trace_parent=None,
+        on_program: Optional[Callable[[str, float], None]] = None,
+        start: bool = True,
+    ) -> None:
+        self.cfg = _normalize_cfg(cfg)
+        self.pool = pool
+        self.signature = exec_signature(self.cfg)
+        self.plan = warmup_plan(self.cfg, buckets)
+        self.results: Dict[Tuple[str, int], Any] = {}
+        self.stats: Dict[str, Any] = {
+            "programs": len(self.plan),
+            "compiled": 0,
+            "pool_hits": 0,
+            "compile_s": 0.0,
+            "aborted": False,
+            "errors": [],
+            "skipped": "",
+        }
+        self.t_start: Optional[float] = None
+        self.t_end: Optional[float] = None
+        #: set by the service to the transfer-window start, so hidden-
+        #: compile accounting starts at the swap edge, not thread spawn
+        self.window_start: Optional[float] = None
+        self._abort = threading.Event()
+        #: set by abort(drop_results=True): an in-flight compile's result
+        #: must ALSO be discarded (device release — it belongs to the
+        #: PJRT client being destroyed), not just the remaining plan
+        self._drop_results = False
+        #: guards `results` — the compile thread inserts while install()
+        #: snapshots (an unguarded dict iteration can raise mid-install)
+        self._results_mu = threading.Lock()
+        self._trace_parent = trace_parent
+        self._on_program = on_program
+        self._thread: Optional[threading.Thread] = None
+        if mesh is not None:
+            # sharded engines fall back to first-touch jit + the
+            # persistent cache: abstract NamedSharding avals for every
+            # program variant are not plumbed yet (the pool key already
+            # carries the mesh shape for when they are)
+            self.stats["skipped"] = "mesh"
+            self.t_start = self.t_end = time.monotonic()
+        elif not self.plan:
+            self.stats["skipped"] = "no buckets"
+            self.t_start = self.t_end = time.monotonic()
+        elif start:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None or self.stats["skipped"]:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="aot-warmup"
+        )
+        self._thread.start()
+
+    def abort(self, drop_results: bool = False) -> None:
+        """Stop compiling between programs (swap cancellation): already-
+        compiled executables stay pooled — the work is not wasted, the
+        next attempt pool-hits them. ``drop_results=True`` (device
+        release) additionally discards an in-flight compile's result
+        instead of pooling it: the executable would belong to the PJRT
+        client being destroyed, and a later pool hit would install a
+        dead-client executable."""
+        if drop_results:
+            self._drop_results = True
+        self._abort.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def install(self, engine, timeout: Optional[float] = None) -> int:
+        """Join the task and hand every executable to the engine. The
+        caller is responsible for signature-checking against the built
+        engine (the service compares ``exec_signature(engine.cfg)``)."""
+        if not self.wait(timeout):
+            # pathological compile outlasting the timeout: stop between
+            # programs and install what finished (the rest jit-compiles)
+            self.abort()
+            self.wait(5)
+        with self._results_mu:
+            snapshot = list(self.results.items())
+        n = 0
+        for (program, bucket), compiled in snapshot:
+            engine.install_executable(program, bucket, compiled)
+            n += 1
+        return n
+
+    def overlap_stats(
+        self, window_t0: Optional[float] = None,
+        window_t1: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        t0 = self.window_start if self.window_start is not None else self.t_start
+        w0 = window_t0 if window_t0 is not None else t0
+        w1 = window_t1 if window_t1 is not None else time.monotonic()
+        hidden = 0.0
+        if self.t_start is not None and self.t_end is not None and w0 is not None:
+            hidden = max(0.0, min(self.t_end, w1) - max(self.t_start, w0))
+        compile_s = self.stats["compile_s"]
+        frac = min(1.0, hidden / compile_s) if compile_s > 0 else 0.0
+        return {
+            "programs": self.stats["programs"],
+            "compiled": self.stats["compiled"],
+            "pool_hits": self.stats["pool_hits"],
+            "compile_s": round(compile_s, 6),
+            "hidden_s": round(min(hidden, compile_s), 6),
+            "hidden_frac": round(frac, 6),
+            "aborted": self.stats["aborted"],
+            "errors": list(self.stats["errors"]),
+            "skipped": self.stats["skipped"],
+            "signature": self.signature,
+        }
+
+    # -- thread body ----------------------------------------------------------
+
+    def _run(self) -> None:
+        from .engine import ProgramSet
+
+        self.t_start = time.monotonic()
+        root = tracing.begin(
+            "warmup.overlap",
+            parent=self._trace_parent,
+            activate=False,
+            signature=self.signature,
+            programs=len(self.plan),
+        )
+        traced = root is not tracing.NOOP_SPAN
+        root_ctx = root.context() if traced else None
+        ps = None
+        # fma_engine_warmup_seconds{program} is a gauge: report the
+        # CUMULATIVE compile seconds per program, not the last bucket's —
+        # with several --warmup-buckets a per-bucket .set() would
+        # undercount prefill/suffix by every bucket but the final one
+        per_program: Dict[str, float] = {}
+        try:
+            for program, bucket in self.plan:
+                if self._abort.is_set():
+                    self.stats["aborted"] = True
+                    break
+                key = exec_key(self.signature, program, bucket)
+                compiled = self.pool.get(key) if self.pool is not None else None
+                if compiled is not None:
+                    with self._results_mu:
+                        self.results[(program, bucket)] = compiled
+                    self.stats["pool_hits"] += 1
+                    continue
+                sp = None
+                if traced:
+                    sp = tracing.begin(
+                        "warmup.compile", parent=root_ctx, activate=False,
+                        program=program, bucket=bucket,
+                    )
+                t0 = time.monotonic()
+                try:
+                    if ps is None:
+                        ps = ProgramSet(
+                            self.cfg.model,
+                            self.cfg.logprobs_topk,
+                            self.cfg.eos_token_id,
+                        )
+                    compiled = compile_program(
+                        self.cfg, program, bucket, programs=ps
+                    )
+                except Exception as e:  # noqa: BLE001 — warmup never fails a swap
+                    self.stats["errors"].append(
+                        f"{program}@{bucket}: {type(e).__name__}: {e}"
+                    )
+                    if sp is not None:
+                        sp.set(error=f"{type(e).__name__}: {e}")
+                        sp.end()
+                    logger.warning(
+                        "AOT warmup compile failed for %s@%s", program,
+                        bucket, exc_info=True,
+                    )
+                    continue
+                secs = time.monotonic() - t0
+                if sp is not None:
+                    sp.set(seconds=round(secs, 6))
+                    sp.end()
+                self.stats["compile_s"] += secs
+                if self._abort.is_set() and self._drop_results:
+                    # aborted by a device release while this compile was
+                    # in flight: the executable is owned by the client
+                    # being torn down — pooling it would hand a later
+                    # build a dead-client executable
+                    self.stats["aborted"] = True
+                    break
+                self.stats["compiled"] += 1
+                with self._results_mu:
+                    self.results[(program, bucket)] = compiled
+                if self.pool is not None:
+                    self.pool.put(
+                        key, compiled, executable_nbytes(compiled),
+                        compile_s=secs,
+                    )
+                if self._on_program is not None:
+                    per_program[program] = per_program.get(program, 0.0) + secs
+                    self._on_program(program, per_program[program])
+        finally:
+            self.t_end = time.monotonic()
+            root.set(
+                compiled=self.stats["compiled"],
+                pool_hits=self.stats["pool_hits"],
+                compile_s=round(self.stats["compile_s"], 6),
+                aborted=self.stats["aborted"],
+            )
+            root.end()

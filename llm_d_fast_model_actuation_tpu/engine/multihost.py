@@ -32,6 +32,8 @@ compiles exactly once.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import logging
 import os
 import socket
@@ -43,6 +45,25 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+#: shared-secret env for heartbeat authentication: every gang member gets
+#: the same value from the coordinator's ISC env. Unset falls back to a
+#: fixed default — the token then only proves "same coordinator address",
+#: which still stops a stray prober on a hostNetwork node from keeping a
+#: half-dead gang looking alive.
+GANG_HB_SECRET_ENV = "FMA_GANG_HB_SECRET"
+
+
+def gang_heartbeat_token(coordinator_address: str) -> str:
+    """Per-gang heartbeat token: HMAC of the coordinator address under the
+    shared secret. Binds a ping to THIS gang — two gangs whose heartbeat
+    ports collide across restarts (the port is derived, not reserved)
+    can no longer accept each other's pings, and an unauthenticated
+    writer can't refresh a member's liveness."""
+    secret = os.environ.get(GANG_HB_SECRET_ENV, "") or "fma-gang"
+    return hmac.new(
+        secret.encode(), coordinator_address.encode(), hashlib.sha256
+    ).hexdigest()[:16]
 
 #: Heartbeat port = coordinator port + this offset. The gang coordinator
 #: draws per-gang coordinator ports from [base, base+4096) (controller/
@@ -104,6 +125,10 @@ class GangWatchdog:
         self.num_processes = num_processes
         self.leader_host = host
         self.hb_port = int(port) + HEARTBEAT_PORT_OFFSET
+        #: per-gang auth token (see gang_heartbeat_token): carried in
+        #: every ping, verified by the responder — an unauthenticated
+        #: ping refreshes nothing and gets no "ok"
+        self.token = gang_heartbeat_token(coordinator_address)
         # a timeout needs several missed pings' slack, or scheduler jitter
         # on a single late ping reads as a death: keep >= 4 intervals per
         # timeout window by shrinking the interval for small timeouts
@@ -164,12 +189,22 @@ class GangWatchdog:
 
     def _start_responder(self) -> None:
         last_seen = self._last_seen
+        token = self.token
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self) -> None:
                 try:
-                    line = self.rfile.readline(64).decode().split()
-                    if len(line) == 2 and line[0] == "hb":
+                    line = self.rfile.readline(96).decode().split()
+                    # "hb <pid> <token>": the token must verify or the
+                    # ping neither refreshes liveness nor gets an "ok" —
+                    # a stray/foreign prober can't keep a dead member
+                    # looking alive (constant-time compare: the token is
+                    # a shared-secret MAC, not a public cookie)
+                    if (
+                        len(line) == 3
+                        and line[0] == "hb"
+                        and hmac.compare_digest(line[2], token)
+                    ):
                         last_seen[int(line[1])] = time.monotonic()
                         self.wfile.write(b"ok\n")
                 except (ValueError, OSError):
@@ -180,7 +215,20 @@ class GangWatchdog:
             # attribute would flip SO_REUSEADDR on for unrelated servers
             allow_reuse_address = True
 
-        self._server = _HBServer(("0.0.0.0", self.hb_port), Handler)
+        try:
+            self._server = _HBServer(("0.0.0.0", self.hb_port), Handler)
+        except OSError as e:
+            # name the port-derivation scheme: "address already in use" on
+            # a number nobody configured is otherwise undebuggable
+            raise RuntimeError(
+                f"gang heartbeat responder failed to bind "
+                f"0.0.0.0:{self.hb_port} (= coordinator port "
+                f"{self.hb_port - HEARTBEAT_PORT_OFFSET} + "
+                f"HEARTBEAT_PORT_OFFSET {HEARTBEAT_PORT_OFFSET}; the "
+                f"gang coordinator draws coordinator ports from a range "
+                f"whose +{HEARTBEAT_PORT_OFFSET} offset must stay free "
+                f"on this node): {e}"
+            ) from e
         self._server.daemon_threads = True
         t = threading.Thread(
             target=self._server.serve_forever, daemon=True,
@@ -220,7 +268,7 @@ class GangWatchdog:
             with socket.create_connection(
                 (self.leader_host, self.hb_port), timeout=self.interval + 1
             ) as s:
-                s.sendall(f"hb {self.process_id}\n".encode())
+                s.sendall(f"hb {self.process_id} {self.token}\n".encode())
                 s.settimeout(self.interval + 1)
                 return s.recv(8).startswith(b"ok")
         except OSError:

@@ -189,6 +189,37 @@ ENGINE_PREFETCH_BYTES = Gauge(
     "Host bytes staged by the last completed prefetch",
 )
 
+# AOT warmup + executable pool (docs/perf.md "Warmup and the executable
+# pool"): first-touch compiles were the tail that wagged TTFT after the
+# streaming loaders fixed weight movement — these say whether the compile
+# work is riding under transfers (warmup seconds per program) and whether
+# rebuilds are reusing executables instead of recompiling (pool traffic).
+ENGINE_WARMUP_SECONDS = Gauge(
+    "fma_engine_warmup_seconds",
+    "AOT warmup compile seconds by program (last warmup)",
+    ["program"],
+)
+ENGINE_EXEC_POOL_HITS = Counter(
+    "fma_engine_exec_pool_hits_total",
+    "Executable-pool lookups served without compiling",
+)
+ENGINE_EXEC_POOL_MISSES = Counter(
+    "fma_engine_exec_pool_misses_total",
+    "Executable-pool lookups that had to compile",
+)
+ENGINE_EXEC_POOL_EVICTIONS = Counter(
+    "fma_engine_exec_pool_evictions_total",
+    "Executables evicted from the pool (budget pressure or device release)",
+)
+ENGINE_EXEC_POOL_BYTES = Gauge(
+    "fma_engine_exec_pool_bytes",
+    "Estimated host bytes held by pooled executables",
+)
+ENGINE_EXEC_POOL_ENTRIES = Gauge(
+    "fma_engine_exec_pool_entries",
+    "Executables resident in the pool",
+)
+
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
@@ -340,6 +371,26 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "DMA window to ~one bucket per direction",
     )
     p.add_argument(
+        "--exec-pool-mib",
+        type=int,
+        default=256,
+        help="host byte budget (MiB) for the AOT executable pool "
+        "(engine/exec_pool.py): compiled prefill/suffix/decode programs "
+        "are pooled across swaps keyed by (config hash, mesh, dtype, "
+        "bucket), so a rebuild of a previously-seen model recompiles "
+        "nothing; 0 disables pooling (warmed executables still install "
+        "into the engine being built)",
+    )
+    p.add_argument(
+        "--warmup-buckets",
+        default="",
+        help="comma-separated prefill token buckets to AOT-precompile "
+        "concurrently with swap/prefetch weight transfers (rounded up to "
+        "the engine's power-of-two buckets; also warms the suffix-prefill "
+        "and decode-chunk programs). Empty disables warmup — first-touch "
+        "jit compile, the pre-existing behavior (docs/perf.md)",
+    )
+    p.add_argument(
         "--load-workers",
         type=int,
         default=0,
@@ -445,6 +496,11 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
         raise ValueError("--swap-bucket-mib must be >= 1")
+    if getattr(args, "exec_pool_mib", 0) < 0:
+        raise ValueError("--exec-pool-mib must be >= 0")
+    from .exec_pool import parse_warmup_buckets
+
+    parse_warmup_buckets(getattr(args, "warmup_buckets", ""))
     if getattr(args, "load_workers", 0) < 0:
         raise ValueError("--load-workers must be >= 0 (0 = auto)")
     if getattr(args, "load_inflight_mib", 1) < 1:
@@ -602,6 +658,27 @@ class EngineService:
         self._swap_bucket_bytes = (
             max(1, getattr(args, "swap_bucket_mib", 256)) << 20
         )
+        # AOT executable pool + warmup plan (engine/exec_pool.py): compiled
+        # programs pooled beside the host model pool, with spill into the
+        # launcher's persistent compile-cache dir so entries survive
+        # instance restarts (docs/perf.md "Warmup and the executable pool").
+        from .exec_pool import (
+            ExecutablePool,
+            default_spill_dir,
+            parse_warmup_buckets,
+        )
+
+        self._warmup_buckets = parse_warmup_buckets(
+            getattr(args, "warmup_buckets", "")
+        )
+        self.exec_pool = ExecutablePool(
+            budget_bytes=max(0, getattr(args, "exec_pool_mib", 256)) << 20,
+            spill_dir=default_spill_dir(),
+            on_event=self._exec_pool_event,
+        )
+        #: the most recent WarmupTask (observability + tests: abort-on-
+        #: cancellation and hidden-compile accounting are asserted on it)
+        self._last_warmup: Optional[Any] = None
         #: cold runtime builds (checkpoint / HF read or random init); a
         #: pool hit on swap does NOT increment it — the zero-re-read
         #: contract the swap e2e test pins
@@ -691,6 +768,82 @@ class EngineService:
                     victim.model_id, why, exc_info=True,
                 )
 
+    def _exec_pool_event(self, kind: str) -> None:
+        """Mirror executable-pool traffic into Prometheus (the pool itself
+        never imports prometheus)."""
+        if kind == "hit":
+            ENGINE_EXEC_POOL_HITS.inc()
+        elif kind == "miss":
+            ENGINE_EXEC_POOL_MISSES.inc()
+        elif kind == "eviction":
+            ENGINE_EXEC_POOL_EVICTIONS.inc()
+
+    def _start_warmup(
+        self, model_id: str, resolved: Optional[tuple] = None
+    ) -> Optional[Any]:
+        """Kick the AOT warmup task for an incoming `model_id` (None =
+        warmup disabled or unsupported): resolves the incoming config
+        exactly like the build will and starts compiling on a background
+        thread (engine/exec_pool.py). Callers that already ran
+        ``_resolve_model`` pass its tuple as ``resolved`` — the resolve
+        loads the tokenizer from disk, which must not run twice on the
+        swap critical path. Never raises — warmup must never fail a swap;
+        worst case the build falls back to first-touch jit."""
+        if not self._warmup_buckets:
+            return None
+        if self.args.tensor_parallel_size > 1 or self.is_follower:
+            # sharded/gang engines fall back to first-touch jit + the
+            # persistent cache (exec_pool.WarmupTask skips meshes)
+            return None
+        try:
+            if resolved is None:
+                resolved = self._resolve_model(model_id)
+            model_cfg, eos, extra_eos = resolved[0], resolved[1], resolved[2]
+            cfg = self._engine_cfg_for(model_cfg, eos, extra_eos)
+            from .exec_pool import WarmupTask
+
+            task = WarmupTask(
+                cfg,
+                self._warmup_buckets,
+                pool=self.exec_pool,
+                trace_parent=tracing.current_context(),
+                on_program=lambda program, secs: ENGINE_WARMUP_SECONDS.labels(
+                    program=program
+                ).set(secs),
+            )
+            self._last_warmup = task
+            return task
+        except Exception:  # noqa: BLE001 — warmup is strictly best-effort
+            logger.warning(
+                "AOT warmup start failed for %s", model_id, exc_info=True
+            )
+            return None
+
+    def _reinstall_executables(self) -> int:
+        """Wake re-validates the executable pool instead of recompiling:
+        pool entries for the engine's config (including spill reloads,
+        where reload is trusted) are reinstalled into the engine's AOT
+        table; anything missing jit-compiles on first touch through the
+        persistent cache — the pre-existing wake behavior."""
+        if not self._warmup_buckets or self.engine.mesh is not None:
+            return 0
+        from .exec_pool import exec_key, exec_signature, warmup_plan
+
+        eng = self.engine
+        try:
+            sig = exec_signature(eng.cfg)
+        except Exception:  # noqa: BLE001 — revalidation is best-effort
+            return 0
+        n = 0
+        for program, bucket in warmup_plan(eng.cfg, self._warmup_buckets):
+            if (program, bucket) in eng._aot:
+                continue
+            compiled = self.exec_pool.get(exec_key(sig, program, bucket))
+            if compiled is not None:
+                eng.install_executable(program, bucket, compiled)
+                n += 1
+        return n
+
     @contextlib.contextmanager
     def _admin_lock(self):
         """The step lock, for admin edges (sleep/wake/swap): registers as a
@@ -708,38 +861,12 @@ class EngineService:
 
     # -- model runtimes (build / install / hot-swap) -------------------------
 
-    def _build_runtime(
-        self,
-        model_id: str,
-        checkpoint_dir: str = "",
-        staged_params: Optional[Dict[str, Any]] = None,
-    ) -> _ModelRuntime:
-        """Traced wrapper around the cold build: the `with` form ends the
-        span (stamping the error) even when the build raises — the
-        cold-swap failure path must not leak an open span."""
-        with tracing.span(
-            "engine.build_runtime",
-            model=model_id,
-            checkpoint_dir=checkpoint_dir,
-            staged=staged_params is not None,
-        ):
-            return self._build_runtime_impl(
-                model_id, checkpoint_dir, staged_params
-            )
-
-    def _build_runtime_impl(
-        self,
-        model_id: str,
-        checkpoint_dir: str = "",
-        staged_params: Optional[Dict[str, Any]] = None,
-    ) -> _ModelRuntime:
-        """Cold-build an awake runtime for `model_id`: config -> tokenizer
-        -> params (checkpoint / HF read, or random init) -> engine ->
-        sleeper. Pool hits on a slept runtime bypass this entirely;
-        `staged_params` (a prefetched host tree) skips the checkpoint read
-        and streams straight host -> device. Leaves the build's transfer
-        accounting in `_last_build_stats` so a pool-miss swap can report
-        its real H2D cost."""
+    def _resolve_model(self, model_id: str):
+        """Config + tokenizer + eos identity for `model_id` — shared by
+        the cold build AND the AOT warmup driver, which must derive the
+        SAME program shapes (the decode-chunk program embeds the eos id,
+        so a divergent resolution would compile the wrong program).
+        Returns (model_cfg, eos_token_id, extra_eos, hf_dir, tokenizer)."""
         args = self.args
         hf_dir = ""
         eos_token_id = args.eos_token_id
@@ -784,6 +911,85 @@ class EngineService:
                 if tokenizer.eos_token_id is not None
                 else -1
             )
+        return model_cfg, eos_token_id, extra_eos, hf_dir, tokenizer
+
+    def _engine_cfg_for(
+        self, model_cfg, eos_token_id: int, extra_eos: tuple
+    ) -> EngineConfig:
+        """The EngineConfig a runtime for `model_cfg` gets — one
+        definition, so the warmup driver's AOT compiles and the engine's
+        lazy jit always describe the same programs."""
+        args = self.args
+        import jax  # deliberately not module-level: parse-time must not touch a backend
+
+        return EngineConfig(
+            model=model_cfg,
+            max_batch=args.max_batch,
+            page_size=args.page_size,
+            num_pages=args.num_pages,
+            max_seq_len=args.max_model_len or 0,
+            eos_token_id=eos_token_id,
+            extra_eos_ids=extra_eos,
+            attention_impl=args.attention_impl,
+            decode_chunk=args.decode_chunk
+            or (32 if jax.default_backend() == "tpu" else 8),
+            pipeline_decode=(
+                getattr(args, "pipeline_decode", "off") == "on"
+            ),
+            drain_tail=getattr(args, "drain_tail", "auto"),
+            prefix_caching=args.prefix_caching == "on",
+            max_prefill_tokens=args.max_prefill_tokens,
+            speculative_ngram=args.speculative_ngram,
+            logprobs_topk=max(0, getattr(args, "logprobs_topk", 5)),
+        )
+
+    def _build_runtime(
+        self,
+        model_id: str,
+        checkpoint_dir: str = "",
+        staged_params: Optional[Dict[str, Any]] = None,
+        warmup: Optional[Any] = None,
+        resolved: Optional[tuple] = None,
+    ) -> _ModelRuntime:
+        """Traced wrapper around the cold build: the `with` form ends the
+        span (stamping the error) even when the build raises — the
+        cold-swap failure path must not leak an open span."""
+        with tracing.span(
+            "engine.build_runtime",
+            model=model_id,
+            checkpoint_dir=checkpoint_dir,
+            staged=staged_params is not None,
+        ):
+            return self._build_runtime_impl(
+                model_id, checkpoint_dir, staged_params, warmup, resolved
+            )
+
+    def _build_runtime_impl(
+        self,
+        model_id: str,
+        checkpoint_dir: str = "",
+        staged_params: Optional[Dict[str, Any]] = None,
+        warmup: Optional[Any] = None,
+        resolved: Optional[tuple] = None,
+    ) -> _ModelRuntime:
+        """Cold-build an awake runtime for `model_id`: config -> tokenizer
+        -> params (checkpoint / HF read, or random init) -> engine ->
+        sleeper. Pool hits on a slept runtime bypass this entirely;
+        `staged_params` (a prefetched host tree) skips the checkpoint read
+        and streams straight host -> device. Leaves the build's transfer
+        accounting in `_last_build_stats` so a pool-miss swap can report
+        its real H2D cost.
+
+        ``warmup`` (a WarmupTask kicked before the transfer started) is
+        joined AFTER the weights land and its executables installed into
+        the new engine — the build completes with warm weights AND warm
+        executables, compile having ridden under the DMA. ``resolved`` is
+        an already-computed ``_resolve_model`` tuple (the swap path
+        resolves once and shares it with the warmup kick)."""
+        args = self.args
+        if resolved is None:
+            resolved = self._resolve_model(model_id)
+        model_cfg, eos_token_id, extra_eos, hf_dir, tokenizer = resolved
         mesh = None
         if args.tensor_parallel_size > 1:
             from ..parallel.mesh import MeshPlan, make_mesh
@@ -853,26 +1059,7 @@ class EngineService:
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
         engine = InferenceEngine(
-            EngineConfig(
-                model=model_cfg,
-                max_batch=args.max_batch,
-                page_size=args.page_size,
-                num_pages=args.num_pages,
-                max_seq_len=args.max_model_len or 0,
-                eos_token_id=eos_token_id,
-                extra_eos_ids=extra_eos,
-                attention_impl=args.attention_impl,
-                decode_chunk=args.decode_chunk
-                or (32 if jax.default_backend() == "tpu" else 8),
-                pipeline_decode=(
-                    getattr(args, "pipeline_decode", "off") == "on"
-                ),
-                drain_tail=getattr(args, "drain_tail", "auto"),
-                prefix_caching=args.prefix_caching == "on",
-                max_prefill_tokens=args.max_prefill_tokens,
-                speculative_ngram=args.speculative_ngram,
-                logprobs_topk=max(0, getattr(args, "logprobs_topk", 5)),
-            ),
+            self._engine_cfg_for(model_cfg, eos_token_id, extra_eos),
             params=params,
             mesh=mesh,
             seed=args.seed,
@@ -887,6 +1074,28 @@ class EngineService:
                 {"p": engine.params, "kv": engine.pool.as_tuple()}
             )
         )
+        if warmup is not None:
+            # The transfer is over: join the AOT warmup (it usually
+            # finished under the DMA) and hand its executables to the new
+            # engine. Signature-checked against the BUILT engine — the
+            # warmup resolved its config through the same _resolve_model,
+            # but an executable compiled for the wrong eos/shape must
+            # never install silently.
+            from .exec_pool import exec_signature
+
+            t_transfer1 = time.monotonic()
+            if warmup.signature == exec_signature(engine.cfg):
+                warmup.install(engine, timeout=600)
+            else:
+                warmup.abort()
+                warmup.wait(5)
+                warmup.stats["errors"].append(
+                    "signature mismatch with built engine; not installed"
+                )
+            build_stats["warmup"] = warmup.overlap_stats(
+                window_t1=t_transfer1
+            )
+            self._last_warmup = warmup
         self._last_build_stats = build_stats
         sleeper = attach_sleep(engine, bucket_bytes=self._swap_bucket_bytes)
         self.builds_total += 1
@@ -1030,6 +1239,10 @@ class EngineService:
             prefetched = pool_hit and isinstance(
                 entry.runtime, _PrefetchedWeights
             )
+            # AOT warmup accounting for this swap: a slept-runtime pool
+            # hit keeps its compiled programs (nothing to warm); the cold
+            # and prefetched paths fill this from the build below.
+            warm_stats: Optional[Dict[str, Any]] = None
             if pool_hit and not prefetched:
                 rt = entry.runtime
                 try:
@@ -1086,17 +1299,51 @@ class EngineService:
                 # size), then build the new one into the freed space. A
                 # prefetched entry skips the checkpoint read — its staged
                 # host tree streams straight to device inside the build.
-                self.sleeper.sleep(1)
+                # The incoming model's AOT warmup is kicked BEFORE the
+                # outgoing offload: compilation is host-CPU work over
+                # abstract avals, so it rides under both DMA directions
+                # (engine/exec_pool.py); pool hits make it a no-op. The
+                # model is resolved ONCE here (tokenizer load included)
+                # and shared with the build — a resolution failure is
+                # deferred to the build, whose rollback path wakes the
+                # outgoing model.
+                resolved = None
+                try:
+                    resolved = self._resolve_model(model)
+                except Exception:  # noqa: BLE001 — the build re-raises it
+                    pass
+                warm = self._start_warmup(model, resolved=resolved)
+                if warm is not None:
+                    warm.window_start = time.monotonic()
+                try:
+                    self.sleeper.sleep(1)
+                except Exception:
+                    # the outgoing offload failed before the build even
+                    # started: don't leave the warmup thread compiling for
+                    # a swap that is already dead (each retry would kick
+                    # another, stacking orphan compile threads)
+                    if warm is not None:
+                        warm.abort()
+                    raise
                 try:
                     if prefetched:
                         rt = self._build_runtime(
                             model,
                             entry.runtime.checkpoint_dir,
                             staged_params=entry.runtime.params_host,
+                            warmup=warm,
+                            resolved=resolved,
                         )
                     else:
-                        rt = self._build_runtime(model, checkpoint_dir)
+                        rt = self._build_runtime(
+                            model, checkpoint_dir, warmup=warm,
+                            resolved=resolved,
+                        )
                 except Exception as build_exc:
+                    if warm is not None:
+                        # swap cancelled: stop compiling between programs
+                        # (what already compiled stays pooled for a retry)
+                        warm.abort()
                     # a failed build must not leave the chip serving nothing
                     try:
                         self.sleeper.wake_up()
@@ -1138,6 +1385,7 @@ class EngineService:
                 # the cold loader's read/H2D overlap, not a two-direction
                 # DMA overlap).
                 b = self._last_build_stats
+                warm_stats = b.get("warmup")
                 metrics = {
                     "swap_total_s": 0.0,  # finalized below
                     "d2h_s": outgoing.sleeper.stats.last_sleep_seconds,
@@ -1195,6 +1443,11 @@ class EngineService:
                 },
                 "builds_total": self.builds_total,
                 "pool": self.model_pool.describe(),
+                # hidden-compile accounting (None on a slept-runtime pool
+                # hit — its executables rode the pooled engine): what the
+                # bench reports as overlap_hidden_compile_frac
+                "warmup": warm_stats,
+                "exec_pool": self.exec_pool.describe(),
             }
             out = dict(self.last_swap)
         self._publish_usage()
@@ -1308,6 +1561,13 @@ class EngineService:
             "engine.prefetch", parent=trace_ctx, model=model
         )
         t0 = time.monotonic()
+        # Executables stage alongside weights: the warmup compiles on its
+        # own thread while this one reads shards, so a first-ever swap to
+        # a prefetched model finds warm weights AND warm executables in
+        # the pools — fully warm, zero compile on the swap edge.
+        warm = self._start_warmup(model)
+        if warm is not None:
+            warm.window_start = t0
         lstats = hf_models.LoadStats()
         try:
             faults.fire("prefetch.stage")
@@ -1323,6 +1583,8 @@ class EngineService:
                 stats=lstats,
             )
         except hf_models.LoadAborted:
+            if warm is not None:
+                warm.abort()
             ENGINE_PREFETCHES.labels(outcome="aborted").inc()
             self.last_prefetch = {
                 "state": "aborted",
@@ -1334,6 +1596,8 @@ class EngineService:
             worker_sp.end()
             return
         except Exception as e:  # noqa: BLE001 — surfaced via GET /v1/prefetch
+            if warm is not None:
+                warm.abort()
             logger.warning("prefetch of %s failed", model, exc_info=True)
             ENGINE_PREFETCHES.labels(outcome="failed").inc()
             self.last_prefetch = {
@@ -1345,6 +1609,11 @@ class EngineService:
             worker_sp.set(state="failed", error=f"{type(e).__name__}: {e}")
             worker_sp.end()
             return
+        # end of the staging window the compiles could hide under — stamped
+        # BEFORE joining the warmup thread below, or compile seconds spent
+        # after the staging finished would count as "hidden" and the
+        # reported hidden_frac would read ~1.0 regardless of actual overlap
+        t_staged = time.monotonic()
         import jax
 
         nbytes = sum(x.nbytes for x in jax.tree.leaves(staged))
@@ -1362,6 +1631,10 @@ class EngineService:
         if bounced:
             # raced a concurrent budget change / the estimate was low: the
             # staging cannot be kept
+            if warm is not None:
+                # same as the aborted/failed branches: stop compiling for
+                # a model that failed to stage (what compiled stays pooled)
+                warm.abort()
             ENGINE_PREFETCHES.labels(outcome="rejected").inc()
             self.last_prefetch = {
                 "state": "rejected",
@@ -1373,6 +1646,11 @@ class EngineService:
             worker_sp.set(state="rejected")
             worker_sp.end()
             return
+        warm_stats = None
+        if warm is not None:
+            # the staging window is the transfer the compiles hid under
+            warm.wait(600)
+            warm_stats = warm.overlap_stats(window_t1=t_staged)
         ENGINE_PREFETCHES.labels(outcome="completed").inc()
         ENGINE_PREFETCH_BYTES.set(nbytes)
         self.last_prefetch = {
@@ -1385,6 +1663,10 @@ class EngineService:
             "shards": lstats.shards,
             "workers": lstats.workers,
             "pool": self.model_pool.describe(),
+            # executables staged alongside the weights (exec_pool.py):
+            # what the first-ever swap to this model will pool-hit
+            "warmup": warm_stats,
+            "exec_pool": self.exec_pool.describe(),
         }
         worker_sp.set(state="completed", bytes=nbytes)
         worker_sp.end()
@@ -1686,16 +1968,32 @@ class EngineService:
                         "gangs (followers cannot replay the reinit)"
                     )
                 self.engine.lockstep.sleep(level, self.release_on_sleep)
-            if self.release_on_sleep and len(self.model_pool):
+            if self.release_on_sleep:
                 # Device release destroys the PJRT client that owns the
-                # pooled models' pinned-host state and host-resident
-                # executables — a later pool hit would stream from dead
-                # buffers. Drop the pool first (their next swap-in
-                # cold-builds), freeing the host copies while the client
-                # is still alive.
-                self._free_pooled(
-                    self.model_pool.drain(), "device release"
-                )
+                # pooled models' pinned-host state and every compiled
+                # executable — a later pool hit would stream from dead
+                # buffers. Drop everything client-owned while the client
+                # is still alive: the model pool (next swap-in
+                # cold-builds), the live executable-pool entries (spilled
+                # copies survive where reload is trusted), the engine's
+                # installed AOT table, and the last warmup task's results
+                # dict, which pins the same client-owned executables.
+                # Wake re-validates the executable pool.
+                if len(self.model_pool):
+                    self._free_pooled(
+                        self.model_pool.drain(), "device release"
+                    )
+                # a still-running warmup (e.g. kicked by an in-flight
+                # prefetch) must be fenced BEFORE the pool drop: left
+                # alone, it would finish its compile after drop_live()
+                # and re-pool an executable owned by the dead client
+                lw = self._last_warmup
+                if lw is not None:
+                    lw.abort(drop_results=True)
+                    lw.wait(5)
+                self.exec_pool.drop_live()
+                self.engine.clear_executables()
+                self._last_warmup = None
             out = self.sleeper.sleep(level, release=self.release_on_sleep)
         self._publish_usage()
         return out
@@ -1783,6 +2081,11 @@ class EngineService:
                 out = self.sleeper.wake_up(reinit=reinit)
             else:
                 out = self.sleeper.wake_up()
+            # wake must not recompile: compiled programs are host-resident
+            # and survive a plain sleep; after a device release the pool
+            # re-validates (reinstalling spilled/pooled executables)
+            # instead of recompiling
+            self._reinstall_executables()
         self._publish_usage()
         self._new_work.set()
         return out
@@ -2022,6 +2325,8 @@ def build_app(service: EngineService) -> web.Application:
         pool = service.model_pool
         ENGINE_POOL_BYTES.set(pool.bytes_used)
         ENGINE_POOL_MODELS.set(len(pool))
+        ENGINE_EXEC_POOL_BYTES.set(service.exec_pool.bytes_used)
+        ENGINE_EXEC_POOL_ENTRIES.set(len(service.exec_pool))
         return web.Response(
             body=generate_latest(),
             content_type="text/plain",

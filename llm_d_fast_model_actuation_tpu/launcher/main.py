@@ -24,6 +24,13 @@ def preload(compile_cache_dir: str) -> None:
     compilation cache (the TPU analogue of the reference's 'launcher imported
     vLLM before forking', launcher.py:836-885)."""
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", compile_cache_dir)
+    # Serialized-executable spill for the engine's AOT pool rides next to
+    # the XLA cache (engine/exec_pool.py): every child of this launcher
+    # shares the directory, so a pooled executable survives instance
+    # restarts and even seeds sibling instances of the same model.
+    os.environ.setdefault(
+        "FMA_EXEC_SPILL_DIR", os.path.join(compile_cache_dir, "exec-pool")
+    )
     os.makedirs(compile_cache_dir, exist_ok=True)
     import jax  # noqa: F401
 

@@ -1,0 +1,386 @@
+"""AOT executable pool + warmup (engine/exec_pool.py): key identity, LRU
+eviction under the byte budget, serialized-executable spill round trips,
+warmup-thread abort on swap cancellation, and the pool-hit swap contract
+(zero compile spans, bit-exact generations through AOT dispatch)."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from llm_d_fast_model_actuation_tpu.engine import exec_pool
+from llm_d_fast_model_actuation_tpu.engine.engine import (
+    EngineConfig,
+    InferenceEngine,
+)
+from llm_d_fast_model_actuation_tpu.engine.exec_pool import (
+    ExecutablePool,
+    WarmupTask,
+    exec_key,
+    exec_signature,
+    warmup_plan,
+)
+from llm_d_fast_model_actuation_tpu.models import llama
+
+pytestmark = pytest.mark.warmup
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        model=llama.LlamaConfig.tiny(), max_batch=2, page_size=8,
+        num_pages=32, max_seq_len=64,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# -- key identity -------------------------------------------------------------
+
+
+def test_signature_stable_and_config_sensitive():
+    cfg = tiny_cfg()
+    sig = exec_signature(cfg)
+    assert sig == exec_signature(tiny_cfg())  # deterministic
+    # any program-shaping knob moves the signature
+    assert sig != exec_signature(tiny_cfg(max_batch=4))
+    assert sig != exec_signature(tiny_cfg(num_pages=64))
+    assert sig != exec_signature(tiny_cfg(eos_token_id=2))
+    assert sig != exec_signature(tiny_cfg(logprobs_topk=0))
+    other_model = dataclasses.replace(
+        llama.LlamaConfig.tiny(), vocab_size=128
+    )
+    assert sig != exec_signature(tiny_cfg(model=other_model))
+    # mesh shape is part of the identity even before sharded warmup lands
+    assert sig != exec_signature(cfg, mesh_shape=(4,))
+    assert exec_signature(cfg, mesh_shape=(4,)) != exec_signature(
+        cfg, mesh_shape=(8,)
+    )
+
+
+def test_signature_matches_live_engine():
+    """The service computes the warmup signature from its pre-build
+    config and validates against the BUILT engine's cfg (which has the
+    attention impl threaded into the model) — they must agree or every
+    install would be rejected."""
+    cfg = tiny_cfg()
+    eng = InferenceEngine(cfg, seed=0)
+    assert exec_signature(cfg) == exec_signature(eng.cfg)
+
+
+def test_exec_key_varies_by_program_and_bucket():
+    sig = exec_signature(tiny_cfg())
+    keys = {
+        exec_key(sig, p, b)
+        for p in ("prefill", "suffix", "chunk")
+        for b in (16, 32)
+    }
+    assert len(keys) == 6
+
+
+def test_warmup_plan_buckets_round_up_and_dedupe():
+    cfg = tiny_cfg()
+    plan = warmup_plan(cfg, (3, 16, 17))  # 3 -> 16, 17 -> 32
+    prefills = [b for p, b in plan if p == "prefill"]
+    assert prefills == [16, 32]
+    suffixes = [b for p, b in plan if p == "suffix"]
+    assert suffixes == [16, 32]
+    # decode chunk at T=decode_chunk, plus T=1 (CPU drain tail = single)
+    chunks = [b for p, b in plan if p == "chunk"]
+    assert cfg.decode_chunk in chunks and 1 in chunks
+    assert warmup_plan(cfg, ()) == []
+
+
+# -- LRU / budget -------------------------------------------------------------
+
+
+def test_pool_lru_eviction_under_budget():
+    events = []
+    pool = ExecutablePool(budget_bytes=100, on_event=events.append)
+    assert pool.put("a", object(), nbytes=40) == []
+    assert pool.put("b", object(), nbytes=40) == []
+    # touch "a" so "b" becomes LRU
+    assert pool.get("a") is not None
+    evicted = pool.put("c", object(), nbytes=40)
+    assert [e.key for e in evicted] == ["b"]
+    assert "a" in pool and "c" in pool and "b" not in pool
+    assert pool.get("b") is None  # miss
+    assert pool.hits == 1 and pool.misses == 1 and pool.evictions == 1
+    assert events.count("eviction") == 1
+    # an entry alone over budget bounces itself, not the residents
+    bounced = pool.put("huge", object(), nbytes=1000)
+    assert [e.key for e in bounced] == ["huge"]
+    assert "a" in pool and "c" in pool
+
+
+def test_pool_same_key_refresh_is_not_an_eviction():
+    """A re-put of an existing key (warmup recompile after a stale-entry
+    drop, spill-reload re-registration) replaces silently — the eviction
+    counter means budget pressure / device release only."""
+    events = []
+    pool = ExecutablePool(budget_bytes=100, on_event=events.append)
+    pool.put("a", object(), nbytes=40)
+    assert pool.put("a", object(), nbytes=50) == []
+    assert pool.evictions == 0 and events.count("eviction") == 0
+    assert pool.bytes_used == 50  # the old entry's bytes were released
+
+
+def test_pool_budget_zero_disables_pooling():
+    pool = ExecutablePool(budget_bytes=0)
+    evicted = pool.put("a", object(), nbytes=1)
+    assert [e.key for e in evicted] == ["a"]
+    assert pool.get("a") is None
+    # a disabled pool is not "budget pressure": the eviction counter
+    # stays untouched by the drops
+    assert pool.evictions == 0
+
+
+def test_pool_budget_zero_ignores_spill(tmp_path, monkeypatch):
+    """--exec-pool-mib 0 must fully disable pooling even where spill is
+    trusted: no write-through blob on put, and blobs left by prior runs
+    (here: written by an enabled pool) never come back as disk hits."""
+    monkeypatch.setenv("FMA_EXEC_SPILL", "1")
+    cfg = tiny_cfg()
+    key = exec_key(exec_signature(cfg), "prefill", 16)
+    compiled = exec_pool.compile_program(cfg, "prefill", 16)
+    enabled = ExecutablePool(budget_bytes=64 << 20, spill_dir=str(tmp_path))
+    enabled.put(key, compiled)
+    assert list(tmp_path.glob("*.exec")), "spill fixture missing"
+
+    disabled = ExecutablePool(budget_bytes=0, spill_dir=str(tmp_path))
+    disabled.put("fresh", compiled, nbytes=1)
+    assert len(list(tmp_path.glob("*.exec"))) == 1  # no new blob
+    assert disabled.get(key) is None  # prior-run blob is NOT served
+    assert disabled.get("fresh") is None
+    assert disabled.misses == 2 and disabled.hits == 0
+    assert disabled.spill_hits == 0 and disabled.evictions == 0
+
+
+def test_pool_drop_live_counts_evictions():
+    pool = ExecutablePool(budget_bytes=1 << 20)
+    pool.put("a", object(), nbytes=1)
+    pool.put("b", object(), nbytes=1)
+    assert pool.drop_live() == 2
+    assert len(pool) == 0 and pool.evictions == 2
+
+
+# -- spill round trip ---------------------------------------------------------
+
+
+def test_spill_and_reload_round_trip(tmp_path, monkeypatch):
+    """A pooled executable spilled to disk reloads in a fresh pool (the
+    instance-restart path) and produces the same outputs as the original
+    — same process/client, where deserialization is trusted."""
+    monkeypatch.setenv("FMA_EXEC_SPILL", "1")
+    cfg = tiny_cfg()
+    sig = exec_signature(cfg)
+    key = exec_key(sig, "prefill", 16)
+    compiled = exec_pool.compile_program(cfg, "prefill", 16)
+    pool_a = ExecutablePool(budget_bytes=64 << 20, spill_dir=str(tmp_path))
+    pool_a.put(key, compiled)
+    assert list(tmp_path.glob("*.exec")), "write-through spill missing"
+
+    # a brand-new pool (fresh process stand-in) reloads from disk
+    pool_b = ExecutablePool(budget_bytes=64 << 20, spill_dir=str(tmp_path))
+    reloaded = pool_b.get(key)
+    assert reloaded is not None
+    assert pool_b.spill_hits == 1 and key in pool_b
+
+    # identical outputs: drive both through two identically-seeded engines
+    eng1 = InferenceEngine(cfg, seed=0)
+    eng2 = InferenceEngine(cfg, seed=0)
+    eng1.install_executable("prefill", 16, compiled)
+    eng2.install_executable("prefill", 16, reloaded)
+    out1 = eng1.generate([[1, 2, 3]], max_new_tokens=1)
+    out2 = eng2.generate([[1, 2, 3]], max_new_tokens=1)
+    assert out1 == out2
+
+
+def test_default_spill_dir_derivation(monkeypatch):
+    """Spill location precedence: the launcher's explicit export
+    (FMA_EXEC_SPILL_DIR, stamped by launcher/main.py preload next to the
+    persistent XLA cache) wins; a standalone engine derives the same
+    location from JAX_COMPILATION_CACHE_DIR; neither set = no spill."""
+    monkeypatch.delenv("FMA_EXEC_SPILL_DIR", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    assert exec_pool.default_spill_dir() == ""
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/tmp/xla-cache")
+    assert exec_pool.default_spill_dir() == "/tmp/xla-cache/exec-pool"
+    monkeypatch.setenv("FMA_EXEC_SPILL_DIR", "/tmp/explicit")
+    assert exec_pool.default_spill_dir() == "/tmp/explicit"
+
+
+def test_spill_disabled_on_cpu_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("FMA_EXEC_SPILL", raising=False)
+    import jax
+
+    pool = ExecutablePool(budget_bytes=1 << 20, spill_dir=str(tmp_path))
+    pool.put("k", object(), nbytes=1)
+    if jax.default_backend() == "tpu":
+        pytest.skip("spill is on by default on TPU")
+    assert not list(tmp_path.glob("*.exec"))
+
+
+# -- warmup task --------------------------------------------------------------
+
+
+def test_warmup_install_is_bit_exact_and_pool_hits_recompile_nothing():
+    cfg = tiny_cfg()
+    ref = InferenceEngine(cfg, seed=0).generate([[1, 2, 3]], max_new_tokens=6)
+    pool = ExecutablePool(budget_bytes=64 << 20)
+    task = WarmupTask(cfg, (16,), pool=pool)
+    assert task.wait(300)
+    assert task.stats["compiled"] == len(task.plan) > 0
+    eng = InferenceEngine(cfg, seed=0)
+    assert task.install(eng) == len(task.plan)
+    assert eng.generate([[1, 2, 3]], max_new_tokens=6) == ref
+    # a second task for the same config compiles nothing
+    task2 = WarmupTask(cfg, (16,), pool=pool)
+    assert task2.wait(60)
+    assert task2.stats["compiled"] == 0
+    assert task2.stats["pool_hits"] == len(task2.plan)
+
+
+def test_warmup_abort_stops_between_compiles():
+    cfg = tiny_cfg()
+    # enough programs that the abort lands mid-plan
+    task = WarmupTask(cfg, (16, 32, 64), pool=None, start=False)
+    assert len(task.plan) >= 6
+    task.start()
+    # wait for the first compile to finish, then cancel
+    deadline = time.monotonic() + 120
+    while not task.results and time.monotonic() < deadline:
+        time.sleep(0.01)
+    task.abort()
+    assert task.wait(120)
+    assert task.stats["aborted"]
+    assert len(task.results) < len(task.plan)
+
+
+def test_warmup_abort_drop_results_discards_inflight_compile(monkeypatch):
+    """abort(drop_results=True) — the device-release fence — must discard
+    a compile already in flight instead of registering/pooling an
+    executable owned by the PJRT client being destroyed."""
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_compile(cfg_, program, bucket, programs=None):
+        started.set()
+        assert release.wait(30)
+        return object()
+
+    monkeypatch.setattr(exec_pool, "compile_program", slow_compile)
+    pool = ExecutablePool(budget_bytes=64 << 20)
+    task = WarmupTask(tiny_cfg(), (16,), pool=pool)
+    assert started.wait(30)
+    task.abort(drop_results=True)  # the release fence, mid-compile
+    release.set()
+    assert task.wait(30)
+    assert task.results == {} and len(pool) == 0
+    assert task.stats["aborted"] and task.stats["compiled"] == 0
+
+
+def test_warmup_skips_meshes():
+    task = WarmupTask(tiny_cfg(), (16,), mesh=object())
+    assert task.stats["skipped"] == "mesh"
+    assert task.wait(0) and task.results == {}
+
+
+# -- service-level contracts --------------------------------------------------
+
+
+@pytest.fixture
+def service():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64 --swap-bucket-mib 1 "
+            "--exec-pool-mib 256 --warmup-buckets 16"
+        )
+    )
+    yield svc
+    svc.shutdown()
+
+
+def _first_token(svc):
+    return svc.submit([1, 2, 3], 1, 0.0).result(timeout=120)
+
+
+def test_cold_swap_warms_and_pool_hit_swap_has_zero_compile_spans(service):
+    from llm_d_fast_model_actuation_tpu.utils import tracing
+
+    tracing.enable()
+    try:
+        _first_token(service)
+        # cold swap: warmup compiles ride under the transfer and install
+        out = service.swap("tiny-gemma")
+        assert out["warmup"] is not None
+        assert out["warmup"]["compiled"] > 0
+        assert not out["warmup"]["errors"]
+        assert service.engine._aot, "executables not installed"
+        _first_token(service)
+        gold = service.submit([1, 2, 3], 3, 0.0).result(timeout=120).out_tokens
+
+        # pool-hit swap back: the slept runtime keeps its programs — the
+        # trace must contain ZERO warmup.compile spans for this edge
+        tracing.clear()
+        back = service.swap("tiny")
+        assert back["pool_hit"] and back["warmup"] is None
+        names = [s.name for s in tracing.snapshot()]
+        assert "warmup.compile" not in names
+        assert "swap.transfer" in names  # the swap itself was traced
+
+        # cold REBUILD of tiny-gemma with a warm executable pool: weights
+        # are cold (runtime evicted), executables all pool-hit, outputs
+        # bit-exact with the first build
+        service._free_pooled(service.model_pool.drain(), "test")
+        tracing.clear()
+        again = service.swap("tiny-gemma")
+        assert again["warmup"]["compiled"] == 0
+        assert again["warmup"]["pool_hits"] == len(
+            warmup_plan(service.engine.cfg, (16,))
+        )
+        assert "warmup.compile" not in [s.name for s in tracing.snapshot()]
+        assert (
+            service.submit([1, 2, 3], 3, 0.0).result(timeout=120).out_tokens
+            == gold
+        )
+    finally:
+        tracing.clear()
+
+
+def test_build_failure_aborts_warmup(service):
+    """Swap cancellation (a failed cold build) aborts the warmup thread;
+    already-compiled executables stay pooled for the retry."""
+    _first_token(service)
+    with pytest.raises(Exception):
+        # a checkpoint dir that does not exist fails the build fast,
+        # while the warmup thread is still compiling
+        service.swap("tiny-gemma", checkpoint_dir="/nonexistent/ckpt")
+    task = service._last_warmup
+    assert task is not None
+    assert task._abort.is_set()
+    assert task.wait(120)
+    # the service rolled back and still serves
+    assert service.failure is None
+    _first_token(service)
+
+
+def test_exec_pool_flags_validated():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        parse_engine_options,
+    )
+
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --exec-pool-mib -1")
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --warmup-buckets 16,zap")
+    with pytest.raises(ValueError):
+        parse_engine_options("--model tiny --warmup-buckets 0")
+    args = parse_engine_options("--model tiny --warmup-buckets 16,128")
+    assert exec_pool.parse_warmup_buckets(args.warmup_buckets) == (16, 128)

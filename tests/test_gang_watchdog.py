@@ -130,3 +130,84 @@ def test_exit_code_is_distinct():
     # the launcher sentinel treats any non-zero exit as a crash; the
     # dedicated code makes gang teardowns recognizable in logs
     assert EXIT_GANG_PEER_LOST not in (0, 1, 2)
+
+
+def test_heartbeat_requires_gang_token():
+    """ADVICE round-5: the responder only accepts pings carrying the
+    per-gang token (HMAC of coordinator address + shared secret env) —
+    an unauthenticated or foreign-gang ping neither refreshes liveness
+    nor gets an "ok"."""
+    import socket
+
+    from llm_d_fast_model_actuation_tpu.engine.multihost import (
+        gang_heartbeat_token,
+    )
+
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    deaths = []
+    leader = _mk(0, port, deaths, join_grace=30, timeout=30)
+    leader.start()
+    try:
+        addr = ("127.0.0.1", port + HEARTBEAT_PORT_OFFSET)
+
+        def ping(line: str) -> bytes:
+            with socket.create_connection(addr, timeout=2) as s:
+                s.sendall(line.encode())
+                s.settimeout(2)
+                try:
+                    return s.recv(8)
+                except TimeoutError:
+                    return b""
+
+        # legacy two-field ping (no token): rejected
+        assert not ping("hb 1\n").startswith(b"ok")
+        assert 1 not in leader._last_seen
+        # wrong token (another gang / no secret agreement): rejected
+        assert not ping("hb 1 deadbeefdeadbeef\n").startswith(b"ok")
+        assert 1 not in leader._last_seen
+        # the real token: accepted and liveness refreshed
+        tok = gang_heartbeat_token(f"127.0.0.1:{port}")
+        assert leader.token == tok
+        assert ping(f"hb 1 {tok}\n").startswith(b"ok")
+        assert 1 in leader._last_seen
+    finally:
+        leader.stop()
+
+
+def test_heartbeat_token_varies_with_secret_and_address(monkeypatch):
+    from llm_d_fast_model_actuation_tpu.engine.multihost import (
+        GANG_HB_SECRET_ENV,
+        gang_heartbeat_token,
+    )
+
+    a = gang_heartbeat_token("10.0.0.1:1234")
+    assert a == gang_heartbeat_token("10.0.0.1:1234")  # deterministic
+    assert a != gang_heartbeat_token("10.0.0.2:1234")  # per-gang
+    monkeypatch.setenv(GANG_HB_SECRET_ENV, "s3cret")
+    assert gang_heartbeat_token("10.0.0.1:1234") != a  # secret-bound
+
+
+def test_leader_bind_failure_names_port_offset_scheme():
+    """A taken heartbeat port must fail with an error that explains the
+    coordinator-port + HEARTBEAT_PORT_OFFSET derivation — 'address in
+    use' on a number nobody configured is otherwise undebuggable."""
+    import socket
+
+    import pytest
+
+    port = free_port() - HEARTBEAT_PORT_OFFSET
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("0.0.0.0", port + HEARTBEAT_PORT_OFFSET))
+    blocker.listen(1)
+    deaths = []
+    leader = _mk(0, port, deaths)
+    try:
+        with pytest.raises(RuntimeError) as ei:
+            leader.start()
+        msg = str(ei.value)
+        assert "HEARTBEAT_PORT_OFFSET" in msg
+        assert str(port) in msg and str(port + HEARTBEAT_PORT_OFFSET) in msg
+    finally:
+        blocker.close()
+        leader.stop()
