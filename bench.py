@@ -814,6 +814,78 @@ def _measure_swap_recovery() -> None:
     finally:
         svc_warm.shutdown()
 
+    # --- variant-swap probe: sibling fine-tunes over the tiered pool ---------
+    # (engine/chunk_store.py; docs/perf.md "Tiered weight cache and delta
+    # swap"). Two Orbax checkpoints of the tiny model differing only in
+    # `final_norm` — the LoRA-merge / fine-tune-head shape of a real
+    # variant fleet. Measured: bytes over the device boundary and TTFT for
+    # a pool-hit swap between the siblings with content hashing on
+    # (delta) vs off (the full-transfer baseline), plus the deduped host
+    # residency of the two variants pooled together. Meaningful on the
+    # CPU backend: byte counts are schedule-independent.
+    import shutil
+
+    import numpy as np
+
+    from llm_d_fast_model_actuation_tpu.models import checkpoint as ckpt_mod
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    vdir = os.environ.get("FMA_VARIANTBENCH_DIR", "/tmp/fma-variantbench")
+    shutil.rmtree(vdir, ignore_errors=True)
+    vcfg = llama.LlamaConfig.tiny()
+    vparams = llama.init_params(jax.random.key(7), vcfg)
+    ck_base = os.path.join(vdir, "base")
+    ck_var = os.path.join(vdir, "variant")
+    ckpt_mod.save_params(ck_base, vcfg, vparams)
+    vparams_b = dict(vparams)
+    vrng = np.random.default_rng(3)
+    vparams_b["final_norm"] = (
+        np.asarray(vparams["final_norm"])
+        + vrng.standard_normal(
+            np.asarray(vparams["final_norm"]).shape
+        ).astype(np.float32)
+    )
+    ckpt_mod.save_params(ck_var, vcfg, vparams_b)
+    # num-pages kept small so the KV pool (never content-matched — its
+    # content is per-variant) doesn't drown the weight dedup signal
+    vopts = (
+        f"--model tiny --num-pages 8 --page-size 8 --max-batch 2 "
+        f"--max-model-len 64 --swap-bucket-mib 1 --checkpoint-dir {ck_base}"
+    )
+
+    def _variant_cycle(extra_opts: str):
+        """gold gen on base -> cold swap to the variant -> pool-hit swap
+        back to base (the measured sibling swap) -> park both. Returns
+        (sibling swap metrics, swap wall s, ttft s, bit_exact, pool)."""
+        svc_n = EngineService(parse_engine_options(vopts + extra_opts))
+        try:
+            first_token_s(svc_n)
+            gold = svc_n.submit([1, 2, 3], 4, 0.0).result(
+                timeout=120
+            ).out_tokens
+            svc_n.swap("tiny", checkpoint_dir=ck_var)  # cold: parks base
+            first_token_s(svc_n)
+            t0 = time.monotonic()
+            out = svc_n.swap("tiny", checkpoint_dir=ck_base)  # sibling hit
+            sib_swap_s = time.monotonic() - t0
+            sib_ttft_s = first_token_s(svc_n)
+            toks = svc_n.submit([1, 2, 3], 4, 0.0).result(
+                timeout=120
+            ).out_tokens
+            svc_n.swap("tiny-gemma")  # park base too: both variants pooled
+            pool = svc_n.model_pool.describe()
+            return out, sib_swap_s, sib_ttft_s, toks == gold, pool
+        finally:
+            svc_n.shutdown()
+
+    v_out, v_swap_s, v_ttft_s, v_exact, v_pool = _variant_cycle("")
+    f_out, f_swap_s, f_ttft_s, f_exact, _ = _variant_cycle(
+        " --content-hash off"
+    )
+    v_full = v_out["bytes_out"] + v_out["bytes_in"]
+    v_single = max(e["nbytes"] for e in v_pool["entries"])
+    v_both = v_pool["bytes_used"]
+
     result = {
         "metric": "swap_rollback_recovery",
         "value": round(rollback_s + recover_ttft_s, 4),
@@ -853,6 +925,34 @@ def _measure_swap_recovery() -> None:
             ),
             "warm_swap_prefetched": warm_prefetched,
             "warmup_target": target,
+            # variant-swap probe: a pool-hit swap between sibling
+            # fine-tunes moves only the content delta over the device
+            # boundary; the full-transfer numbers come from the identical
+            # cycle with --content-hash off
+            "variant_swap_moved_bytes": v_out["bytes_moved"],
+            "variant_swap_deduped_bytes": v_out["bytes_deduped"],
+            "variant_swap_full_bytes": v_full,
+            "variant_swap_moved_frac": round(
+                v_out["bytes_moved"] / v_full, 4
+            )
+            if v_full
+            else 0.0,
+            "variant_swap_s": round(v_swap_s, 4),
+            "variant_swap_ttft_s": round(v_ttft_s, 4),
+            "variant_swap_bit_exact": v_exact,
+            "variant_fullswap_moved_bytes": f_out["bytes_moved"],
+            "variant_fullswap_s": round(f_swap_s, 4),
+            "variant_fullswap_ttft_s": round(f_ttft_s, 4),
+            "variant_fullswap_bit_exact": f_exact,
+            # two pooled siblings' deduped host residency vs one copy
+            "variant_pool_two_variants_bytes": v_both,
+            "variant_pool_single_bytes": v_single,
+            "variant_pool_bytes_ratio": round(v_both / v_single, 4)
+            if v_single
+            else 0.0,
+            "variant_pool_dedup_saved_bytes": (
+                (v_pool.get("chunks") or {}).get("dedup_saved_bytes", 0)
+            ),
         },
     }
     if _trace_out_path():
@@ -940,16 +1040,19 @@ def main() -> int:
         if proc.returncode == 0 and line is not None:
             if proc.stderr.strip():
                 print(proc.stderr, file=sys.stderr)
+            obj = json.loads(line)
+            extra = obj.setdefault("extra", {})
+            # Every result is self-describing about WHERE it ran and WHY:
+            # cross-round comparisons (TPU rounds vs CPU-fallback rounds)
+            # must never need out-of-band context to interpret.
+            extra["backend"] = extra.get("platform", label)
+            extra["backend_fallback"] = prior_failures.get("tpu", "")
             if prior_failures:
                 # A fallback result must be impossible to misread as the
                 # primary measurement: record what failed and why in the
                 # emitted line itself (extra.platform already says 'cpu').
-                obj = json.loads(line)
-                obj.setdefault("extra", {})["fallback_from"] = {
-                    lbl: tail for lbl, tail in prior_failures.items()
-                }
-                line = json.dumps(obj)
-            print(line)
+                extra["fallback_from"] = dict(prior_failures)
+            print(json.dumps(obj))
             return 0
         prior_failures[label] = (
             f"rc={proc.returncode}: {proc.stderr.strip()[-300:]}"
@@ -975,6 +1078,8 @@ def main() -> int:
         "vs_baseline": 0.0,
         "extra": {
             "platform": "unavailable",
+            "backend": "unavailable",
+            "backend_fallback": prior_failures.get("tpu", ""),
             "error": (proc.stderr[-500:] if proc is not None else "no attempt"),
         },
     }))

@@ -1,0 +1,449 @@
+"""Content-addressed weight chunks: the substrate of the tiered model pool.
+
+Real fleets serve dozens of fine-tune variants of one base model; a flat
+per-model host pool stores the shared base tensors once PER VARIANT and a
+swap between siblings moves the whole checkpoint over PCIe. This module
+makes weight identity content-addressed instead of model-addressed:
+
+  * every leaf staged by the loaders (models/hf.py, models/checkpoint.py)
+    gets a sha256 **digest** computed exactly once at load time;
+  * :class:`ChunkStore` holds host-resident weight chunks keyed by digest,
+    **refcounted** so two pooled fine-tunes of one base hold their common
+    tensors in host DRAM exactly once (the dedup the tiered pool reports
+    as ``dedup_saved_bytes``);
+  * chunks whose last reference drops **spill to a local-disk tier**
+    (bounded LRU, atomic-rename writes, content-verified reload — a
+    stale/corrupt/colliding blob is a miss, never wrong weights), so an
+    evicted variant can be rebuilt from local SSD instead of re-reading
+    its checkpoint over the network.
+
+The same digests drive the **delta-aware hot-swap**
+(engine/sleep.py:swap_states): leaves the incoming and outgoing models
+share by content hash never cross the device boundary at all — the live
+device array is handed over and only the delta moves.
+
+Grounding: 10Cache's cost-aware tier placement/migration and "Memory
+Offloading for LLM Inference with Latency SLO Guarantees" (PAPERS.md) —
+tier residency decisions here are recency+refcount driven, with the disk
+tier as the cheap slot below host DRAM.
+
+Mirrors engine/exec_pool.py's spill discipline (bounded budget per tier,
+atomic rename, stale-blob-is-a-miss) for weights instead of executables.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: disk chunk files: "<sha256-of-digest>.chunk" under the spill dir —
+#: hashing the digest for the filename keeps names fixed-length and
+#: filesystem-safe regardless of how the digest scheme evolves
+_CHUNK_SUFFIX = ".chunk"
+
+
+def default_disk_dir() -> str:
+    """Where the weight-chunk disk tier lives when ``--pool-disk-dir`` is
+    not given: ``FMA_POOL_SPILL_DIR`` (exported by deployments next to the
+    compile cache), else disabled."""
+    return os.environ.get("FMA_POOL_SPILL_DIR", "")
+
+
+def leaf_digest(arr: Any) -> str:
+    """Content digest of one weight leaf: sha256 over (dtype, shape, raw
+    bytes). Computed ONCE at load/stage time; equality implies bit-equal
+    arrays of identical shape+dtype, so a digest match is sufficient for
+    the delta-swap's device-array reuse."""
+    a = np.asarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(b"|")
+    h.update(",".join(str(d) for d in a.shape).encode())
+    h.update(b"|")
+    if not a.flags["C_CONTIGUOUS"]:
+        a = np.ascontiguousarray(a)
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def unflatten_tree(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Nested dict from '/'-joined flat keys — the inverse of the flat-key
+    convention every digest map and manifest in this module uses (one
+    definition: models/hf.py and the pool's manifest reconstruction both
+    delegate here)."""
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def digest_tree(params: Dict[str, Any]) -> Dict[str, str]:
+    """Flat-key -> digest over a nested host param tree (the loaders
+    compute this incrementally instead; this is the offline/bench path)."""
+    out: Dict[str, str] = {}
+
+    def walk(node: Any, prefix: Tuple[str, ...]) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (k,))
+        else:
+            out["/".join(prefix)] = leaf_digest(node)
+
+    walk(params, ())
+    return out
+
+
+def aligned_digests(
+    state: Any, digests: Optional[Dict[str, str]], prefix: str = "params"
+) -> List[Optional[str]]:
+    """Per-leaf digest list aligned with ``jax.tree.flatten(state)`` order.
+
+    ``digests`` maps flat param keys ("embed", "layers/wq", ...) to
+    digests; leaves outside the ``prefix`` subtree (the KV pool, scheduler
+    arrays) get None — they are never content-matched. This is the
+    alignment contract between the service's digest bookkeeping and
+    ``swap_states``'s leaf lists."""
+    from jax.tree_util import tree_flatten_with_path
+
+    flat, _ = tree_flatten_with_path(state)
+    out: List[Optional[str]] = []
+    for path, _leaf in flat:
+        if not digests:
+            out.append(None)
+            continue
+        keys = []
+        for k in path:
+            if hasattr(k, "key"):
+                keys.append(str(k.key))
+            elif hasattr(k, "idx"):
+                keys.append(str(k.idx))
+            else:  # pragma: no cover — exotic pytree key types
+                keys.append(str(k))
+        if prefix:
+            if keys and keys[0] == prefix:
+                out.append(digests.get("/".join(keys[1:])))
+            else:
+                out.append(None)
+        else:
+            out.append(digests.get("/".join(keys)))
+    return out
+
+
+@dataclass
+class _Chunk:
+    digest: str
+    data: np.ndarray
+    nbytes: int
+    refs: int = 0
+    stored_at: float = field(default_factory=time.monotonic)
+
+
+class ChunkStore:
+    """Refcounted host tier + bounded disk tier of content-addressed chunks.
+
+    Host tier: chunks live here exactly while referenced (refs > 0) by
+    pool entries; ``intern`` dedupes (a second variant's identical tensor
+    returns the FIRST one's array and adds a reference), ``release`` drops
+    a reference and — when the last one goes — spills the chunk to the
+    disk tier before freeing its host bytes.
+
+    Disk tier: bounded LRU of spilled chunks (``disk_budget_bytes``;
+    <= 0 or empty ``disk_dir`` disables it). Writes are atomic-rename;
+    ``fetch`` re-verifies the content hash on reload, so a stale, torn,
+    corrupt, or hash-colliding blob is a miss (the caller cold-loads),
+    never silently wrong weights.
+
+    All byte totals are RUNNING counters — O(1) reads from /metrics, no
+    re-summing under the lock. ``on_event(kind)`` mirrors traffic into
+    Prometheus without this module importing prometheus. Thread-safe.
+    """
+
+    def __init__(
+        self,
+        disk_dir: str = "",
+        disk_budget_bytes: int = 0,
+        on_event: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.disk_dir = disk_dir or ""
+        self.disk_budget_bytes = int(disk_budget_bytes)
+        self._mu = threading.Lock()
+        self._chunks: Dict[str, _Chunk] = {}
+        #: digest -> file size; insertion order is the disk LRU order
+        self._disk: "OrderedDict[str, int]" = OrderedDict()
+        self._on_event = on_event or (lambda kind: None)
+        # running counters (the O(n) re-sum fix, module docstring)
+        self.host_bytes = 0
+        self.disk_bytes = 0
+        self.dedup_saved_bytes = 0
+        # traffic counters
+        self.dedup_hits = 0
+        self.disk_spills = 0
+        self.disk_hits = 0
+        self.disk_evictions = 0
+        self.verify_failures = 0
+        if self._disk_enabled():
+            self._scan_disk()
+
+    def __len__(self) -> int:
+        return len(self._chunks)
+
+    def __contains__(self, digest: str) -> bool:
+        return digest in self._chunks
+
+    # -- host tier ------------------------------------------------------------
+
+    def intern(self, digest: str, arr: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Register one reference to `digest`, using `arr` as its content
+        when the chunk is new. Returns ``(canonical_array, added_bytes)``:
+        on a dedup hit the canonical array is the EXISTING chunk's (the
+        caller drops its duplicate — that is the host-DRAM saving) and
+        added_bytes is 0."""
+        with self._mu:
+            c = self._chunks.get(digest)
+            if c is not None:
+                c.refs += 1
+                self.dedup_hits += 1
+                self.dedup_saved_bytes += c.nbytes
+                self._on_event("dedup_hit")
+                return c.data, 0
+            nb = int(arr.nbytes)
+            self._chunks[digest] = _Chunk(digest=digest, data=arr, nbytes=nb, refs=1)
+            self.host_bytes += nb
+            return arr, nb
+
+    def release(self, digest: str, spill: bool = True) -> int:
+        """Drop one reference; when the last goes, spill the chunk to the
+        disk tier (``spill=True`` — the eviction path) and free its host
+        bytes. Returns host bytes freed (0 while other references hold
+        it)."""
+        freed = self._drop_ref(digest)
+        if freed is None:
+            return 0
+        data, nb = freed
+        if spill:
+            self._spill(digest, data)
+        return nb
+
+    def release_deferred(
+        self, digest: str
+    ) -> Optional[Tuple[str, np.ndarray]]:
+        """Drop one reference WITHOUT spilling inline: when the last goes,
+        returns ``(digest, data)`` for the caller to :meth:`spill` after
+        dropping its own locks — the eviction loop runs under the pool
+        mutex and must not do disk I/O there. None while still
+        referenced."""
+        freed = self._drop_ref(digest)
+        return None if freed is None else (digest, freed[0])
+
+    def _drop_ref(
+        self, digest: str
+    ) -> Optional[Tuple[np.ndarray, int]]:
+        with self._mu:
+            c = self._chunks.get(digest)
+            if c is None:
+                return None
+            if c.refs > 1:
+                c.refs -= 1
+                self.dedup_saved_bytes -= c.nbytes
+                return None
+            data, nb = c.data, c.nbytes
+            del self._chunks[digest]
+            self.host_bytes -= nb
+        return data, nb
+
+    def spill(self, digest: str, data: np.ndarray) -> bool:
+        """Write one freed chunk to the disk tier (the deferred half of
+        :meth:`release_deferred`)."""
+        return self._spill(digest, data)
+
+    def fetch(self, digest: str) -> Optional[np.ndarray]:
+        """Resolve a digest: host tier first (zero-copy — the array a
+        sibling variant still references), then a verified disk-tier
+        reload; None = genuine miss (the caller cold-loads). Does NOT take
+        a reference — callers that re-pool the result intern it again."""
+        with self._mu:
+            c = self._chunks.get(digest)
+            if c is not None:
+                self._on_event("host_hit")
+                return c.data
+        return self._load_spilled(digest)
+
+    # -- disk tier ------------------------------------------------------------
+
+    def _disk_enabled(self) -> bool:
+        return bool(self.disk_dir) and self.disk_budget_bytes > 0
+
+    def _path(self, digest: str) -> str:
+        name = hashlib.sha256(digest.encode()).hexdigest() + _CHUNK_SUFFIX
+        return os.path.join(self.disk_dir, name)
+
+    def _scan_disk(self) -> None:
+        """Adopt chunk files from prior runs (oldest-first = LRU order) so
+        the disk tier survives an instance restart, trimming to budget."""
+        try:
+            entries = []
+            for f in os.listdir(self.disk_dir):
+                if not f.endswith(_CHUNK_SUFFIX):
+                    continue
+                p = os.path.join(self.disk_dir, f)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, p, st.st_size))
+            entries.sort()
+            with self._mu:
+                for _, p, size in entries:
+                    digest = self._read_header_digest(p)
+                    if digest is None:
+                        continue
+                    self._disk[digest] = size
+                    self.disk_bytes += size
+                self._trim_disk_locked()
+        except OSError:
+            pass
+
+    @staticmethod
+    def _read_header_digest(path: str) -> Optional[str]:
+        try:
+            with open(path, "rb") as f:
+                header = f.readline(4096)
+            return json.loads(header).get("digest")
+        except Exception:  # noqa: BLE001 — a torn header is just not adopted
+            return None
+
+    def _spill(self, digest: str, data: np.ndarray) -> bool:
+        if not self._disk_enabled():
+            return False
+        with self._mu:
+            if digest in self._disk:
+                # already on disk from an earlier cycle — still a fresh
+                # use: touch the LRU so a hot, repeatedly-respilled chunk
+                # (a shared base tensor) isn't evicted as stale
+                self._disk.move_to_end(digest)
+                return True
+        header = json.dumps(
+            {
+                "digest": digest,
+                "dtype": str(data.dtype),
+                "shape": list(data.shape),
+                "nbytes": int(data.nbytes),
+            }
+        ).encode()
+        path = self._path(digest)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.disk_dir, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(header)
+                f.write(b"\n")
+                if not data.flags["C_CONTIGUOUS"]:
+                    data = np.ascontiguousarray(data)
+                f.write(data.tobytes())
+            os.replace(tmp, path)  # atomic: readers never see a torn file
+        except OSError:
+            logger.warning("chunk spill failed for %s", digest[:16], exc_info=True)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        size = len(header) + 1 + int(data.nbytes)
+        with self._mu:
+            self._disk[digest] = size
+            self._disk.move_to_end(digest)
+            self.disk_bytes += size
+            self.disk_spills += 1
+            self._on_event("disk_spill")
+            self._trim_disk_locked()
+        return True
+
+    def _trim_disk_locked(self) -> None:
+        while self.disk_bytes > self.disk_budget_bytes and self._disk:
+            victim, size = self._disk.popitem(last=False)
+            self.disk_bytes -= size
+            self.disk_evictions += 1
+            self._on_event("disk_eviction")
+            try:
+                os.unlink(self._path(victim))
+            except OSError:
+                pass
+
+    def _load_spilled(self, digest: str) -> Optional[np.ndarray]:
+        if not self._disk_enabled():
+            self._on_event("miss")
+            return None
+        path = self._path(digest)
+        try:
+            with open(path, "rb") as f:
+                header = json.loads(f.readline())
+                raw = f.read()
+        except (OSError, ValueError):
+            self._forget_disk(digest)
+            self._on_event("miss")
+            return None
+        try:
+            # CONTENT verify on every reload: the digest names the bytes,
+            # so recompute it over what the file actually holds — a stale
+            # blob, bitrot, or an (astronomically unlikely) collision
+            # must be a miss, never silently-wrong weights.
+            dtype = np.dtype(header["dtype"])
+            arr = np.frombuffer(raw, dtype=dtype).reshape(header["shape"])
+            if header.get("digest") != digest or leaf_digest(arr) != digest:
+                raise ValueError("content digest mismatch")
+        except Exception:  # noqa: BLE001 — any malformed blob is a miss
+            with self._mu:
+                self.verify_failures += 1
+            self._on_event("verify_failure")
+            self._forget_disk(digest)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        with self._mu:
+            if digest in self._disk:
+                self._disk.move_to_end(digest)  # LRU touch
+            self.disk_hits += 1
+            self._on_event("disk_hit")
+        return arr
+
+    def _forget_disk(self, digest: str) -> None:
+        with self._mu:
+            size = self._disk.pop(digest, None)
+            if size is not None:
+                self.disk_bytes -= size
+
+    # -- observability --------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "host_chunks": len(self._chunks),
+                "host_bytes": self.host_bytes,
+                "dedup_saved_bytes": self.dedup_saved_bytes,
+                "dedup_hits": self.dedup_hits,
+                "disk_dir": self.disk_dir if self._disk_enabled() else "",
+                "disk_budget_bytes": self.disk_budget_bytes,
+                "disk_chunks": len(self._disk),
+                "disk_bytes": self.disk_bytes,
+                "disk_spills": self.disk_spills,
+                "disk_hits": self.disk_hits,
+                "disk_evictions": self.disk_evictions,
+                "verify_failures": self.verify_failures,
+            }

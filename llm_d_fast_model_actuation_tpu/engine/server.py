@@ -153,6 +153,37 @@ ENGINE_POOL_EVICTIONS = Counter(
     "Pooled models evicted (budget pressure or device release)",
 )
 
+# Tiered, content-addressed pool (docs/perf.md "Tiered weight cache and
+# delta swap"): per-tier residency, how many host bytes dedup across
+# sibling fine-tune variants is saving right now, tier traffic, and how
+# much of the last swap crossed the device boundary vs was content-matched
+# away.
+ENGINE_POOL_TIER_BYTES = Gauge(
+    "fma_engine_model_pool_tier_bytes",
+    "Bytes resident per model-pool tier (host chunks / disk spill)",
+    ["tier"],  # host | disk
+)
+ENGINE_POOL_TIER_CHUNKS = Gauge(
+    "fma_engine_model_pool_tier_chunks",
+    "Content-addressed chunks resident per model-pool tier",
+    ["tier"],
+)
+ENGINE_POOL_DEDUP_SAVED = Gauge(
+    "fma_engine_model_pool_dedup_saved_bytes",
+    "Host bytes saved by content-addressed dedup across pooled models",
+)
+ENGINE_POOL_TIER_EVENTS = Counter(
+    "fma_engine_model_pool_tier_events_total",
+    "Chunk-store traffic by event",
+    ["event"],  # dedup_hit | host_hit | disk_spill | disk_hit |
+    #             disk_eviction | verify_failure | miss
+)
+ENGINE_SWAP_DELTA_BYTES = Gauge(
+    "fma_engine_swap_delta_bytes",
+    "Last swap's bytes over the device boundary by kind",
+    ["model", "kind"],  # kind: moved | deduped
+)
+
 # Self-healing observability (docs/operations.md "Self-healing and fault
 # drills"): every recovery edge — a swap failure rolled back in-process, or
 # a rollback that itself failed and flipped /health — is counted, so an
@@ -363,6 +394,33 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "disables pooling (every swap-in is a cold build)",
     )
     p.add_argument(
+        "--pool-disk-dir",
+        default="",
+        help="local-disk spill tier below the host model pool: weight "
+        "chunks whose last pooled reference is evicted spill here "
+        "(atomic rename, content-verified reload), so a swap back to an "
+        "evicted model rebuilds from local disk instead of re-reading "
+        "its checkpoint. Defaults to FMA_POOL_SPILL_DIR; empty disables "
+        "the tier",
+    )
+    p.add_argument(
+        "--pool-disk-mib",
+        type=int,
+        default=4096,
+        help="byte budget (MiB) for the model pool's disk spill tier "
+        "(LRU beyond it); 0 disables the tier",
+    )
+    p.add_argument(
+        "--content-hash",
+        default="on",
+        choices=["on", "off"],
+        help="content-address pooled weights (sha256 per leaf, computed "
+        "once at load): dedupes sibling fine-tune variants in the host "
+        "pool and lets hot-swaps move only the delta between models "
+        "sharing tensors. Ignored (off) for sharded or quantized "
+        "engines",
+    )
+    p.add_argument(
         "--swap-bucket-mib",
         type=int,
         default=256,
@@ -496,6 +554,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
         raise ValueError("--swap-bucket-mib must be >= 1")
+    if getattr(args, "pool_disk_mib", 0) < 0:
+        raise ValueError("--pool-disk-mib must be >= 0")
     if getattr(args, "exec_pool_mib", 0) < 0:
         raise ValueError("--exec-pool-mib must be >= 0")
     from .exec_pool import parse_warmup_buckets
@@ -547,6 +607,9 @@ class _PrefetchedWeights:
     checkpoint_dir: str
     params_host: Optional[Dict[str, Any]]
     nbytes: int
+    #: flat weight key -> content digest (engine/chunk_store.py): what the
+    #: tiered pool dedupes on; carried into the runtime a swap builds
+    digests: Optional[Dict[str, str]] = None
 
 
 @dataclass
@@ -562,6 +625,10 @@ class _ModelRuntime:
     tokenizer: Any
     hf_dir: str
     checkpoint_dir: str
+    #: flat weight key -> content digest, computed once at load (None for
+    #: random-init/sharded/quantized builds): drives the delta-swap's
+    #: device-array reuse and the pool's cross-variant dedup
+    digests: Optional[Dict[str, str]] = None
 
 
 class EngineService:
@@ -615,6 +682,25 @@ class EngineService:
             # the whole multi-host gang formed.
             import jax
 
+            if "cpu" in (os.environ.get("JAX_PLATFORMS") or "").lower():
+                # The XLA CPU client ships WITHOUT cross-process
+                # collectives by default: a CPU gang forms, then the first
+                # sharded device_put dies with "Multiprocess computations
+                # aren't implemented on the CPU backend" (the leader exits
+                # 1, the follower aborts on the lost coordinator). The
+                # gloo backend jaxlib bundles makes CPU gangs real — the
+                # e2e multihost tests and any CPU rehearsal of a TPU
+                # topology depend on it. TPU runs never enter here.
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except Exception:  # noqa: BLE001 — gloo-less jaxlib
+                    logger.warning(
+                        "this jaxlib has no CPU collectives backend; "
+                        "a multi-process CPU gang will fail at the first "
+                        "sharded computation"
+                    )
             jax.distributed.initialize(**dist)
         # Multi-host lockstep roles (engine/multihost.py): process 0 leads
         # (serves + broadcasts control frames); others follow (replay).
@@ -649,11 +735,33 @@ class EngineService:
                 ),
             )
             self.watchdog.start()
-        # Host model pool + chunked-transfer sizing (docs/engine.md
-        # "Model hot-swap"): models swapped out stay host-resident up to
-        # the budget, so swapping back re-reads no checkpoint.
+        # Tiered host model pool + chunked-transfer sizing (docs/engine.md
+        # "Model hot-swap", docs/perf.md "Tiered weight cache and delta
+        # swap"): models swapped out stay host-resident up to the budget —
+        # content-addressed so sibling fine-tunes dedupe their shared
+        # tensors and swaps between them move only the delta — with a
+        # local-disk spill tier below for evicted models' chunks.
+        # Content hashing is meaningful only where host weight trees are
+        # plain numpy with stable identity: single-device, unquantized.
+        self._content_hash = (
+            getattr(args, "content_hash", "on") == "on"
+            and args.tensor_parallel_size == 1
+            and not getattr(args, "quantization", "")
+        )
+        from .chunk_store import ChunkStore, default_disk_dir
+
+        chunks = None
+        if self._content_hash:
+            chunks = ChunkStore(
+                disk_dir=getattr(args, "pool_disk_dir", "")
+                or default_disk_dir(),
+                disk_budget_bytes=max(0, getattr(args, "pool_disk_mib", 4096))
+                << 20,
+                on_event=self._pool_tier_event,
+            )
         self.model_pool = HostModelPool(
-            budget_bytes=max(0, getattr(args, "model_pool_mib", 4096)) << 20
+            budget_bytes=max(0, getattr(args, "model_pool_mib", 4096)) << 20,
+            chunks=chunks,
         )
         self._swap_bucket_bytes = (
             max(1, getattr(args, "swap_bucket_mib", 256)) << 20
@@ -767,6 +875,62 @@ class EngineService:
                     "failed to free pooled model %s (%s)",
                     victim.model_id, why, exc_info=True,
                 )
+
+    def _pool_tier_event(self, kind: str) -> None:
+        """Mirror chunk-store tier traffic into Prometheus (the store
+        never imports prometheus)."""
+        ENGINE_POOL_TIER_EVENTS.labels(event=kind).inc()
+
+    def _pool_park(
+        self, key: str, runtime: Any, nbytes: int
+    ) -> List[Any]:
+        """Pool a runtime (or staged-weights bundle) under `key`,
+        interning its digested weight leaves into the content-addressed
+        chunk store first — so a sibling variant already pooled shares its
+        common tensors instead of duplicating them, and an eviction later
+        leaves a manifest the disk tier can serve. Returns the evicted
+        entries (the caller frees them via _free_pooled)."""
+        chunk_digests: List[str] = []
+        interned = 0
+        weight_digests = None
+        if self._content_hash and self.model_pool.budget_bytes > 0:
+            if isinstance(runtime, _PrefetchedWeights):
+                if runtime.digests and runtime.params_host is not None:
+                    (
+                        runtime.params_host,
+                        chunk_digests,
+                        interned,
+                    ) = self.model_pool.intern_tree(
+                        runtime.params_host, runtime.digests, prefix=""
+                    )
+                    weight_digests = runtime.digests
+            else:
+                digests = getattr(runtime, "digests", None)
+                host_state = getattr(runtime.sleeper, "_host_state", None)
+                if digests and host_state is not None:
+                    (
+                        new_tree,
+                        chunk_digests,
+                        interned,
+                    ) = self.model_pool.intern_tree(
+                        host_state, digests, prefix="params"
+                    )
+                    runtime.sleeper._host_state = new_tree
+                    weight_digests = digests
+        if not chunk_digests:
+            # nothing interned (e.g. TPU pinned-host staging, whose jax
+            # arrays are client-owned): an eviction manifest would be
+            # guaranteed-dead — every chunk a miss — and would only crowd
+            # resolvable manifests out of the bounded registry
+            weight_digests = None
+        return self.model_pool.put(
+            key,
+            runtime,
+            nbytes=nbytes,
+            chunk_digests=chunk_digests,
+            weight_digests=weight_digests,
+            interned_bytes=interned,
+        )
 
     def _exec_pool_event(self, kind: str) -> None:
         """Mirror executable-pool traffic into Prometheus (the pool itself
@@ -950,6 +1114,7 @@ class EngineService:
         staged_params: Optional[Dict[str, Any]] = None,
         warmup: Optional[Any] = None,
         resolved: Optional[tuple] = None,
+        staged_digests: Optional[Dict[str, str]] = None,
     ) -> _ModelRuntime:
         """Traced wrapper around the cold build: the `with` form ends the
         span (stamping the error) even when the build raises — the
@@ -961,7 +1126,8 @@ class EngineService:
             staged=staged_params is not None,
         ):
             return self._build_runtime_impl(
-                model_id, checkpoint_dir, staged_params, warmup, resolved
+                model_id, checkpoint_dir, staged_params, warmup, resolved,
+                staged_digests,
             )
 
     def _build_runtime_impl(
@@ -971,6 +1137,7 @@ class EngineService:
         staged_params: Optional[Dict[str, Any]] = None,
         warmup: Optional[Any] = None,
         resolved: Optional[tuple] = None,
+        staged_digests: Optional[Dict[str, str]] = None,
     ) -> _ModelRuntime:
         """Cold-build an awake runtime for `model_id`: config -> tokenizer
         -> params (checkpoint / HF read, or random init) -> engine ->
@@ -1007,6 +1174,10 @@ class EngineService:
         }
         inflight = max(1, getattr(args, "load_inflight_mib", 512)) << 20
         params = None
+        #: per-leaf content digests for the new runtime, computed once at
+        #: load (or carried through from a prefetch/tier staging) — the
+        #: tiered pool's and the delta-swap's weight identity
+        digests: Optional[Dict[str, str]] = staged_digests
         t_load0 = time.monotonic()
         if checkpoint_dir and staged_params is None:
             from ..models import checkpoint
@@ -1015,6 +1186,8 @@ class EngineService:
             params = checkpoint.load_params(
                 checkpoint_dir, model_cfg, mesh=mesh, stats_out=ckpt_stats
             )
+            if self._content_hash:
+                digests = ckpt_stats.get("digests") or None
             # Orbax restores each leaf straight into its device placement:
             # the restore wall IS the cold H2D window (read inseparable)
             build_stats["h2d_s"] = ckpt_stats.get(
@@ -1037,7 +1210,10 @@ class EngineService:
                     hf_dir, model_cfg, mesh=mesh,
                     workers=getattr(args, "load_workers", 0) or None,
                     max_inflight_bytes=inflight, stats=lstats,
+                    want_digests=self._content_hash,
                 )
+                if self._content_hash:
+                    digests = dict(lstats.digests) or None
                 for phase, v in (
                     ("read", lstats.read_s),
                     ("convert", lstats.convert_s),
@@ -1106,6 +1282,7 @@ class EngineService:
             tokenizer=tokenizer,
             hf_dir=hf_dir,
             checkpoint_dir=checkpoint_dir,
+            digests=digests if self._content_hash else None,
         )
 
     def _install_runtime(self, rt: _ModelRuntime) -> None:
@@ -1243,20 +1420,34 @@ class EngineService:
             # hit keeps its compiled programs (nothing to warm); the cold
             # and prefetched paths fill this from the build below.
             warm_stats: Optional[Dict[str, Any]] = None
+            #: which tier served the incoming weights: pool (slept
+            #: runtime) | prefetched (staged host weights) | disk
+            #: (chunk-tier manifest reload) | cold (checkpoint/HF read)
+            swap_tier = "pool" if pool_hit and not prefetched else "cold"
             if pool_hit and not prefetched:
                 rt = entry.runtime
                 try:
+                    # Delta-aware restore (engine/sleep.py): leaves the
+                    # incoming and outgoing models share by content hash
+                    # never cross the device boundary — sibling
+                    # fine-tunes move only their delta over PCIe.
                     metrics = swap_states(
                         outgoing.sleeper,
                         rt.sleeper,
                         bucket_bytes=self._swap_bucket_bytes,
+                        out_digests=(
+                            outgoing.digests if self._content_hash else None
+                        ),
+                        in_digests=(
+                            rt.digests if self._content_hash else None
+                        ),
                     )
                 except ValueError:
                     # precondition rejections fire before any transfer:
                     # the pooled entry is still intact — put it back under
                     # ITS key (a checkpoint-less request may have matched
                     # a checkpoint-qualified entry)
-                    self.model_pool.put(entry.model_id, rt, entry.nbytes)
+                    self._pool_park(entry.model_id, rt, entry.nbytes)
                     raise
                 except SwapRolledBack as e:
                     # mid-transfer failure, rolled back by swap_states:
@@ -1264,7 +1455,7 @@ class EngineService:
                     # the incoming entry's host state is untouched —
                     # re-pool it, mark DEGRADED (visible, but /health
                     # stays 200), and surface a retryable 503
-                    self.model_pool.put(entry.model_id, rt, entry.nbytes)
+                    self._pool_park(entry.model_id, rt, entry.nbytes)
                     self.degraded = (
                         f"hot-swap {previous}->{model} rolled back: {e}"
                     )
@@ -1307,6 +1498,36 @@ class EngineService:
                 # and shared with the build — a resolution failure is
                 # deferred to the build, whose rollback path wakes the
                 # outgoing model.
+                # Disk-tier reload first: an evicted model whose chunks
+                # still resolve (host chunks a pooled sibling references,
+                # or verified disk-tier blobs) rebuilds from LOCAL tiers
+                # — no checkpoint re-read. Any unresolvable chunk made
+                # take_staged a miss, so this is all-or-nothing.
+                tier_params = tier_digests = None
+                tier_ckpt = checkpoint_dir
+                tier_src = "disk"
+                if not pool_hit and self._content_hash:
+                    if checkpoint_dir:
+                        got = self.model_pool.take_staged(
+                            _pool_key(model, checkpoint_dir)
+                        )
+                        if got is not None:
+                            tier_params, tier_digests, tier_src = got
+                    else:
+                        got = self.model_pool.take_staged_match(model)
+                        if got is not None:
+                            tier_params, tier_digests, mkey, tier_src = got
+                            tier_ckpt = (
+                                mkey.split("@", 1)[1] if "@" in mkey else ""
+                            )
+                if prefetched:
+                    swap_tier = "prefetched"
+                elif tier_params is not None:
+                    # "host": every chunk was still host-resident via a
+                    # sibling's references; "disk": at least one verified
+                    # disk-tier reload — the per-tier cost signal must not
+                    # attribute DRAM-speed rebuilds to the disk tier
+                    swap_tier = tier_src
                 resolved = None
                 try:
                     resolved = self._resolve_model(model)
@@ -1333,6 +1554,18 @@ class EngineService:
                             staged_params=entry.runtime.params_host,
                             warmup=warm,
                             resolved=resolved,
+                            staged_digests=entry.runtime.digests,
+                        )
+                    elif tier_params is not None:
+                        # weights reconstructed from the chunk tiers:
+                        # stream straight host -> device, digests carried
+                        # through (they name the same content)
+                        rt = self._build_runtime(
+                            model, tier_ckpt,
+                            staged_params=tier_params,
+                            warmup=warm,
+                            resolved=resolved,
+                            staged_digests=tier_digests,
                         )
                     else:
                         rt = self._build_runtime(
@@ -1366,8 +1599,31 @@ class EngineService:
                     if prefetched:
                         # the staged host weights are untouched by a
                         # failed build: re-pool them for the next attempt
-                        self.model_pool.put(
+                        self._pool_park(
                             entry.model_id, entry.runtime, entry.nbytes
+                        )
+                    elif tier_params is not None:
+                        # tier-staged weights are untouched too: re-pool
+                        # them as prefetched host weights (take_staged
+                        # consumed the manifest — without this, a
+                        # transient build failure costs the retry a full
+                        # checkpoint re-read despite every chunk sitting
+                        # verified on local tiers)
+                        import jax
+
+                        nb = sum(
+                            x.nbytes for x in jax.tree.leaves(tier_params)
+                        )
+                        self._pool_park(
+                            _pool_key(model, tier_ckpt),
+                            _PrefetchedWeights(
+                                model_id=model,
+                                checkpoint_dir=tier_ckpt,
+                                params_host=tier_params,
+                                nbytes=nb,
+                                digests=tier_digests,
+                            ),
+                            nb,
                         )
                     ENGINE_RECOVERIES.labels(
                         path="swap_cold", outcome="rolled_back"
@@ -1386,6 +1642,10 @@ class EngineService:
                 # DMA overlap).
                 b = self._last_build_stats
                 warm_stats = b.get("warmup")
+                cold_moved = (
+                    outgoing.sleeper.stats.bytes_offloaded
+                    + b.get("bytes_in", 0)
+                )
                 metrics = {
                     "swap_total_s": 0.0,  # finalized below
                     "d2h_s": outgoing.sleeper.stats.last_sleep_seconds,
@@ -1394,12 +1654,17 @@ class EngineService:
                     "overlap_frac": b.get("overlap_frac", 0.0),
                     "bytes_out": outgoing.sleeper.stats.bytes_offloaded,
                     "bytes_in": b.get("bytes_in", 0),
+                    # full transfer in both directions: a build streams
+                    # the whole incoming model regardless of content
+                    "bytes_moved": cold_moved,
+                    "bytes_deduped": 0,
+                    "deduped_leaves": 0,
                     "buckets_out": 0,
                     "buckets_in": b.get("buckets_in", 0),
                     "bucket_bytes": self._swap_bucket_bytes,
                     "peak_bytes_in_flight": 0,
                 }
-            evicted = self.model_pool.put(
+            evicted = self._pool_park(
                 _pool_key(previous, outgoing.checkpoint_dir),
                 outgoing,
                 nbytes=outgoing.sleeper.stats.bytes_offloaded,
@@ -1420,6 +1685,12 @@ class EngineService:
             ENGINE_SWAP_INFLIGHT_BYTES.labels(model=model).set(
                 metrics.get("peak_bytes_in_flight", 0)
             )
+            ENGINE_SWAP_DELTA_BYTES.labels(model=model, kind="moved").set(
+                metrics.get("bytes_moved", 0)
+            )
+            ENGINE_SWAP_DELTA_BYTES.labels(model=model, kind="deduped").set(
+                metrics.get("bytes_deduped", 0)
+            )
             # a committed swap is proof the failure domain healed: clear
             # any DEGRADED marker from an earlier rolled-back attempt
             self.degraded = None
@@ -1437,6 +1708,9 @@ class EngineService:
                 # pool_hit via background prefetch: source="pool" but the
                 # entry was staged weights, not a slept runtime
                 "prefetched": prefetched,
+                # which tier served the incoming weights (docs/perf.md
+                # "Tiered weight cache and delta swap")
+                "tier": swap_tier,
                 **{
                     k: (round(v, 6) if isinstance(v, float) else v)
                     for k, v in metrics.items()
@@ -1504,6 +1778,40 @@ class EngineService:
                 "checkpoint_dir": checkpoint_dir,
                 "started": False,
             }
+        if self._content_hash:
+            # tier fast path: an evicted model whose chunks still resolve
+            # (host or disk tier) stages with ZERO source reads
+            got = self.model_pool.take_staged(_pool_key(model, checkpoint_dir))
+            if got is not None:
+                import jax
+
+                tree, tier_digests, tier_src = got
+                nbytes = sum(x.nbytes for x in jax.tree.leaves(tree))
+                pw = _PrefetchedWeights(
+                    model_id=model,
+                    checkpoint_dir=checkpoint_dir,
+                    params_host=tree,
+                    nbytes=nbytes,
+                    digests=tier_digests,
+                )
+                evicted = self._pool_park(
+                    _pool_key(model, checkpoint_dir), pw, nbytes
+                )
+                bounced = any(v.runtime is pw for v in evicted)
+                self._free_pooled(evicted, "evicted by prefetch")
+                if not bounced:
+                    ENGINE_PREFETCHES.labels(outcome="completed").inc()
+                    ENGINE_PREFETCH_BYTES.set(nbytes)
+                    self.last_prefetch = {
+                        "state": "completed",
+                        "model": model,
+                        "checkpoint_dir": checkpoint_dir,
+                        "bytes": nbytes,
+                        "source": "tier",
+                        "tier": tier_src,
+                        "pool": self.model_pool.describe(),
+                    }
+                    return dict(self.last_prefetch, started=False)
         from ..models import hf as hf_models
 
         model_cfg = hf_models.config_from_hf(
@@ -1581,6 +1889,7 @@ class EngineService:
                     max(0, getattr(self.args, "prefetch_mib_s", 0)) << 20
                 ),
                 stats=lstats,
+                want_digests=self._content_hash,
             )
         except hf_models.LoadAborted:
             if warm is not None:
@@ -1622,8 +1931,11 @@ class EngineService:
             checkpoint_dir=checkpoint_dir,
             params_host=staged,
             nbytes=nbytes,
+            digests=(
+                dict(lstats.digests) or None if self._content_hash else None
+            ),
         )
-        evicted = self.model_pool.put(
+        evicted = self._pool_park(
             _pool_key(model, checkpoint_dir), pw, nbytes
         )
         bounced = any(v.runtime is pw for v in evicted)
@@ -2325,6 +2637,22 @@ def build_app(service: EngineService) -> web.Application:
         pool = service.model_pool
         ENGINE_POOL_BYTES.set(pool.bytes_used)
         ENGINE_POOL_MODELS.set(len(pool))
+        if pool.chunks is not None:
+            # running counters — the scrape never re-sums entries
+            ENGINE_POOL_TIER_BYTES.labels(tier="host").set(
+                pool.chunks.host_bytes
+            )
+            ENGINE_POOL_TIER_BYTES.labels(tier="disk").set(
+                pool.chunks.disk_bytes
+            )
+            cd = pool.chunks.describe()
+            ENGINE_POOL_TIER_CHUNKS.labels(tier="host").set(
+                cd["host_chunks"]
+            )
+            ENGINE_POOL_TIER_CHUNKS.labels(tier="disk").set(
+                cd["disk_chunks"]
+            )
+            ENGINE_POOL_DEDUP_SAVED.set(pool.chunks.dedup_saved_bytes)
         ENGINE_EXEC_POOL_BYTES.set(service.exec_pool.bytes_used)
         ENGINE_EXEC_POOL_ENTRIES.set(len(service.exec_pool))
         return web.Response(

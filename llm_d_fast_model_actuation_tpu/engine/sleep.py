@@ -108,6 +108,15 @@ def partition_buckets(
     return buckets
 
 
+def _aligned(state, digests):
+    """Per-leaf digest list aligned with the flatten order of ``state``
+    (weight digests live under the "params" subtree; KV and scheduler
+    leaves get None and always move)."""
+    from .chunk_store import aligned_digests
+
+    return aligned_digests(state, digests, prefix="params")
+
+
 class SleepLevel(enum.IntEnum):
     AWAKE = 0
     L1_HOST_OFFLOAD = 1
@@ -477,6 +486,8 @@ def swap_states(
     in_mgr: SleepManager,
     bucket_bytes: Optional[int] = None,
     overlapped: bool = True,
+    out_digests: Optional[Dict[str, str]] = None,
+    in_digests: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Any]:
     """Overlapped model hot-swap: stream the awake model behind ``out_mgr``
     to host while restoring ``in_mgr``'s slept (level-1, non-released) state
@@ -517,6 +528,19 @@ def swap_states(
     sequential schedule (every outgoing bucket lands before the first
     incoming one is issued) — the measured apples-to-apples baseline the
     swap sub-bench compares against (bench.py).
+
+    **Delta-aware** (``out_digests``/``in_digests``, flat weight key ->
+    content digest — engine/chunk_store.py): leaves the two models share
+    by content hash never cross the device boundary at all. A matched
+    incoming leaf takes OVER the outgoing model's live device array (same
+    bytes, by digest), and the incoming pool entry's host copy becomes
+    the outgoing model's slept host state — so only the *delta* between
+    sibling fine-tune variants moves over PCIe, in both directions.
+    Matches additionally require equal shape/dtype/sharding, and the
+    reuse is applied only at commit: a rollback sees untouched leaves.
+    Reported as ``bytes_moved`` / ``bytes_deduped`` (and the
+    ``swap.delta`` trace span). ``None`` digests = the pre-delta full
+    transfer, bit-for-bit the old behavior.
     """
     if out_mgr.is_sleeping:
         raise ValueError("swap-out model must be awake")
@@ -550,16 +574,67 @@ def swap_states(
     # jax arrays) and reused for partitioning, totals, and the in-flight
     # accounting inside the transfer loop
     nb_out = [x.nbytes for x in leaves_out]
-    buckets_out = partition_buckets(nb_out, bucket_bytes)
     leaves_in, treedef_in = jax.tree.flatten(in_mgr._host_state)
     shard_in, _ = jax.tree.flatten(in_mgr._shardings)
     nb_in = [x.nbytes for x in leaves_in]
-    buckets_in = partition_buckets(nb_in, bucket_bytes)
+
+    # Delta matching (module docstring): pair incoming leaves with
+    # content-identical live outgoing leaves by digest. Matched pairs are
+    # excluded from BOTH transfer directions; the handover itself happens
+    # only at commit, so every pre-commit code path (including rollback)
+    # sees them untouched.
+    reuse_pairs: List[tuple] = []  # (incoming idx, outgoing idx)
+    if out_digests and in_digests:
+        dl_out = _aligned(state_out, out_digests)
+        dl_in = _aligned(in_mgr._host_state, in_digests)
+        by_digest: Dict[str, List[int]] = {}
+        for j, d in enumerate(dl_out):
+            if d is not None:
+                by_digest.setdefault(d, []).append(j)
+        for i, d in enumerate(dl_in):
+            cands = by_digest.get(d) if d is not None else None
+            if not cands:
+                continue
+            j = cands[0]
+            lo, li = leaves_out[j], leaves_in[i]
+            if (
+                tuple(lo.shape) == tuple(li.shape)
+                and lo.dtype == li.dtype
+                and shard_out[j] == shard_in[i]
+            ):
+                reuse_pairs.append((i, j))
+                cands.pop(0)
+    reused_in = {i for i, _ in reuse_pairs}
+    reused_out = {j for _, j in reuse_pairs}
+    move_out = [i for i in range(len(leaves_out)) if i not in reused_out]
+    move_in = [i for i in range(len(leaves_in)) if i not in reused_in]
+    buckets_out = [
+        [move_out[k] for k in b]
+        for b in partition_buckets([nb_out[i] for i in move_out], bucket_bytes)
+    ]
+    buckets_in = [
+        [move_in[k] for k in b]
+        for b in partition_buckets([nb_in[i] for i in move_in], bucket_bytes)
+    ]
 
     host_out: list = [None] * len(leaves_out)
     dev_in: list = [None] * len(leaves_in)
     bytes_out = sum(nb_out)
     bytes_in = sum(nb_in)
+    deduped_bytes = sum(nb_out[j] for j in reused_out) + sum(
+        nb_in[i] for i in reused_in
+    )
+    moved_bytes = bytes_out + bytes_in - deduped_bytes
+    if reuse_pairs and traced:
+        dsp = tracing.begin(
+            "swap.delta",
+            parent=root_ctx,
+            activate=False,
+            leaves_shared=len(reuse_pairs),
+            bytes_deduped=deduped_bytes,
+            bytes_moved=moved_bytes,
+        )
+        dsp.end()
     bsize_out = [sum(nb_out[i] for i in b) for b in buckets_out]
     bsize_in = [sum(nb_in[i] for i in b) for b in buckets_in]
 
@@ -850,6 +925,14 @@ def swap_states(
         for i in deferred_in_frees:
             leaves_in[i].delete()
 
+    # Delta handover, at commit only: each matched incoming leaf takes
+    # over the outgoing model's live device array (content-identical by
+    # digest), and the incoming host copy becomes the outgoing model's
+    # slept host state — zero bytes crossed the device boundary for them.
+    for i, j in reuse_pairs:
+        dev_in[i] = leaves_out[j]
+        host_out[j] = leaves_in[i]
+
     # Commit the state-machine edges: outgoing asleep (poolable host
     # state), incoming awake.
     out_mgr._host_state = jax.tree.unflatten(treedef_out, host_out)
@@ -879,6 +962,8 @@ def swap_states(
     root.set(
         bytes_out=bytes_out,
         bytes_in=bytes_in,
+        bytes_moved=moved_bytes,
+        bytes_deduped=deduped_bytes,
         buckets_out=len(buckets_out),
         buckets_in=len(buckets_in),
         overlap_frac=round(overlap / total, 6) if total > 0 else 0.0,
@@ -893,6 +978,9 @@ def swap_states(
         "overlap_frac": overlap / total if total > 0 else 0.0,
         "bytes_out": bytes_out,
         "bytes_in": bytes_in,
+        "bytes_moved": moved_bytes,
+        "bytes_deduped": deduped_bytes,
+        "deduped_leaves": len(reuse_pairs),
         "buckets_out": len(buckets_out),
         "buckets_in": len(buckets_in),
         "bucket_bytes": bucket_bytes,

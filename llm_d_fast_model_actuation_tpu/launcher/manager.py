@@ -171,6 +171,12 @@ class ChipLedger:
         #: when the hint is consumed (swap to that model), aborted, or
         #: the holder releases its chips.
         self._prefetched: Dict[str, str] = {}
+        #: instance_id -> compact tiered-pool summary (pooled models,
+        #: deduped host residency, dedup savings, disk-tier bytes, staged
+        #: manifests) from the holder's last swap/prefetch answer — what a
+        #: multi-model scheduler reads to pick a warm victim/target
+        #: without an extra engine round trip.
+        self._pools: Dict[str, Dict[str, Any]] = {}
 
     def overlapping(
         self, chip_ids: Optional[List[str]], exclude: Optional[str] = None
@@ -194,6 +200,7 @@ class ChipLedger:
         self._held.pop(instance_id, None)
         self._models.pop(instance_id, None)
         self._prefetched.pop(instance_id, None)
+        self._pools.pop(instance_id, None)
 
     def set_model(self, instance_id: str, model: str) -> None:
         """Record which model a holder serves (updated on hot-swap). A
@@ -211,6 +218,24 @@ class ChipLedger:
         elif instance_id in self._held:
             self._prefetched[instance_id] = model
 
+    def set_pool(
+        self, instance_id: str, pool: Optional[Dict[str, Any]]
+    ) -> None:
+        """Record the holder's tiered-pool shape from an engine swap /
+        prefetch answer (None or a pool-less answer clears nothing — the
+        last known summary stays until the holder releases its chips)."""
+        if pool is None or instance_id not in self._held:
+            return
+        chunks = pool.get("chunks") or {}
+        self._pools[instance_id] = {
+            "models": list(pool.get("models") or []),
+            "bytes_used": pool.get("bytes_used", 0),
+            "budget_bytes": pool.get("budget_bytes", 0),
+            "dedup_saved_bytes": chunks.get("dedup_saved_bytes", 0),
+            "disk_bytes": chunks.get("disk_bytes", 0),
+            "staged_manifests": list(pool.get("staged_manifests") or []),
+        }
+
     def holders(self) -> Dict[str, List[str]]:
         return dict(self._held)
 
@@ -219,6 +244,9 @@ class ChipLedger:
 
     def prefetched(self) -> Dict[str, str]:
         return dict(self._prefetched)
+
+    def pools(self) -> Dict[str, Dict[str, Any]]:
+        return dict(self._pools)
 
 
 @dataclass
@@ -644,6 +672,7 @@ class EngineProcessManager:
             checkpoint_dir=body.get("checkpoint_dir") or checkpoint_dir,
         )
         self.ledger.set_model(instance_id, model)
+        self.ledger.set_pool(instance_id, body.get("pool"))
         obj = instance.get_status()
         obj["swap"] = body
         instance.last_revision = self._publish("SWAPPED", obj)
@@ -843,6 +872,7 @@ class EngineProcessManager:
         # controller that acts on the hint without having polled may still
         # get a cold build if the staging later failed.
         self.ledger.set_prefetched(instance_id, model)
+        self.ledger.set_pool(instance_id, body.get("pool"))
         logger.info(
             "prefetch on instance %s: %s (state=%s)",
             instance_id, model, body.get("state"),
@@ -909,6 +939,15 @@ class EngineProcessManager:
             "total_instances": len(statuses),
             "running_instances": running,
             "instances": statuses,
+            # node-local actuation state a multi-model scheduler reads in
+            # one call: who holds which chips, what each holder serves,
+            # what's staged (prefetch hints), and each holder's tiered
+            # pool shape (pooled models, deduped residency, disk tier)
+            "ledger": {
+                "models": self.ledger.models(),
+                "prefetched": self.ledger.prefetched(),
+                "pools": self.ledger.pools(),
+            },
         }
 
     def list_instances(self) -> List[str]:

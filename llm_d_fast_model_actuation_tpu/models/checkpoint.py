@@ -32,6 +32,10 @@ from . import llama
 
 CONFIG_FILE = "config.json"
 PARAMS_DIR = "params"
+#: per-leaf content digests (engine/chunk_store.py), written at save time
+#: so a load gets every weight's identity WITHOUT hashing restored device
+#: arrays — the "hash computed once" contract for the Orbax path
+MANIFEST_FILE = "manifest.json"
 
 #: LlamaConfig fields that must match between checkpoint and engine config
 #: (dtype/attention_impl are runtime choices, not weight-layout facts).
@@ -76,6 +80,18 @@ def save_params(
         ckptr.wait_until_finished()
     with open(os.path.join(directory, CONFIG_FILE), "w") as f:
         json.dump(_config_dict(cfg), f, indent=2, sort_keys=True)
+    # Content manifest (offline, so load pays nothing): flat key -> digest
+    # over host copies of exactly what was written. Orbax restore is
+    # bit-exact, so these identify the restored leaves too — the tiered
+    # pool dedupes sibling fine-tune checkpoints and the delta-swap moves
+    # only differing leaves on the strength of this file.
+    from ..engine.chunk_store import digest_tree
+
+    # digest_tree hashes leaf by leaf (leaf_digest np.asarray's each one),
+    # so peak extra host memory is one leaf's copy, never a second full
+    # model tree
+    with open(os.path.join(directory, MANIFEST_FILE), "w") as f:
+        json.dump({"format": 1, "digests": digest_tree(params)}, f, indent=2)
 
 
 def validate_config(directory: str, cfg: llama.LlamaConfig) -> None:
@@ -178,6 +194,16 @@ def load_params(
         stats_out["bytes"] = sum(
             x.nbytes for x in jax.tree.leaves(params)
         )
+        # content manifest written at save time (flat key -> digest):
+        # the restored tree's identity without hashing device arrays;
+        # a checkpoint predating the manifest just yields no digests
+        mpath = os.path.join(directory, MANIFEST_FILE)
+        if os.path.isfile(mpath):
+            try:
+                with open(mpath) as f:
+                    stats_out["digests"] = json.load(f).get("digests") or {}
+            except (OSError, ValueError):
+                stats_out["digests"] = {}
     if serve_cfg is not cfg:
         from .registry import logical_axes_for, maybe_quantize
 

@@ -200,6 +200,11 @@ class LoadStats:
     shards: int = 0
     workers: int = 0
     streaming: bool = False
+    #: flat weight key -> content digest (engine/chunk_store.py), computed
+    #: once per stacked buffer as its last slice lands — the identity the
+    #: tiered pool dedupes on and the delta-swap matches by. Filled only
+    #: with ``load_params(..., want_digests=True)``.
+    digests: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def _shard_files(path: str) -> Tuple[str, List[str]]:
@@ -438,6 +443,7 @@ def load_params(
     abort_event: Optional[threading.Event] = None,
     throttle_bytes_per_s: float = 0.0,
     stats: Optional[LoadStats] = None,
+    want_digests: bool = False,
 ) -> Dict[str, Any]:
     """Load an HF checkpoint into the stacked (L, ...) param tree — the
     pipelined, parallel cold-start path.
@@ -470,6 +476,13 @@ def load_params(
     ``abort_event`` (checked between tensors) raises LoadAborted;
     ``throttle_bytes_per_s`` bounds read bandwidth (prefetch I/O
     throttle). ``stats`` (a LoadStats) is filled in place.
+
+    ``want_digests`` computes each stacked buffer's content digest
+    (engine/chunk_store.py) the moment its last slice lands — on the
+    reader threads, so hashing overlaps other shards' reads and (in
+    streaming mode) the H2D stream — into ``stats.digests``. This is the
+    ONE place weight content is hashed: the tiered pool's dedup and the
+    delta-swap both reuse these digests.
 
     Bit-exactness: staging writes disjoint slices whose values do not
     depend on schedule, so any (workers, streaming) combination produces
@@ -589,6 +602,7 @@ def load_params(
         else:
             buf[layer] = arr
         dt = time.monotonic() - t0
+        completed = False
         with mu:
             convert_s[0] += dt
             bytes_read[0] += arr.nbytes
@@ -596,8 +610,19 @@ def load_params(
             if sl not in got:
                 got.add(sl)
                 remaining[flat] -= 1
-                if remaining[flat] == 0 and streaming:
-                    ready.put(flat)
+                completed = remaining[flat] == 0
+        if completed:
+            if want_digests:
+                # hashed HERE — before the buffer is queued for transfer
+                # (the streaming thread frees host buffers as they land),
+                # and off the lock so sibling readers keep staging
+                from ..engine.chunk_store import leaf_digest
+
+                dg = leaf_digest(buf)
+                with mu:
+                    st.digests[flat] = dg
+            if streaming:
+                ready.put(flat)
 
     throttle_t0 = time.monotonic()
 
@@ -991,11 +1016,9 @@ def _flatten(tree: Dict[str, Any], prefix: Tuple[str, ...] = ()):
 
 
 def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for key, v in flat.items():
-        parts = key.split("/")
-        node = out
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = v
-    return out
+    # one definition of the '/'-joined flat-key convention, shared with
+    # the digest maps / tier manifests (lazy import: parse-time must not
+    # pull the engine package)
+    from ..engine.chunk_store import unflatten_tree
+
+    return unflatten_tree(flat)

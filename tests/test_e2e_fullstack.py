@@ -52,7 +52,7 @@ CHIP = "tpu-mock-0-0"
 CHIP2 = "tpu-mock-0-1"
 
 
-def wait_http(url: str, timeout: float = 90.0) -> None:
+def wait_http(url: str, timeout: float = 240.0) -> None:
     deadline = time.time() + timeout
     last = None
     while time.time() < deadline:
@@ -278,7 +278,7 @@ class Scenario:
 
     # -- waiting -------------------------------------------------------------
 
-    async def wait_ready(self, probes_port, timeout=180):
+    async def wait_ready(self, probes_port, timeout=300):
         deadline = time.time() + timeout
         while time.time() < deadline:
             try:
@@ -294,7 +294,7 @@ class Scenario:
             await asyncio.sleep(0.3)
         raise TimeoutError(f"stub on {probes_port} never became ready")
 
-    async def wait_sleeping_label(self, pod_name, value="true", timeout=60):
+    async def wait_sleeping_label(self, pod_name, value="true", timeout=180):
         deadline = time.time() + timeout
         while time.time() < deadline:
             pod = self.ks.try_get("Pod", self.ns, pod_name)
@@ -307,7 +307,7 @@ class Scenario:
             await asyncio.sleep(0.3)
         raise TimeoutError(f"{pod_name} never got sleeping={value}")
 
-    async def wait_gone(self, kind, name, timeout=60):
+    async def wait_gone(self, kind, name, timeout=180):
         deadline = time.time() + timeout
         while time.time() < deadline:
             if self.ks.try_get(kind, self.ns, name) is None:
@@ -315,7 +315,7 @@ class Scenario:
             await asyncio.sleep(0.3)
         raise TimeoutError(f"{kind} {name} never deleted")
 
-    async def wait_engine_sleeping(self, engine_port, value, timeout=60):
+    async def wait_engine_sleeping(self, engine_port, value, timeout=180):
         deadline = time.time() + timeout
         while time.time() < deadline:
             try:
@@ -330,7 +330,7 @@ class Scenario:
         raise TimeoutError(f"engine {engine_port} never is_sleeping={value}")
 
 
-def complete(engine_port, prompt=(1, 2, 3), n=3, timeout=60):
+def complete(engine_port, prompt=(1, 2, 3), n=3, timeout=180):
     return requests.post(
         f"http://127.0.0.1:{engine_port}/v1/completions",
         json={"prompt": list(prompt), "max_tokens": n},
