@@ -6,8 +6,10 @@ IMAGE_TAG ?= latest
 
 .PHONY: test test-fast native bench lint images dryrun clean
 
+# --durations mirrors the CI sweep: the tier-1 run is timeout-bound in
+# some containers (ROADMAP), so the slowest tests must be visible
 test:
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q --durations=15
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q -x

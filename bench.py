@@ -886,6 +886,77 @@ def _measure_swap_recovery() -> None:
     v_single = max(e["nbytes"] for e in v_pool["entries"])
     v_both = v_pool["bytes_used"]
 
+    # --- quantized-transfer probe: --sleep-quant int8/fp8 --------------------
+    # (models/quant.py + engine/sleep.py; docs/perf.md "Compressed
+    # actuation"). Per mode: a pool-hit swap cycle on the tiny model,
+    # measuring wire bytes over the device boundary, wake TTFT, the
+    # effective full-precision GiB/s the compression buys, and the
+    # numerics drift (greedy stability + max-abs logprob divergence of
+    # the same greedy tokens). Byte counts are schedule-independent, so
+    # the probe is meaningful on the CPU backend. Content hashing is off
+    # so the quant savings aren't confounded with delta dedup.
+    qbase = (
+        "--model tiny --num-pages 8 --page-size 8 --max-batch 2 "
+        "--max-model-len 64 --swap-bucket-mib 1 --model-pool-mib 512 "
+        "--content-hash off "
+    )
+
+    def _quant_cycle(extra_opts: str):
+        """gold greedy gen -> park tiny (swap to tiny-gemma) -> pool-hit
+        swap back (the measured quantized transfer) -> greedy gen again,
+        then a SECOND quantized cycle. Returns (swap metrics, wake ttft
+        s, greedy_equal over a 4-token window vs the pre-quant gold,
+        max-abs sampled-logprob diff over that window, cycle_stable =
+        8-token greedy identical across cycles — the lossy-once
+        contract's bit-stability)."""
+
+        def gen(svc_g, n):
+            r = svc_g.submit([1, 2, 3], n, 0.0).result(timeout=120)
+            return r.out_tokens, list(getattr(r, "out_logprobs", []) or [])
+
+        svc_q = EngineService(parse_engine_options(qbase + extra_opts))
+        try:
+            first_token_s(svc_q)
+            gold_toks, gold_lps = gen(svc_q, 4)
+            svc_q.swap("tiny-gemma")
+            first_token_s(svc_q)
+            out = svc_q.swap("tiny")
+            ttft = first_token_s(svc_q)
+            toks, lps = gen(svc_q, 4)
+            equal = toks == gold_toks
+            diff = (
+                max(
+                    (abs(a - b) for a, b in zip(lps, gold_lps)),
+                    default=0.0,
+                )
+                if lps and gold_lps
+                else 0.0
+            )
+            c1, _ = gen(svc_q, 8)
+            svc_q.swap("tiny-gemma")
+            svc_q.swap("tiny")
+            c2, _ = gen(svc_q, 8)
+            return out, ttft, equal, diff, c1 == c2
+        finally:
+            svc_q.shutdown()
+
+    q_fp_out, q_fp_ttft, _, _, _ = _quant_cycle("")
+    q8_out, q8_ttft, q8_equal, q8_diff, q8_stable = _quant_cycle(
+        "--sleep-quant int8 --sleep-quant-hot-head off"
+    )
+    q8h_out, _, q8h_equal, _, _ = _quant_cycle("--sleep-quant int8")
+    qf8_out, _, qf8_equal, qf8_diff, qf8_stable = _quant_cycle(
+        "--sleep-quant fp8 --sleep-quant-hot-head off"
+    )
+    fp_moved = q_fp_out["bytes_moved"]
+
+    def _eff_gibps(out, swap_s):
+        # full-precision bytes delivered per wall second: the compressed
+        # path's effective bandwidth (what the PCIe link "looks like")
+        return (
+            out.get("bytes_full", 0) / 2**30 / swap_s if swap_s > 0 else 0.0
+        )
+
     result = {
         "metric": "swap_rollback_recovery",
         "value": round(rollback_s + recover_ttft_s, 4),
@@ -953,6 +1024,40 @@ def _measure_swap_recovery() -> None:
             "variant_pool_dedup_saved_bytes": (
                 (v_pool.get("chunks") or {}).get("dedup_saved_bytes", 0)
             ),
+            # quantized-transfer probe: wire bytes / wake TTFT / effective
+            # full-precision GiB/s per --sleep-quant mode, plus the
+            # numerics contract (greedy stability + logprob divergence of
+            # the same greedy tokens). *_hothead = int8 with the default
+            # fp hot head (embed/final_norm/lm_head kept full precision).
+            "fp16_swap_moved_bytes": fp_moved,
+            "fp16_swap_ttft_s": round(q_fp_ttft, 4),
+            "fp16_swap_effective_gibps": float(
+                f"{_eff_gibps(q_fp_out, q_fp_out['swap_total_s']):.3g}"
+            ),
+            "int8_swap_moved_bytes": q8_out["bytes_moved"],
+            "int8_swap_full_bytes": q8_out["bytes_full"],
+            "int8_swap_saved_bytes": q8_out["bytes_saved_quant"],
+            "int8_swap_bytes_ratio": round(
+                q8_out["bytes_moved"] / fp_moved, 4
+            )
+            if fp_moved
+            else 0.0,
+            "int8_swap_ttft_s": round(q8_ttft, 4),
+            "int8_swap_effective_gibps": float(
+                f"{_eff_gibps(q8_out, q8_out['swap_total_s']):.3g}"
+            ),
+            "int8_greedy_equal": q8_equal,
+            "int8_logit_max_abs_diff": round(q8_diff, 6),
+            # 8-token greedy identical across quantized cycles: the
+            # lossy-once contract's bit-stability (weights rounded once,
+            # every later actuation reproduces the same bits)
+            "int8_cycle_stable": q8_stable,
+            "int8_hothead_swap_moved_bytes": q8h_out["bytes_moved"],
+            "int8_hothead_greedy_equal": q8h_equal,
+            "fp8_swap_moved_bytes": qf8_out["bytes_moved"],
+            "fp8_greedy_equal": qf8_equal,
+            "fp8_logit_max_abs_diff": round(qf8_diff, 6),
+            "fp8_cycle_stable": qf8_stable,
         },
     }
     if _trace_out_path():
@@ -1020,6 +1125,13 @@ def main() -> int:
         attempts.append(("tpu", dict(os.environ)))
     cpu_env = dict(os.environ)
     cpu_env["JAX_PLATFORMS"] = "cpu"
+    # The persistent XLA compilation cache is TPU-only for this bench
+    # (CPU deserialization flips numerics, see _measure), but the env var
+    # alone arms it — and a cache dir shared across heterogeneous runners
+    # makes XLA spew a multi-KiB "machine features mismatch" warning into
+    # every CPU child's stderr, drowning the result JSON tail the driver
+    # records. Scope it out of the CPU attempt entirely.
+    cpu_env.pop("JAX_COMPILATION_CACHE_DIR", None)
     # The TPU plugin's registration hook (on the image's extra PYTHONPATH
     # entry) overrides JAX_PLATFORMS; drop just that entry so the fallback
     # is pure CPU without losing unrelated path entries.

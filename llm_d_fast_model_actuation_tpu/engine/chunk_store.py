@@ -144,6 +144,18 @@ def aligned_digests(
     return out
 
 
+#: digest prefix marking chunks whose digest is NOT the plain content
+#: hash of their bytes (transfer-quantized payloads, models/quant.py
+#: transfer_digest): such chunks never spill — a written blob could not
+#: pass the reload content re-verification, so the write would only
+#: churn the disk tier
+QUANT_DIGEST_PREFIX = "q:"
+
+
+def digest_spillable(digest: str) -> bool:
+    return not digest.startswith(QUANT_DIGEST_PREFIX)
+
+
 @dataclass
 class _Chunk:
     digest: str
@@ -212,7 +224,8 @@ class ChunkStore:
         when the chunk is new. Returns ``(canonical_array, added_bytes)``:
         on a dedup hit the canonical array is the EXISTING chunk's (the
         caller drops its duplicate — that is the host-DRAM saving) and
-        added_bytes is 0."""
+        added_bytes is 0. Chunks under a :data:`QUANT_DIGEST_PREFIX`
+        digest never reach the disk tier (see :func:`digest_spillable`)."""
         with self._mu:
             c = self._chunks.get(digest)
             if c is not None:
@@ -222,7 +235,9 @@ class ChunkStore:
                 self._on_event("dedup_hit")
                 return c.data, 0
             nb = int(arr.nbytes)
-            self._chunks[digest] = _Chunk(digest=digest, data=arr, nbytes=nb, refs=1)
+            self._chunks[digest] = _Chunk(
+                digest=digest, data=arr, nbytes=nb, refs=1
+            )
             self.host_bytes += nb
             return arr, nb
 
@@ -235,7 +250,7 @@ class ChunkStore:
         if freed is None:
             return 0
         data, nb = freed
-        if spill:
+        if spill and digest_spillable(digest):
             self._spill(digest, data)
         return nb
 
@@ -246,9 +261,11 @@ class ChunkStore:
         returns ``(digest, data)`` for the caller to :meth:`spill` after
         dropping its own locks — the eviction loop runs under the pool
         mutex and must not do disk I/O there. None while still
-        referenced."""
+        referenced (or for never-spillable quant-digest chunks)."""
         freed = self._drop_ref(digest)
-        return None if freed is None else (digest, freed[0])
+        if freed is None or not digest_spillable(digest):
+            return None
+        return digest, freed[0]
 
     def _drop_ref(
         self, digest: str
@@ -328,7 +345,7 @@ class ChunkStore:
             return None
 
     def _spill(self, digest: str, data: np.ndarray) -> bool:
-        if not self._disk_enabled():
+        if not self._disk_enabled() or not digest_spillable(digest):
             return False
         with self._mu:
             if digest in self._disk:

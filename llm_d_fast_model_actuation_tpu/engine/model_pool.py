@@ -136,7 +136,9 @@ class HostModelPool:
 
         Only plain numpy leaves intern: pinned-host jax arrays (TPU sleep
         staging) are client-owned and cannot be shared across trees, so
-        they keep per-entry residency (documented in docs/perf.md)."""
+        they keep per-entry residency (documented in docs/perf.md).
+        Transfer-quantized payloads intern under ``"q:"`` digests, which
+        the chunk store never spills (chunk_store.digest_spillable)."""
         if self.chunks is None or not digests or self.budget_bytes <= 0:
             return tree, [], 0
         import numpy as np

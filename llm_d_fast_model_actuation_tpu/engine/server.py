@@ -184,6 +184,17 @@ ENGINE_SWAP_DELTA_BYTES = Gauge(
     ["model", "kind"],  # kind: moved | deduped
 )
 
+# Compressed actuation (docs/perf.md "Compressed actuation"): cumulative
+# wire bytes per transfer mode and direction across every sleep / wake /
+# swap edge — the signal for "what is --sleep-quant actually saving".
+# A Gauge used as a monotonic accumulator so the exposition name matches
+# the documented fma_engine_actuation_bytes{mode,dir} exactly.
+ENGINE_ACTUATION_BYTES = Gauge(
+    "fma_engine_actuation_bytes",
+    "Cumulative actuation transfer bytes by mode and direction",
+    ["mode", "dir"],  # mode: off | int8 | fp8; dir: d2h | h2d
+)
+
 # Self-healing observability (docs/operations.md "Self-healing and fault
 # drills"): every recovery edge — a swap failure rolled back in-process, or
 # a rollback that itself failed and flipped /health — is counted, so an
@@ -421,6 +432,28 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "engines",
     )
     p.add_argument(
+        "--sleep-quant",
+        default="off",
+        choices=["off", "int8", "fp8"],
+        help="compressed actuation transfers (docs/perf.md): level-1 "
+        "sleep offloads eligible weight stacks as int8 (per-channel "
+        "scales) or fp8 (e4m3), only the payload crosses PCIe, and wake "
+        "dequantizes on device — ~2x models per GiB of host pool and "
+        "~half the transfer bytes per actuation. OPT-IN AND LOSSY-ONCE: "
+        "the first quantized offload rounds the weights; every later "
+        "cycle reproduces the same bits. off (default) keeps every "
+        "sleep/wake/swap bit-exact",
+    )
+    p.add_argument(
+        "--sleep-quant-hot-head",
+        default="on",
+        choices=["on", "off"],
+        help="keep the 'hot head' (embeddings + final norm + lm_head) at "
+        "full precision under --sleep-quant (the numerics-conservative "
+        "default); off also quantizes embed/lm_head for maximum byte "
+        "savings",
+    )
+    p.add_argument(
         "--swap-bucket-mib",
         type=int,
         default=256,
@@ -554,6 +587,24 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
         raise ValueError("--swap-bucket-mib must be >= 1")
+    sq = getattr(args, "sleep_quant", "off") or "off"
+    if sq != "off":
+        from ..models import quant as transfer_quant
+
+        reason = transfer_quant.transfer_quant_supported(sq)
+        if reason:
+            raise ValueError(f"--sleep-quant {sq}: {reason}")
+        if getattr(args, "quantization", ""):
+            raise ValueError(
+                "--sleep-quant composes with full-precision serving only: "
+                "a --quantization int8 engine already holds (and moves) "
+                "int8 weights"
+            )
+        if args.tensor_parallel_size > 1:
+            raise ValueError(
+                "--sleep-quant requires --tensor-parallel-size 1 (sharded "
+                "offloads stage per-shard and reassemble bit-for-bit)"
+            )
     if getattr(args, "pool_disk_mib", 0) < 0:
         raise ValueError("--pool-disk-mib must be >= 0")
     if getattr(args, "exec_pool_mib", 0) < 0:
@@ -610,6 +661,11 @@ class _PrefetchedWeights:
     #: flat weight key -> content digest (engine/chunk_store.py): what the
     #: tiered pool dedupes on; carried into the runtime a swap builds
     digests: Optional[Dict[str, str]] = None
+    #: --sleep-quant staging: params_host leaves are int8/fp8 payloads and
+    #: this is the aligned TransferQuant-or-None list (models/quant.py) —
+    #: the consuming swap streams payloads and dequantizes on device
+    quant_metas: Optional[list] = None
+    quant_mode: str = "off"
 
 
 @dataclass
@@ -748,6 +804,17 @@ class EngineService:
             and args.tensor_parallel_size == 1
             and not getattr(args, "quantization", "")
         )
+        # Compressed actuation transfers (docs/perf.md "Compressed
+        # actuation"): opt-in int8/fp8 sleep/wake/swap via models/quant.py.
+        # Single-process only — gang offloads stage per-shard bit-for-bit.
+        self._sleep_quant = getattr(args, "sleep_quant", "off") or "off"
+        self._sleep_quant_hot_head = (
+            getattr(args, "sleep_quant_hot_head", "on") != "off"
+        )
+        if self._sleep_quant != "off" and dist is not None:
+            raise ValueError(
+                "--sleep-quant is not supported for multi-host gangs"
+            )
         from .chunk_store import ChunkStore, default_disk_dir
 
         chunks = None
@@ -894,8 +961,29 @@ class EngineService:
         interned = 0
         weight_digests = None
         if self._content_hash and self.model_pool.budget_bytes > 0:
+            from ..models import quant as transfer_quant
+
             if isinstance(runtime, _PrefetchedWeights):
-                if runtime.digests and runtime.params_host is not None:
+                if runtime.quant_metas is not None:
+                    # quantized staging: payloads intern under TRANSFER
+                    # digests (disjoint space — a payload must never be
+                    # handed out as the fp tensor it approximates), with
+                    # no eviction manifest; "q:" digests never spill
+                    # (a blob could not pass content re-verification)
+                    if runtime.params_host is not None:
+                        qmap = transfer_quant.transfer_digest_map(
+                            runtime.params_host,
+                            runtime.quant_metas,
+                            prefix="",
+                        )
+                        (
+                            runtime.params_host,
+                            chunk_digests,
+                            interned,
+                        ) = self.model_pool.intern_tree(
+                            runtime.params_host, qmap, prefix=""
+                        )
+                elif runtime.digests and runtime.params_host is not None:
                     (
                         runtime.params_host,
                         chunk_digests,
@@ -907,7 +995,29 @@ class EngineService:
             else:
                 digests = getattr(runtime, "digests", None)
                 host_state = getattr(runtime.sleeper, "_host_state", None)
-                if digests and host_state is not None:
+                quant_metas = getattr(runtime.sleeper, "_quant_meta", None)
+                if quant_metas is not None and host_state is not None:
+                    # quantized slept runtime: quantized leaves under
+                    # never-spilled "q:" transfer digests, untouched
+                    # hot-head leaves under their fp digests (correct
+                    # content — they dedupe AND spill with fp siblings);
+                    # no eviction manifest
+                    qmap = transfer_quant.transfer_digest_map(
+                        host_state, quant_metas, prefix="params"
+                    )
+                    merged = dict(qmap)
+                    for k, d in (digests or {}).items():
+                        if k not in merged:
+                            merged[k] = d
+                    (
+                        new_tree,
+                        chunk_digests,
+                        interned,
+                    ) = self.model_pool.intern_tree(
+                        host_state, merged, prefix="params"
+                    )
+                    runtime.sleeper._host_state = new_tree
+                elif digests and host_state is not None:
                     (
                         new_tree,
                         chunk_digests,
@@ -1115,6 +1225,7 @@ class EngineService:
         warmup: Optional[Any] = None,
         resolved: Optional[tuple] = None,
         staged_digests: Optional[Dict[str, str]] = None,
+        staged_quant: Optional[list] = None,
     ) -> _ModelRuntime:
         """Traced wrapper around the cold build: the `with` form ends the
         span (stamping the error) even when the build raises — the
@@ -1127,7 +1238,7 @@ class EngineService:
         ):
             return self._build_runtime_impl(
                 model_id, checkpoint_dir, staged_params, warmup, resolved,
-                staged_digests,
+                staged_digests, staged_quant,
             )
 
     def _build_runtime_impl(
@@ -1138,6 +1249,7 @@ class EngineService:
         warmup: Optional[Any] = None,
         resolved: Optional[tuple] = None,
         staged_digests: Optional[Dict[str, str]] = None,
+        staged_quant: Optional[list] = None,
     ) -> _ModelRuntime:
         """Cold-build an awake runtime for `model_id`: config -> tokenizer
         -> params (checkpoint / HF read, or random init) -> engine ->
@@ -1203,6 +1315,36 @@ class EngineService:
                     staged_params, model_cfg, mesh=mesh,
                     max_inflight_bytes=inflight, stats=lstats,
                 )
+                if staged_quant is not None:
+                    # quantized staging (--sleep-quant prefetch): the
+                    # placement streamed int8/fp8 payloads (half the PCIe
+                    # bytes); expand to serving precision on device,
+                    # aligned by flatten order with the staged tree
+                    import jax
+
+                    from ..models import quant as transfer_quant
+
+                    leaves, treedef = jax.tree.flatten(params)
+                    if len(staged_quant) != len(leaves):
+                        # fail LOUD: serving raw int8 payloads as weights
+                        # would be silent garbage, never a slow path
+                        raise RuntimeError(
+                            "quantized staging metadata does not align "
+                            f"with the placed tree ({len(staged_quant)} "
+                            f"metas vs {len(leaves)} leaves)"
+                        )
+                    payloads = []
+                    for i, meta in enumerate(staged_quant):
+                        if meta is None:
+                            continue
+                        payloads.append(leaves[i])
+                        leaves[i] = transfer_quant.dequantize_leaf(
+                            leaves[i], meta
+                        )
+                    params = jax.tree.unflatten(treedef, leaves)
+                    params = jax.block_until_ready(params)
+                    for p in payloads:
+                        p.delete()
             else:
                 # pipelined cold load: parallel shard readers + streaming
                 # placement straight into the serving sharding
@@ -1273,7 +1415,12 @@ class EngineService:
             )
             self._last_warmup = warmup
         self._last_build_stats = build_stats
-        sleeper = attach_sleep(engine, bucket_bytes=self._swap_bucket_bytes)
+        sleeper = attach_sleep(
+            engine,
+            bucket_bytes=self._swap_bucket_bytes,
+            quant_mode=self._sleep_quant,
+            quant_hot_head=self._sleep_quant_hot_head,
+        )
         self.builds_total += 1
         return _ModelRuntime(
             model_id=model_id,
@@ -1441,6 +1588,7 @@ class EngineService:
                         in_digests=(
                             rt.digests if self._content_hash else None
                         ),
+                        quant=self._sleep_quant,
                     )
                 except ValueError:
                     # precondition rejections fire before any transfer:
@@ -1555,6 +1703,7 @@ class EngineService:
                             warmup=warm,
                             resolved=resolved,
                             staged_digests=entry.runtime.digests,
+                            staged_quant=entry.runtime.quant_metas,
                         )
                     elif tier_params is not None:
                         # weights reconstructed from the chunk tiers:
@@ -1642,23 +1791,33 @@ class EngineService:
                 # DMA overlap).
                 b = self._last_build_stats
                 warm_stats = b.get("warmup")
+                out_stats = outgoing.sleeper.stats
                 cold_moved = (
-                    outgoing.sleeper.stats.bytes_offloaded
-                    + b.get("bytes_in", 0)
+                    out_stats.bytes_offloaded + b.get("bytes_in", 0)
+                )
+                cold_full = (
+                    out_stats.bytes_offloaded_full + b.get("bytes_in", 0)
                 )
                 metrics = {
                     "swap_total_s": 0.0,  # finalized below
-                    "d2h_s": outgoing.sleeper.stats.last_sleep_seconds,
+                    "d2h_s": out_stats.last_sleep_seconds,
                     "h2d_s": b.get("h2d_s", 0.0),
                     "overlap_s": b.get("overlap_s", 0.0),
                     "overlap_frac": b.get("overlap_frac", 0.0),
-                    "bytes_out": outgoing.sleeper.stats.bytes_offloaded,
+                    "bytes_out": out_stats.bytes_offloaded,
                     "bytes_in": b.get("bytes_in", 0),
                     # full transfer in both directions: a build streams
-                    # the whole incoming model regardless of content
+                    # the whole incoming model regardless of content.
+                    # Under --sleep-quant the OUTGOING offload still moved
+                    # only payload bytes (bytes_full records the
+                    # uncompressed total, same contract as swap_states).
                     "bytes_moved": cold_moved,
                     "bytes_deduped": 0,
                     "deduped_leaves": 0,
+                    "quant": out_stats.last_quant,
+                    "quant_leaves": 0,
+                    "bytes_full": cold_full,
+                    "bytes_saved_quant": max(0, cold_full - cold_moved),
                     "buckets_out": 0,
                     "buckets_in": b.get("buckets_in", 0),
                     "bucket_bytes": self._swap_bucket_bytes,
@@ -1690,6 +1849,15 @@ class EngineService:
             )
             ENGINE_SWAP_DELTA_BYTES.labels(model=model, kind="deduped").set(
                 metrics.get("bytes_deduped", 0)
+            )
+            # per-mode wire-byte accounting (docs/metrics.md): what the
+            # compressed path actually moved, by direction
+            swap_quant = metrics.get("quant", "off") or "off"
+            ENGINE_ACTUATION_BYTES.labels(mode=swap_quant, dir="d2h").inc(
+                metrics.get("bytes_out", 0)
+            )
+            ENGINE_ACTUATION_BYTES.labels(mode=swap_quant, dir="h2d").inc(
+                metrics.get("bytes_in", 0)
             )
             # a committed swap is proof the failure domain healed: clear
             # any DEGRADED marker from an earlier rolled-back attempt
@@ -1817,7 +1985,14 @@ class EngineService:
         model_cfg = hf_models.config_from_hf(
             hf_dir, quantization=self.args.quantization or ""
         )
-        est = hf_models.estimate_param_bytes(model_cfg)
+        # quant-aware admission: an int8/fp8-staged model occupies its
+        # payload bytes, not 2x that — the estimate must agree with what
+        # the worker below actually pools
+        est = hf_models.estimate_param_bytes(
+            model_cfg,
+            transfer_quant=self._sleep_quant,
+            hot_head=self._sleep_quant_hot_head,
+        )
         if est > self.model_pool.budget_bytes:
             ENGINE_PREFETCHES.labels(outcome="rejected").inc()
             raise ValueError(
@@ -1925,15 +2100,47 @@ class EngineService:
         t_staged = time.monotonic()
         import jax
 
-        nbytes = sum(x.nbytes for x in jax.tree.leaves(staged))
+        quant_metas = None
+        if self._sleep_quant != "off":
+            # compressed staging (docs/perf.md "Compressed actuation"):
+            # quantize host-side while no one is waiting — the pool holds
+            # payload bytes (~2x models per GiB) and the consuming swap
+            # streams payloads + dequantizes on device. The fp digests
+            # describe content this entry no longer carries; the pool
+            # interns payloads under transfer digests instead.
+            from ..models import quant as transfer_quant
+
+            plan = transfer_quant.transfer_quant_plan(
+                staged, hot_head=self._sleep_quant_hot_head, prefix=""
+            )
+            if any(plan):
+                leaves, treedef = jax.tree.flatten(staged)
+                quant_metas = [None] * len(leaves)
+                for i, flag in enumerate(plan):
+                    if flag:
+                        leaves[i], quant_metas[i] = (
+                            transfer_quant.quantize_leaf_np(
+                                leaves[i], self._sleep_quant
+                            )
+                        )
+                staged = jax.tree.unflatten(treedef, leaves)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(staged)) + (
+            sum(m.scale_nbytes for m in quant_metas if m is not None)
+            if quant_metas is not None
+            else 0
+        )
         pw = _PrefetchedWeights(
             model_id=model,
             checkpoint_dir=checkpoint_dir,
             params_host=staged,
             nbytes=nbytes,
             digests=(
-                dict(lstats.digests) or None if self._content_hash else None
+                dict(lstats.digests) or None
+                if self._content_hash and quant_metas is None
+                else None
             ),
+            quant_metas=quant_metas,
+            quant_mode=self._sleep_quant if quant_metas else "off",
         )
         evicted = self._pool_park(
             _pool_key(model, checkpoint_dir), pw, nbytes
@@ -1970,6 +2177,9 @@ class EngineService:
             "model": model,
             "checkpoint_dir": checkpoint_dir,
             "bytes": nbytes,
+            # staged representation: "off" = full precision, else the
+            # transfer mode the pooled payload carries
+            "quant": pw.quant_mode,
             "read_s": round(lstats.read_s, 6),
             "total_s": round(time.monotonic() - t0, 6),
             "shards": lstats.shards,
@@ -2307,6 +2517,11 @@ class EngineService:
                 self.engine.clear_executables()
                 self._last_warmup = None
             out = self.sleeper.sleep(level, release=self.release_on_sleep)
+        if out.get("bytes_offloaded"):
+            # per-mode wire bytes: payload bytes under --sleep-quant
+            ENGINE_ACTUATION_BYTES.labels(
+                mode=out.get("quant", "off") or "off", dir="d2h"
+            ).inc(out["bytes_offloaded"])
         self._publish_usage()
         return out
 
@@ -2321,6 +2536,10 @@ class EngineService:
                 "reason": "gang follower; wake is driven by the leader",
             }
         with self._admin_lock():
+            was_l1 = (
+                self.sleeper.level == 1
+                and not getattr(self.sleeper, "_staged", None)
+            )
             if self.engine.lockstep is not None and self.sleeper.is_sleeping:
                 self.engine.lockstep.wake()
             if self.sleeper.level == 2:
@@ -2398,6 +2617,12 @@ class EngineService:
             # re-validates (reinstalling spilled/pooled executables)
             # instead of recompiling
             self._reinstall_executables()
+            if was_l1 and self.sleeper.stats.last_wake_bytes:
+                # per-mode wire bytes the restore moved (payload bytes
+                # under --sleep-quant, with the on-device dequant after)
+                ENGINE_ACTUATION_BYTES.labels(
+                    mode=self.sleeper.stats.last_quant or "off", dir="h2d"
+                ).inc(self.sleeper.stats.last_wake_bytes)
         self._publish_usage()
         self._new_work.set()
         return out

@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
+from ..models import quant as transfer_quant
 from ..utils import faults, tracing
 from .device import (
     rebuild_spec,
@@ -136,7 +137,16 @@ class _Stats:
     last_sleep_seconds: float = 0.0
     last_wake_seconds: float = 0.0
     last_reacquire_seconds: float = 0.0
+    #: host bytes the slept state actually occupies (the quantized payload
+    #: bytes when --sleep-quant compressed the offload)
     bytes_offloaded: int = 0
+    #: full-precision bytes of the state that went to sleep (==
+    #: bytes_offloaded for uncompressed offloads)
+    bytes_offloaded_full: int = 0
+    #: transfer mode of the last level-1 offload: "off" | "int8" | "fp8"
+    last_quant: str = "off"
+    #: wire bytes the last wake moved host->device
+    last_wake_bytes: int = 0
     sleeps_total: int = 0
     wakes_total: int = 0
     releases_total: int = 0
@@ -163,11 +173,27 @@ class SleepManager:
         set_state,
         on_reacquire: Optional[Callable[[], None]] = None,
         bucket_bytes: Optional[int] = None,
+        quant_mode: str = "off",
+        quant_hot_head: bool = True,
     ) -> None:
         self._get_state = get_state
         self._set_state = set_state
         self._on_reacquire = on_reacquire
         self.bucket_bytes = bucket_bytes
+        #: compressed actuation (docs/perf.md "Compressed actuation"):
+        #: level-1 offloads quantize eligible weight leaves to int8/fp8 on
+        #: device, only the payload crosses the boundary, and wake
+        #: dequantizes on device. "off" (default) keeps every transfer
+        #: bit-exact.
+        self.quant_mode = "" if quant_mode in ("", "off") else quant_mode
+        self.quant_hot_head = quant_hot_head
+        #: per-leaf TransferQuant-or-None aligned with the flatten order of
+        #: ``_host_state`` while quantized-slept (None = fully fp sleep)
+        self._quant_meta: Optional[list] = None
+        #: int8 scales cached across cycles (aligned with the state's
+        #: flatten order): re-quantizing with the SAME scale makes every
+        #: cycle after the first reproduce identical payload bits
+        self._quant_scales: Optional[list] = None
         self._level = SleepLevel.AWAKE
         self._host_state: Optional[Any] = None
         self._shardings: Optional[Any] = None  # sharding objects (no release)
@@ -196,17 +222,68 @@ class SleepManager:
 
     # -- chunked transfer primitives -----------------------------------------
 
-    def _offload_leaves(self, leaves: list, to_numpy: bool) -> list:
+    def _quant_plan(self, state) -> Optional[list]:
+        """Per-leaf quantize-for-transfer flags for this state, or None
+        when the mode is off / nothing is eligible (multi-host staged
+        offloads never quantize — shards reassemble bit-for-bit)."""
+        if not self.quant_mode or jax.process_count() > 1:
+            return None
+        plan = transfer_quant.transfer_quant_plan(
+            state, hot_head=self.quant_hot_head
+        )
+        return plan if any(plan) else None
+
+    def _cached_scale(self, i: int, leaf) -> Optional[Any]:
+        """The int8 scale this leaf quantized with on its first offload
+        (idempotence: same scale -> same payload bits every cycle); None
+        until then or when the state structure changed."""
+        if self._quant_scales is None or i >= len(self._quant_scales):
+            return None
+        s = self._quant_scales[i]
+        if s is None:
+            return None
+        want = tuple(leaf.shape[: len(leaf.shape) - 2]) + (
+            1,
+            leaf.shape[-1],
+        )
+        return s if tuple(s.shape) == want else None
+
+    def _note_wake_quant(self, metas: Optional[list]) -> None:
+        """After a quantized wake (or swap commit): remember the scales so
+        the next offload re-quantizes to identical bits, and drop the
+        now-consumed payload metadata."""
+        if metas is not None and any(m is not None for m in metas):
+            self._quant_scales = [
+                (m.scale if m is not None else None) for m in metas
+            ]
+        self._quant_meta = None
+
+    def _offload_leaves(
+        self, leaves: list, to_numpy: bool, plan: Optional[list] = None
+    ) -> tuple:
         """Device -> host, bucket by bucket: each bucket's device HBM is
         freed as soon as its host copy lands, so peak duplicated state is
         ~one bucket (whole tree when bucket_bytes is None — one batched
         transfer, the round-trip-optimal default on high-latency links).
 
         ``to_numpy`` stages into plain numpy (release path / no
-        memory-kind backend); otherwise into pinned_host jax arrays."""
+        memory-kind backend); otherwise into pinned_host jax arrays.
+
+        ``plan`` (per-leaf flags from :meth:`_quant_plan`) quantizes the
+        flagged leaves ON DEVICE first, so only the int8/fp8 payload
+        crosses the boundary. Returns ``(host_leaves, metas)`` — metas is
+        the aligned TransferQuant-or-None list (None when no plan)."""
         host: list = [None] * len(leaves)
+        metas: Optional[list] = [None] * len(leaves) if plan else None
+        mode = self.quant_mode
+
+        def wire_nb(i):
+            if plan and plan[i]:
+                return transfer_quant.payload_nbytes(leaves[i].shape, mode)
+            return leaves[i].nbytes
+
         buckets = partition_buckets(
-            [x.nbytes for x in leaves], self.bucket_bytes
+            [wire_nb(i) for i in range(len(leaves))], self.bucket_bytes
         )
         # tracing hoisted out of the bucket loop: disabled = zero per-chunk
         # allocations on this hot path (utils/tracing.py)
@@ -217,10 +294,23 @@ class SleepManager:
             if traced:
                 sp = tracing.begin(
                     "sleep.d2h", parent=parent, activate=False,
-                    bytes=sum(leaves[i].nbytes for i in bucket),
+                    bytes=sum(wire_nb(i) for i in bucket),
                     leaves=len(bucket),
                 )
+            payload_devs: list = []
             try:
+                srcs = []
+                for i in bucket:
+                    if plan and plan[i]:
+                        p, meta = transfer_quant.quantize_leaf(
+                            leaves[i], mode,
+                            scale=self._cached_scale(i, leaves[i]),
+                        )
+                        metas[i] = meta
+                        payload_devs.append(p)
+                        srcs.append(p)
+                    else:
+                        srcs.append(leaves[i])
                 if to_numpy:
                     # force materialized copies: device_get can return
                     # views aliasing the device buffer on CPU-family
@@ -229,18 +319,14 @@ class SleepManager:
                     # release path) on its own
                     copies = [
                         np.array(h, copy=True)
-                        for h in jax.device_get(
-                            [leaves[i] for i in bucket]
-                        )
+                        for h in jax.device_get(srcs)
                     ]
                 else:
                     copies = jax.device_put(
-                        [leaves[i] for i in bucket],
+                        srcs,
                         [
-                            leaves[i].sharding.with_memory_kind(
-                                "pinned_host"
-                            )
-                            for i in bucket
+                            s.sharding.with_memory_kind("pinned_host")
+                            for s in srcs
                         ],
                     )
                     copies = jax.block_until_ready(copies)
@@ -253,24 +339,36 @@ class SleepManager:
                 raise
             for i, h in zip(bucket, copies):
                 host[i] = h
+            for p in payload_devs:
+                p.delete()  # the on-device staging payload served its copy
             for i in bucket:
                 leaves[i].delete()
             if sp is not None:
                 sp.end()
-        return host
+        return host, metas
 
     def _restore_leaves(
-        self, leaves: list, targets: list, free_host: bool
+        self,
+        leaves: list,
+        targets: list,
+        free_host: bool,
+        metas: Optional[list] = None,
     ) -> list:
         """Host -> device, bucket by bucket: each bucket blocks before the
         next is issued (bounds the in-flight transfer window) and, with
-        ``free_host``, releases its pinned-host source as it lands."""
+        ``free_host``, releases its pinned-host source as it lands.
+
+        ``metas`` (aligned TransferQuant-or-None) marks quantized-payload
+        leaves: the payload moves H2D, then dequantizes ON DEVICE — the
+        dequant of bucket k is dispatched async and rides under bucket
+        k+1's transfer, the same overlap discipline AOT warmup uses."""
         out: list = [None] * len(leaves)
         buckets = partition_buckets(
             [x.nbytes for x in leaves], self.bucket_bytes
         )
         traced = tracing.enabled()
         parent = tracing.current_context() if traced else None
+        deq_payloads: list = []  # device payloads to free once dequants land
         for bucket in buckets:
             sp = None
             if traced:
@@ -291,12 +389,22 @@ class SleepManager:
                     sp.end()
                 raise
             for i, d in zip(bucket, restored):
-                out[i] = d
+                if metas is not None and metas[i] is not None:
+                    # async dispatch: the expansion runs while the next
+                    # bucket's H2D is in flight
+                    out[i] = transfer_quant.dequantize_leaf(d, metas[i])
+                    deq_payloads.append(d)
+                else:
+                    out[i] = d
             if free_host:
                 for i in bucket:
                     leaves[i].delete()
             if sp is not None:
                 sp.end()
+        if deq_payloads:
+            jax.block_until_ready([o for o in out if o is not None])
+            for p in deq_payloads:
+                p.delete()
         return out
 
     # -- edges ---------------------------------------------------------------
@@ -323,12 +431,20 @@ class SleepManager:
                 self._staged = None
                 self._staged_meta = None
                 self._treedef = None
+                # the payload metadata dies with the host state; the scale
+                # cache too — a level-2 wake reinitializes weights, and
+                # stale scales must never quantize fresh content
+                self._quant_meta = None
+                self._quant_scales = None
                 self._level = SleepLevel.L2_DISCARD
                 self.stats.bytes_offloaded = 0
+                self.stats.bytes_offloaded_full = 0
+                self.stats.last_quant = "off"
             return self.describe()
         t0 = time.monotonic()
         state = self._get_state()
         nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
+        plan = self._quant_plan(state) if level == SleepLevel.L1_HOST_OFFLOAD else None
         if release:
             # Plain numpy staging: pinned_host buffers belong to the client
             # we are about to destroy. Save device-free sharding specs as a
@@ -342,9 +458,11 @@ class SleepManager:
                 # round trip per array); returns plain numpy, which
                 # survives the client destruction below
                 leaves, treedef = jax.tree.flatten(state)
-                self._host_state = jax.tree.unflatten(
-                    treedef, self._offload_leaves(leaves, to_numpy=True)
+                host_leaves, metas = self._offload_leaves(
+                    leaves, to_numpy=True, plan=plan
                 )
+                self._host_state = jax.tree.unflatten(treedef, host_leaves)
+                self._quant_meta = metas
             else:
                 self._host_state = None
         elif jax.process_count() > 1:
@@ -378,10 +496,11 @@ class SleepManager:
                 # array on high-latency links); device HBM is freed
                 # bucket-by-bucket inside _offload_leaves
                 leaves, treedef = jax.tree.flatten(state)
-                host_leaves = self._offload_leaves(
-                    leaves, to_numpy=not self._use_memory_kind
+                host_leaves, metas = self._offload_leaves(
+                    leaves, to_numpy=not self._use_memory_kind, plan=plan
                 )
                 self._host_state = jax.tree.unflatten(treedef, host_leaves)
+                self._quant_meta = metas
             else:
                 self._host_state = None
         # Release HBM now, not at GC time (chunked offload already deleted
@@ -396,7 +515,26 @@ class SleepManager:
             self.stats.releases_total += 1
         self._level = level
         self.stats.last_sleep_seconds = time.monotonic() - t0
-        self.stats.bytes_offloaded = nbytes if level == SleepLevel.L1_HOST_OFFLOAD else 0
+        if level == SleepLevel.L1_HOST_OFFLOAD:
+            self.stats.bytes_offloaded_full = nbytes
+            if self._host_state is not None and self._quant_meta is not None:
+                # actual host residency: payload + scale bytes for the
+                # quantized leaves, full precision for the rest
+                self.stats.bytes_offloaded = sum(
+                    x.nbytes for x in jax.tree.leaves(self._host_state)
+                ) + sum(
+                    m.scale_nbytes
+                    for m in self._quant_meta
+                    if m is not None
+                )
+                self.stats.last_quant = self.quant_mode or "off"
+            else:
+                self.stats.bytes_offloaded = nbytes
+                self.stats.last_quant = "off"
+        else:
+            self.stats.bytes_offloaded = 0
+            self.stats.bytes_offloaded_full = 0
+            self.stats.last_quant = "off"
         self.stats.sleeps_total += 1
         return self.describe()
 
@@ -433,31 +571,43 @@ class SleepManager:
             self._treedef = None
         elif self._level == SleepLevel.L1_HOST_OFFLOAD:
             assert self._host_state is not None
+            leaves, treedef = jax.tree.flatten(self._host_state)
+            metas = self._quant_meta
+            self.stats.last_wake_bytes = sum(x.nbytes for x in leaves) + (
+                sum(m.scale_nbytes for m in metas if m is not None)
+                if metas is not None
+                else 0
+            )
             if self._released:
                 assert self._sharding_specs is not None
                 # bucket-by-bucket: shardings are rebuilt on the fresh
                 # client and each bucket lands before the next is issued
                 # (bounded in-flight window; whole tree = one bucket by
                 # default)
-                leaves, treedef = jax.tree.flatten(self._host_state)
                 restored = self._restore_leaves(
                     leaves,
                     [rebuild_spec(spec) for spec in self._sharding_specs],
                     free_host=False,
+                    metas=metas,
                 )
                 state = jax.tree.unflatten(treedef, restored)
             else:
                 # batched transfer per bucket (see sleep); pinned-host
                 # sources are released as their bucket lands
-                leaves, treedef = jax.tree.flatten(self._host_state)
                 shardings, _ = jax.tree.flatten(self._shardings)
                 restored = self._restore_leaves(
-                    leaves, shardings, free_host=self._use_memory_kind
+                    leaves, shardings, free_host=self._use_memory_kind,
+                    metas=metas,
                 )
                 state = jax.tree.unflatten(treedef, restored)
+            self._note_wake_quant(metas)
         else:
             if reinit is None:
                 raise ValueError("level-2 wake requires a reinit callback")
+            # fresh state: cached scales describe weights that no longer
+            # exist and must never quantize the reinitialized content
+            self._quant_scales = None
+            self._quant_meta = None
             state = reinit()
         self._host_state = None
         self._sharding_specs = None
@@ -469,12 +619,28 @@ class SleepManager:
         self.stats.wakes_total += 1
         return self.describe()
 
+    def quant_state(self) -> str:
+        """Transfer mode of the currently-slept payload ("off" when the
+        host state is full precision / not level-1 slept)."""
+        if self._quant_meta is not None and any(
+            m is not None for m in self._quant_meta
+        ):
+            return self._quant_meta[
+                next(
+                    i for i, m in enumerate(self._quant_meta)
+                    if m is not None
+                )
+            ].mode
+        return "off"
+
     def describe(self) -> Dict[str, Any]:
         return {
             "is_sleeping": self.is_sleeping,
             "level": int(self._level),
             "devices_released": self._released,
             "bytes_offloaded": self.stats.bytes_offloaded,
+            "bytes_offloaded_full": self.stats.bytes_offloaded_full,
+            "quant": self.stats.last_quant,
             "last_sleep_seconds": self.stats.last_sleep_seconds,
             "last_wake_seconds": self.stats.last_wake_seconds,
             "last_reacquire_seconds": self.stats.last_reacquire_seconds,
@@ -488,6 +654,7 @@ def swap_states(
     overlapped: bool = True,
     out_digests: Optional[Dict[str, str]] = None,
     in_digests: Optional[Dict[str, str]] = None,
+    quant: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Overlapped model hot-swap: stream the awake model behind ``out_mgr``
     to host while restoring ``in_mgr``'s slept (level-1, non-released) state
@@ -541,6 +708,25 @@ def swap_states(
     Reported as ``bytes_moved`` / ``bytes_deduped`` (and the
     ``swap.delta`` trace span). ``None`` digests = the pre-delta full
     transfer, bit-for-bit the old behavior.
+
+    **Quantized transfers** (``quant="int8"|"fp8"``, default = the
+    outgoing manager's mode; docs/perf.md "Compressed actuation"):
+    eligible outgoing weight leaves quantize ON DEVICE and only the
+    payload crosses PCIe; an incoming model slept quantized moves its
+    payload and dequantizes ON DEVICE after each bucket lands (the
+    expansion rides under the next bucket's transfer); an incoming model
+    slept at full precision gets a host-side quantized *staging copy* for
+    the transfer while its pooled host state is never touched — a
+    rollback re-pools it bit-exact. The transactional contract holds:
+    rolled-back outgoing leaves are re-uploaded from their payloads and
+    dequantized with the same cached scales, reproducing the exact
+    post-quantization bits every cycle after a model's first quantized
+    offload (the lossy-once contract). Composes with the delta path:
+    digest-matched leaves still skip both directions entirely. Byte
+    metrics (``bytes_out``/``bytes_in``/``bytes_moved``) count WIRE
+    bytes; ``bytes_full`` carries the uncompressed total and
+    ``bytes_saved_quant`` the difference (the ``swap.quant`` span mirrors
+    them).
     """
     if out_mgr.is_sleeping:
         raise ValueError("swap-out model must be awake")
@@ -578,11 +764,34 @@ def swap_states(
     shard_in, _ = jax.tree.flatten(in_mgr._shardings)
     nb_in = [x.nbytes for x in leaves_in]
 
+    # Quantized-transfer planning (docstring): which outgoing leaves
+    # compress on device, which incoming leaves are already payloads
+    # (quantized-slept), and the per-leaf metadata the commit hands over.
+    qmode = quant if quant is not None else (out_mgr.quant_mode or "off")
+    qmode = "" if qmode in ("", "off") else qmode
+    out_plan = out_mgr._quant_plan(state_out) if qmode else None
+    meta_out: list = [None] * len(leaves_out)
+    in_metas: list = (
+        list(in_mgr._quant_meta)
+        if in_mgr._quant_meta is not None
+        else [None] * len(leaves_in)
+    )
+    in_meta_nb = [
+        (m.scale_nbytes if m is not None else 0) for m in in_metas
+    ]
+
     # Delta matching (module docstring): pair incoming leaves with
     # content-identical live outgoing leaves by digest. Matched pairs are
     # excluded from BOTH transfer directions; the handover itself happens
     # only at commit, so every pre-commit code path (including rollback)
-    # sees them untouched.
+    # sees them untouched. A quantized-slept incoming leaf's digest names
+    # its ORIGINAL full-precision content, so the dtype check compares
+    # against the payload's origin dtype, not the int8/fp8 carrier.
+    # Under --sleep-quant, digest matching on the fp ORIGIN stays value-
+    # consistent: quantization is deterministic over identical origin
+    # bits (and scale-cached thereafter), so a handed-over live array is
+    # either the shared fp content itself or the identical
+    # post-quantization bits the incoming payload would dequantize to.
     reuse_pairs: List[tuple] = []  # (incoming idx, outgoing idx)
     if out_digests and in_digests:
         dl_out = _aligned(state_out, out_digests)
@@ -597,9 +806,14 @@ def swap_states(
                 continue
             j = cands[0]
             lo, li = leaves_out[j], leaves_in[i]
+            li_dtype = (
+                np.dtype(in_metas[i].orig_dtype)
+                if in_metas[i] is not None
+                else li.dtype
+            )
             if (
                 tuple(lo.shape) == tuple(li.shape)
-                and lo.dtype == li.dtype
+                and lo.dtype == li_dtype
                 and shard_out[j] == shard_in[i]
             ):
                 reuse_pairs.append((i, j))
@@ -608,23 +822,71 @@ def swap_states(
     reused_out = {j for _, j in reuse_pairs}
     move_out = [i for i in range(len(leaves_out)) if i not in reused_out]
     move_in = [i for i in range(len(leaves_in)) if i not in reused_in]
+
+    # Host-side staging quantization for a full-precision incoming entry
+    # under quant mode: the payload staging copies move instead of the fp
+    # host state, which stays untouched until commit (rollback re-pools it
+    # bit-exact). Only leaves that actually move are staged.
+    stage_in: list = [None] * len(leaves_in)
+    if qmode and in_mgr._quant_meta is None:
+        in_plan = transfer_quant.transfer_quant_plan(
+            in_mgr._host_state, hot_head=in_mgr.quant_hot_head
+        )
+        for i in move_in:
+            if in_plan[i]:
+                stage_in[i], in_metas[i] = transfer_quant.quantize_leaf_np(
+                    np.asarray(leaves_in[i]), qmode
+                )
+                in_meta_nb[i] = in_metas[i].scale_nbytes
+
+    # Wire bytes per leaf: what actually crosses the device boundary —
+    # payload + scale for quantized leaves, the full leaf otherwise. All
+    # bucket partitioning and byte metrics below run on wire bytes.
+    wnb_out = [
+        transfer_quant.payload_nbytes(leaves_out[i].shape, qmode)
+        if out_plan and out_plan[i]
+        else nb_out[i]
+        for i in range(len(leaves_out))
+    ]
+    wnb_in = [
+        (stage_in[i].nbytes if stage_in[i] is not None else nb_in[i])
+        + in_meta_nb[i]
+        for i in range(len(leaves_in))
+    ]
     buckets_out = [
         [move_out[k] for k in b]
-        for b in partition_buckets([nb_out[i] for i in move_out], bucket_bytes)
+        for b in partition_buckets(
+            [wnb_out[i] for i in move_out], bucket_bytes
+        )
     ]
     buckets_in = [
         [move_in[k] for k in b]
-        for b in partition_buckets([nb_in[i] for i in move_in], bucket_bytes)
+        for b in partition_buckets(
+            [wnb_in[i] for i in move_in], bucket_bytes
+        )
     ]
 
     host_out: list = [None] * len(leaves_out)
     dev_in: list = [None] * len(leaves_in)
-    bytes_out = sum(nb_out)
-    bytes_in = sum(nb_in)
-    deduped_bytes = sum(nb_out[j] for j in reused_out) + sum(
-        nb_in[i] for i in reused_in
+    bytes_out = sum(wnb_out)
+    bytes_in = sum(wnb_in)
+    bytes_full = sum(nb_out) + sum(
+        nb_in[i]
+        if in_metas[i] is None
+        else int(
+            np.prod(leaves_in[i].shape)
+            * np.dtype(in_metas[i].orig_dtype).itemsize
+        )
+        for i in range(len(leaves_in))
+    )
+    deduped_bytes = sum(wnb_out[j] for j in reused_out) + sum(
+        wnb_in[i] for i in reused_in
     )
     moved_bytes = bytes_out + bytes_in - deduped_bytes
+    quant_leaves = (
+        sum(1 for i in move_out if out_plan and out_plan[i])
+        + sum(1 for i in move_in if in_metas[i] is not None)
+    )
     if reuse_pairs and traced:
         dsp = tracing.begin(
             "swap.delta",
@@ -635,8 +897,28 @@ def swap_states(
             bytes_moved=moved_bytes,
         )
         dsp.end()
-    bsize_out = [sum(nb_out[i] for i in b) for b in buckets_out]
-    bsize_in = [sum(nb_in[i] for i in b) for b in buckets_in]
+    quant_active = bool(out_plan) or any(
+        m is not None for m in in_metas
+    )
+    quant_mode_used = (
+        qmode or next((m.mode for m in in_metas if m is not None), "off")
+        if quant_active
+        else "off"
+    )
+    if quant_active and traced:
+        qsp = tracing.begin(
+            "swap.quant",
+            parent=root_ctx,
+            activate=False,
+            mode=quant_mode_used,
+            leaves=quant_leaves,
+            bytes_wire=bytes_out + bytes_in,
+            bytes_full=bytes_full,
+            bytes_saved=max(0, bytes_full - (bytes_out + bytes_in)),
+        )
+        qsp.end()
+    bsize_out = [sum(wnb_out[i] for i in b) for b in buckets_out]
+    bsize_in = [sum(wnb_in[i] for i in b) for b in buckets_in]
 
     in_flight = 0
     peak_in_flight = 0
@@ -664,30 +946,43 @@ def swap_states(
                 "swap.d2h", parent=root_ctx, activate=False,
                 bucket=k, bytes=bsize_out[k],
             )
+        payload_devs: list = []
         try:
             faults.fire("swap.d2h")
             bucket = buckets_out[k]
+            srcs = []
+            for i in bucket:
+                if out_plan and out_plan[i]:
+                    # on-device quantization: only the payload crosses
+                    # PCIe; cached scales keep re-quantization bit-stable
+                    p, meta = transfer_quant.quantize_leaf(
+                        leaves_out[i], qmode,
+                        scale=out_mgr._cached_scale(i, leaves_out[i]),
+                    )
+                    meta_out[i] = meta
+                    payload_devs.append(p)
+                    srcs.append(p)
+                else:
+                    srcs.append(leaves_out[i])
             if use_mk:
                 copies = jax.device_put(
-                    [leaves_out[i] for i in bucket],
+                    srcs,
                     [
-                        shard_out[i].with_memory_kind("pinned_host")
-                        for i in bucket
+                        s.sharding.with_memory_kind("pinned_host")
+                        for s in srcs
                     ],
                 )
             else:
                 # real copies (not views of the buffers deleted below),
                 # same as the SleepManager staging path
-                copies = [
-                    np.array(leaves_out[i], copy=True) for i in bucket
-                ]
+                copies = [np.array(s, copy=True) for s in srcs]
         except BaseException as e:
             _fail_span(sp, e)
             raise
         in_flight += bsize_out[k]
         if in_flight > peak_in_flight:
             peak_in_flight = in_flight
-        return k, copies, sp
+        return k, copies, payload_devs, sp
 
     #: threaded (numpy-staging) mode: outgoing buffer deletes are deferred
     #: to the commit phase so the main thread never mutates client buffer
@@ -695,9 +990,14 @@ def swap_states(
     #: "device" memory is host RAM, so nothing is gained by eager frees
     deferred_deletes: List[int] = []
 
+    #: on-device staging payloads whose frees are deferred in threaded
+    #: (numpy-staging) mode — same rule as deferred_deletes below: the
+    #: main thread must not mutate client buffer state mid-device_put
+    deferred_payload_frees: List[Any] = []
+
     def _finish_d2h(pending):
         nonlocal in_flight
-        k, copies, sp = pending
+        k, copies, payload_devs, sp = pending
         bucket = buckets_out[k]
         if use_mk:
             try:
@@ -708,10 +1008,13 @@ def swap_states(
         for i, h in zip(bucket, copies):
             host_out[i] = h
         if h2d_pool is None:
+            for p in payload_devs:
+                p.delete()  # staging payload: its host copy just landed
             for i in bucket:
                 leaves_out[i].delete()  # the HBM the next h2d bucket fills
             deleted_out.update(bucket)
         else:
+            deferred_payload_frees.extend(payload_devs)
             deferred_deletes.extend(bucket)
         in_flight -= bsize_out[k]
         if sp is not None:
@@ -740,8 +1043,14 @@ def swap_states(
 
     def _h2d_transfer(j):
         bucket = buckets_in[j]
+        # staged payload (host-quantized fp entry) or the host leaf itself
+        # (a payload already, for a quantized-slept entry; fp otherwise)
         return jax.device_put(
-            [leaves_in[i] for i in bucket], [shard_in[i] for i in bucket]
+            [
+                stage_in[i] if stage_in[i] is not None else leaves_in[i]
+                for i in bucket
+            ],
+            [shard_in[i] for i in bucket],
         )
 
     def _issue_h2d(j):
@@ -768,6 +1077,10 @@ def swap_states(
             peak_in_flight = in_flight
         return j, restored, sp
 
+    #: device payloads of incoming quantized leaves, freed once their
+    #: dequant (dispatched async below) has landed
+    in_payload_devs: List[Any] = []
+
     def _finish_h2d(pending):
         nonlocal in_flight
         j, restored, sp = pending
@@ -780,7 +1093,13 @@ def swap_states(
             _fail_span(sp, e)
             raise
         for i, d in zip(bucket, restored):
-            dev_in[i] = d
+            if in_metas[i] is not None:
+                # on-device dequant, dispatched async: the expansion to
+                # full precision rides under the next bucket's transfers
+                dev_in[i] = transfer_quant.dequantize_leaf(d, in_metas[i])
+                in_payload_devs.append(d)
+            else:
+                dev_in[i] = d
         if use_mk:
             # NOT freed here: the incoming pool entry must survive intact
             # until the swap commits, so a mid-transfer failure can put it
@@ -826,7 +1145,7 @@ def swap_states(
         # (its device leaves are only deleted by _finish_d2h, which did
         # not run for a still-pending bucket)
         if pend_d2h is not None:
-            k, copies, _sp = pend_d2h
+            k, copies, pdevs, _sp = pend_d2h
             if _sp is not None and not _sp.ended:
                 _sp.set(error="rolled_back")
                 _sp.end()
@@ -835,21 +1154,46 @@ def swap_states(
                     copies = jax.block_until_ready(copies)
                 for i, h in zip(buckets_out[k], copies):
                     host_out[i] = h
+                for p in pdevs:
+                    p.delete()
             except Exception:  # noqa: BLE001 — the failed transfer itself
                 pass
+        try:
+            # quantized incoming leaves have async dequants in flight:
+            # they must land (or fail) before their arrays are reclaimed
+            jax.block_until_ready([a for a in dev_in if a is not None])
+        except Exception:  # noqa: BLE001 — a failed dequant is dropped too
+            pass
         for a in dev_in:
             if a is not None:
                 a.delete()
+        for p in in_payload_devs:
+            p.delete()
         # re-upload freed outgoing leaves, bucket-by-bucket (same bounded
-        # in-flight window as the forward direction)
+        # in-flight window as the forward direction). Quantized leaves
+        # re-upload their payload and dequantize on device: the cached
+        # scales make the result bit-identical to the post-quantization
+        # weights every cycle after the model's first quantized offload
+        # (the lossy-once contract, docs/perf.md).
         idxs = sorted(deleted_out)
-        for b in partition_buckets([nb_out[i] for i in idxs], bucket_bytes):
+        for b in partition_buckets([wnb_out[i] for i in idxs], bucket_bytes):
             bidx = [idxs[i] for i in b]
             back = jax.device_put(
                 [host_out[i] for i in bidx], [shard_out[i] for i in bidx]
             )
-            for i, a in zip(bidx, jax.block_until_ready(back)):
-                leaves_out[i] = a
+            back = jax.block_until_ready(back)
+            expanded = []
+            for i, a in zip(bidx, back):
+                if meta_out[i] is not None:
+                    d = transfer_quant.dequantize_leaf(a, meta_out[i])
+                    expanded.append((a, d))
+                    leaves_out[i] = d
+                else:
+                    leaves_out[i] = a
+            if expanded:
+                jax.block_until_ready([d for _, d in expanded])
+                for a, _ in expanded:
+                    a.delete()
         if use_mk:
             # staging copies served their purpose (re-upload done): free
             # the pinned-host bytes
@@ -859,6 +1203,15 @@ def swap_states(
         # the re-uploaded leaves are NEW arrays; the engine must point at
         # them (their originals are deleted)
         out_mgr._set_state(jax.tree.unflatten(treedef_out, leaves_out))
+        if any(m is not None for m in meta_out):
+            # a rolled-back FIRST quantized offload already rounded the
+            # re-uploaded leaves: cache the scales it used, so the next
+            # offload re-quantizes to the identical bits instead of
+            # recomputing a perturbed scale from the rounded weights
+            # (which could flip roundings — a second lossy step)
+            out_mgr._quant_scales = [
+                (m.scale if m is not None else None) for m in meta_out
+            ]
 
     d2h_t0 = time.monotonic()
     try:
@@ -912,11 +1265,19 @@ def swap_states(
             f"hot-swap transfer failed mid-flight; rolled back "
             f"({type(exc).__name__}: {exc})"
         ) from exc
+    if in_payload_devs:
+        # the last buckets' async dequants are part of the wake window:
+        # land them, then free the device payload staging
+        jax.block_until_ready([a for a in dev_in if a is not None])
+        for p in in_payload_devs:
+            p.delete()
     h2d_t1 = time.monotonic()
     if h2d_t0 is None:  # empty incoming tree (degenerate)
         h2d_t0 = h2d_t1
     if h2d_pool is not None:
         h2d_pool.shutdown(wait=True)  # no transfer outlives the swap
+        for p in deferred_payload_frees:
+            p.delete()
         for i in deferred_deletes:
             leaves_out[i].delete()
     if use_mk:
@@ -929,20 +1290,32 @@ def swap_states(
     # over the outgoing model's live device array (content-identical by
     # digest), and the incoming host copy becomes the outgoing model's
     # slept host state — zero bytes crossed the device boundary for them.
+    # A quantized incoming host copy carries its payload metadata along to
+    # the outgoing model's slept state.
     for i, j in reuse_pairs:
         dev_in[i] = leaves_out[j]
         host_out[j] = leaves_in[i]
+        meta_out[j] = in_metas[i]
 
     # Commit the state-machine edges: outgoing asleep (poolable host
     # state), incoming awake.
     out_mgr._host_state = jax.tree.unflatten(treedef_out, host_out)
+    out_mgr._quant_meta = (
+        meta_out if any(m is not None for m in meta_out) else None
+    )
     out_mgr._shardings = jax.tree.unflatten(treedef_out, shard_out)
     out_mgr._sharding_specs = None
     out_mgr._staged = None
     out_mgr._set_state(None)
     out_mgr._level = SleepLevel.L1_HOST_OFFLOAD
     out_mgr.stats.last_sleep_seconds = d2h_t1 - d2h_t0
-    out_mgr.stats.bytes_offloaded = bytes_out
+    out_mgr.stats.bytes_offloaded = sum(
+        x.nbytes for x in host_out if x is not None
+    ) + sum(m.scale_nbytes for m in meta_out if m is not None)
+    out_mgr.stats.bytes_offloaded_full = sum(nb_out)
+    out_mgr.stats.last_quant = (
+        quant_mode_used if out_mgr._quant_meta is not None else "off"
+    )
     out_mgr.stats.sleeps_total += 1
 
     in_mgr._host_state = None
@@ -950,8 +1323,13 @@ def swap_states(
     in_mgr._sharding_specs = None
     in_mgr._set_state(jax.tree.unflatten(treedef_in, dev_in))
     in_mgr._level = SleepLevel.AWAKE
+    # scales cached for the incoming model's NEXT offload (bit-stable
+    # re-quantization); payload metadata is consumed by this wake
+    in_mgr._note_wake_quant(in_metas)
     in_mgr.stats.last_wake_seconds = h2d_t1 - h2d_t0
+    in_mgr.stats.last_wake_bytes = bytes_in
     in_mgr.stats.bytes_offloaded = 0
+    in_mgr.stats.bytes_offloaded_full = 0
     in_mgr.stats.wakes_total += 1
 
     total = time.monotonic() - t_begin
@@ -981,6 +1359,11 @@ def swap_states(
         "bytes_moved": moved_bytes,
         "bytes_deduped": deduped_bytes,
         "deduped_leaves": len(reuse_pairs),
+        # compressed-actuation accounting (docstring): wire vs full bytes
+        "quant": quant_mode_used,
+        "quant_leaves": quant_leaves,
+        "bytes_full": bytes_full,
+        "bytes_saved_quant": max(0, bytes_full - (bytes_out + bytes_in)),
         "buckets_out": len(buckets_out),
         "buckets_in": len(buckets_in),
         "bucket_bytes": bucket_bytes,
@@ -988,10 +1371,20 @@ def swap_states(
     }
 
 
-def attach_sleep(engine, bucket_bytes: Optional[int] = None) -> SleepManager:
+def attach_sleep(
+    engine,
+    bucket_bytes: Optional[int] = None,
+    quant_mode: str = "off",
+    quant_hot_head: bool = True,
+) -> SleepManager:
     """Wire a SleepManager to an InferenceEngine: the offloadable state is
     (params, kv page pool). Page tables / host bookkeeping stay put, so the
-    wake fast path resumes in-flight sequences."""
+    wake fast path resumes in-flight sequences.
+
+    ``quant_mode`` opts the level-1 offload path into compressed transfers
+    (int8/fp8 payloads + on-device dequant; docs/perf.md "Compressed
+    actuation"); ``quant_hot_head`` keeps embeddings / final norm /
+    lm_head at full precision (the default)."""
 
     def get_state():
         # a dispatched-but-unread decode chunk would be lost with the
@@ -1017,4 +1410,6 @@ def attach_sleep(engine, bucket_bytes: Optional[int] = None) -> SleepManager:
         set_state,
         on_reacquire=engine.on_device_reacquire,
         bucket_bytes=bucket_bytes,
+        quant_mode=quant_mode,
+        quant_hot_head=quant_hot_head,
     )

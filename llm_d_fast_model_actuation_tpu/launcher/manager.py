@@ -177,6 +177,11 @@ class ChipLedger:
         #: multi-model scheduler reads to pick a warm victim/target
         #: without an extra engine round trip.
         self._pools: Dict[str, Dict[str, Any]] = {}
+        #: instance_id -> transfer mode of the holder's last swap ("off" |
+        #: "int8" | "fp8"): whether this holder actuates compressed
+        #: (docs/perf.md "Compressed actuation") — the byte-cost signal a
+        #: scheduler weighs against the models' numerics requirements.
+        self._quant: Dict[str, str] = {}
 
     def overlapping(
         self, chip_ids: Optional[List[str]], exclude: Optional[str] = None
@@ -201,6 +206,7 @@ class ChipLedger:
         self._models.pop(instance_id, None)
         self._prefetched.pop(instance_id, None)
         self._pools.pop(instance_id, None)
+        self._quant.pop(instance_id, None)
 
     def set_model(self, instance_id: str, model: str) -> None:
         """Record which model a holder serves (updated on hot-swap). A
@@ -235,6 +241,15 @@ class ChipLedger:
             "disk_bytes": chunks.get("disk_bytes", 0),
             "staged_manifests": list(pool.get("staged_manifests") or []),
         }
+
+    def set_quant(self, instance_id: str, quant: Optional[str]) -> None:
+        """Record the transfer mode of a holder's last swap answer (None
+        / unknown answers leave the last known value)."""
+        if quant and instance_id in self._held:
+            self._quant[instance_id] = quant
+
+    def quants(self) -> Dict[str, str]:
+        return dict(self._quant)
 
     def holders(self) -> Dict[str, List[str]]:
         return dict(self._held)
@@ -673,6 +688,7 @@ class EngineProcessManager:
         )
         self.ledger.set_model(instance_id, model)
         self.ledger.set_pool(instance_id, body.get("pool"))
+        self.ledger.set_quant(instance_id, body.get("quant"))
         obj = instance.get_status()
         obj["swap"] = body
         instance.last_revision = self._publish("SWAPPED", obj)
@@ -947,6 +963,9 @@ class EngineProcessManager:
                 "models": self.ledger.models(),
                 "prefetched": self.ledger.prefetched(),
                 "pools": self.ledger.pools(),
+                # per-holder transfer mode of the last swap ("int8"/"fp8"
+                # when the holder actuates compressed, docs/perf.md)
+                "quant": self.ledger.quants(),
             },
         }
 

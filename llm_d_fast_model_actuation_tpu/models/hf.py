@@ -978,12 +978,22 @@ def place_staged_params(
     return params
 
 
-def estimate_param_bytes(cfg: LlamaConfig) -> int:
-    """Host bytes a staged (cfg.dtype, unquantized) copy of the model
-    occupies — the prefetch budget pre-check. Shapes only; nothing read."""
+def estimate_param_bytes(
+    cfg: LlamaConfig,
+    transfer_quant: str = "off",
+    hot_head: bool = True,
+) -> int:
+    """Host bytes a staged copy of the model occupies — the prefetch
+    budget pre-check. Shapes only; nothing read.
+
+    ``transfer_quant`` ("int8"/"fp8", --sleep-quant) sizes the leaves the
+    compressed staging path quantizes at their payload+scale bytes instead
+    of cfg.dtype — without it the admission check would over-reserve ~2x
+    for an int8-staged model and reject prefetches that actually fit."""
     import jax
 
     from .registry import init_params_for
+    from . import quant as quant_mod
 
     plain = (
         dataclasses.replace(cfg, quantization="")
@@ -994,10 +1004,23 @@ def estimate_param_bytes(cfg: LlamaConfig) -> int:
         lambda: init_params_for(jax.random.key(0), plain)
     )
     itemsize = np.dtype(cfg.dtype).itemsize
-    return sum(
-        int(np.prod(node.shape)) * itemsize
-        for _, node in _flatten(shapes)
-    )
+    mode = transfer_quant if transfer_quant not in ("", "off") else ""
+    if not mode:
+        return sum(
+            int(np.prod(node.shape)) * itemsize
+            for _, node in _flatten(shapes)
+        )
+    import jax.tree_util as jtu
+
+    flat_leaves = jtu.tree_flatten(shapes)[0]
+    plan = quant_mod.transfer_quant_plan(shapes, hot_head=hot_head, prefix="")
+    total = 0
+    for leaf, q in zip(flat_leaves, plan):
+        if q:
+            total += quant_mod.payload_nbytes(leaf.shape, mode)
+        else:
+            total += int(np.prod(leaf.shape)) * itemsize
+    return total
 
 
 def load_model(

@@ -20,9 +20,12 @@ tied-head use stays bf16 too).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 #: weight stacks quantized in a llama-family layer pytree + top level
 LAYER_WEIGHTS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
@@ -72,6 +75,256 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     head = params.get("lm_head")
     if head is not None and not is_quantized(head):
         out["lm_head"] = quantize_weight(head)
+    return out
+
+
+# -- compressed actuation transfers (docs/perf.md "Compressed actuation") ----
+#
+# The serving-path W8A16 above changes what the MODEL computes; the
+# transfer quantization below changes only how weight bytes CROSS the
+# PCIe/host boundary on sleep/wake/swap (engine/sleep.py). A leaf is
+# quantized right before it leaves HBM (or host-side when staging a
+# full-precision pool entry), the half-size payload moves, and the wake
+# dequantizes on device — the engine always serves plain cfg.dtype arrays,
+# so no program recompiles and `qmat` never sees these.
+#
+# Numerics contract: opt-in and lossy-ONCE. The first quantized offload
+# rounds each eligible weight to its int8/fp8 representation; every later
+# cycle reproduces the exact same post-quantization bits, because (a) the
+# int8 scale is cached by the sleeper and reused (re-quantizing
+# dequant(q, s) with the same s recovers q exactly: |q|<=127 and the
+# bf16/f32 round-trip error is < 0.25 of a quantization step) and (b) the
+# fp8 path is a plain dtype round trip, exact by construction.
+
+#: transfer quantization modes (--sleep-quant)
+TRANSFER_MODES = ("off", "int8", "fp8")
+
+#: top-level leaves the default "hot head" keeps at full precision
+HOT_HEAD_KEYS = ("embed", "lm_head")
+
+
+def fp8_dtype():
+    """The fp8 transfer dtype (e4m3: weight-shaped range, 3 mantissa
+    bits). Raises ImportError where ml_dtypes lacks it."""
+    import ml_dtypes
+
+    return ml_dtypes.float8_e4m3fn
+
+
+def transfer_quant_supported(mode: str) -> Optional[str]:
+    """None when `mode` can run here, else a human reason (the flag
+    validation surface)."""
+    if mode in ("", "off"):
+        return None
+    if mode not in TRANSFER_MODES:
+        return f"unknown sleep-quant mode {mode!r} (want {TRANSFER_MODES})"
+    if mode == "fp8":
+        try:
+            fp8_dtype()
+        except Exception as e:  # noqa: BLE001 — report, caller rejects
+            return f"fp8 transfers need ml_dtypes float8_e4m3fn: {e}"
+    return None
+
+
+@dataclass
+class TransferQuant:
+    """Per-leaf metadata for a transfer-quantized payload: what the wake
+    needs to rebuild the full-precision array on device. Rides NEXT TO the
+    host state tree (an aligned flat list), never inside it — the tree
+    keeps its structure so digest alignment and sharding trees stay valid."""
+
+    mode: str  #: "int8" | "fp8"
+    orig_dtype: str  #: numpy dtype string of the full-precision leaf
+    #: float32 per-output-channel scale, broadcastable (int8 only)
+    scale: Optional[np.ndarray] = None
+
+    @property
+    def scale_nbytes(self) -> int:
+        return int(self.scale.nbytes) if self.scale is not None else 0
+
+
+def _is_float_dtype(dt: Any) -> bool:
+    try:
+        return jnp.issubdtype(np.dtype(dt), jnp.floating)
+    except TypeError:
+        return False
+
+
+def transfer_quant_plan(
+    state: Any, hot_head: bool = True, prefix: str = "params"
+) -> List[bool]:
+    """Which leaves of ``state`` a quantized transfer compresses, aligned
+    with ``jax.tree.flatten(state)`` order (the same alignment contract as
+    chunk_store.aligned_digests).
+
+    Eligible: floating-point weight stacks under the ``prefix`` subtree —
+    the layer matmul weights (LAYER_WEIGHTS, ndim 3/4), plus ``embed`` and
+    ``lm_head`` (ndim 2) when ``hot_head`` is False. Norms, biases, the
+    KV pool, and scheduler arrays never quantize; with the default hot
+    head on, embeddings / final norm / lm_head stay full precision."""
+    from jax.tree_util import tree_flatten_with_path
+
+    flat, _ = tree_flatten_with_path(state)
+    out: List[bool] = []
+    for path, leaf in flat:
+        keys = []
+        for k in path:
+            if hasattr(k, "key"):
+                keys.append(str(k.key))
+            elif hasattr(k, "idx"):
+                keys.append(str(k.idx))
+            else:  # pragma: no cover — exotic pytree key types
+                keys.append(str(k))
+        if prefix:
+            if not keys or keys[0] != prefix:
+                out.append(False)
+                continue
+            keys = keys[1:]
+        ndim = len(getattr(leaf, "shape", ()))
+        dt = getattr(leaf, "dtype", None)
+        if not keys or ndim < 2 or dt is None or not _is_float_dtype(dt):
+            out.append(False)
+        elif keys[0] == "layers" and keys[-1] in LAYER_WEIGHTS and ndim in (3, 4):
+            out.append(True)
+        elif not hot_head and keys[-1] in HOT_HEAD_KEYS and ndim == 2:
+            out.append(True)
+        else:
+            out.append(False)
+    return out
+
+
+def payload_nbytes(shape: Tuple[int, ...], mode: str) -> int:
+    """Wire bytes of one quantized leaf: 1-byte payload + the int8 path's
+    f32 scale (axis ndim-2 reduced to 1). Shapes only — the swap's bucket
+    partitioner and the prefetch admission estimate both size transfers
+    without materializing anything."""
+    elems = 1
+    for d in shape:
+        elems *= int(d)
+    scale = 0
+    if mode == "int8":
+        scale = (elems // max(1, int(shape[-2]))) * 4
+    return elems + scale
+
+
+def quantize_leaf(
+    arr: Any, mode: str, scale: Optional[Any] = None
+) -> Tuple[Any, TransferQuant]:
+    """Quantize one leaf for transfer with jnp ops — ON DEVICE when `arr`
+    is a device array, so only the payload crosses the boundary.
+
+    ``scale`` (the sleeper's cached scale from this leaf's first
+    quantization) makes re-quantization bit-idempotent: round(w'/s) with
+    w' = dequant(q, s) recovers exactly q. Returns (payload, meta); the
+    meta's scale is normalized to host numpy."""
+    orig = str(np.dtype(arr.dtype))
+    if mode == "fp8":
+        return jnp.asarray(arr).astype(fp8_dtype()), TransferQuant(
+            mode="fp8", orig_dtype=orig
+        )
+    w = jnp.asarray(arr).astype(jnp.float32)
+    if scale is None:
+        amax = jnp.max(jnp.abs(w), axis=w.ndim - 2, keepdims=True)
+        s = jnp.maximum(amax / 127.0, 1e-8)
+    else:
+        s = jnp.asarray(scale, dtype=jnp.float32)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return q, TransferQuant(
+        mode="int8",
+        orig_dtype=orig,
+        scale=np.asarray(s, dtype=np.float32),
+    )
+
+
+def quantize_leaf_np(
+    arr: np.ndarray, mode: str, scale: Optional[np.ndarray] = None
+) -> Tuple[np.ndarray, TransferQuant]:
+    """Host-side twin of :func:`quantize_leaf` (pure numpy): the staging
+    path for full-precision pool entries and prefetched weights, where no
+    device round trip is wanted. Same rounding (half-to-even), so both
+    paths produce identical payloads for identical input bits."""
+    orig = str(np.dtype(arr.dtype))
+    if mode == "fp8":
+        return np.asarray(arr).astype(fp8_dtype()), TransferQuant(
+            mode="fp8", orig_dtype=orig
+        )
+    w = np.asarray(arr).astype(np.float32)
+    if scale is None:
+        amax = np.max(np.abs(w), axis=w.ndim - 2, keepdims=True)
+        s = np.maximum(amax / 127.0, np.float32(1e-8)).astype(np.float32)
+    else:
+        s = np.asarray(scale, dtype=np.float32)
+    q = np.clip(np.rint(w / s), -127, 127).astype(np.int8)
+    return q, TransferQuant(mode="int8", orig_dtype=orig, scale=s)
+
+
+def dequantize_leaf(payload: Any, meta: TransferQuant) -> Any:
+    """Rebuild the full-precision array from a payload with jnp ops — ON
+    DEVICE when the payload is a device array (the wake-side dequant that
+    rides under the remaining H2D stream)."""
+    dt = np.dtype(meta.orig_dtype)
+    if meta.mode == "fp8":
+        return jnp.asarray(payload).astype(dt)
+    w = jnp.asarray(payload).astype(jnp.float32) * jnp.asarray(meta.scale)
+    return w.astype(dt)
+
+
+def dequantize_leaf_np(payload: np.ndarray, meta: TransferQuant) -> np.ndarray:
+    """Host-side twin of :func:`dequantize_leaf`."""
+    dt = np.dtype(meta.orig_dtype)
+    if meta.mode == "fp8":
+        return np.asarray(payload).astype(dt)
+    w = np.asarray(payload).astype(np.float32) * meta.scale
+    return w.astype(dt)
+
+
+def transfer_digest(payload: Any, meta: TransferQuant) -> str:
+    """Content digest of a quantized chunk (payload + scale + mode + the
+    dtype it dequantizes to): what the tiered pool dedupes quantized
+    entries on. A distinct digest space from the full-precision leaf
+    digests — a quantized payload must never content-match (and be handed
+    out as) the full-precision tensor it came from — and the "q:" prefix
+    keeps these chunks out of the disk spill tier
+    (chunk_store.digest_spillable: a spilled blob could never pass the
+    reload's content re-verification)."""
+    from ..engine.chunk_store import QUANT_DIGEST_PREFIX, leaf_digest
+
+    h = hashlib.sha256()
+    h.update(f"tq|{meta.mode}|{meta.orig_dtype}|".encode())
+    h.update(leaf_digest(np.asarray(payload)).encode())
+    if meta.scale is not None:
+        h.update(leaf_digest(np.asarray(meta.scale)).encode())
+    return QUANT_DIGEST_PREFIX + h.hexdigest()
+
+
+def transfer_digest_map(
+    state: Any, metas: list, prefix: str = "params"
+) -> Dict[str, str]:
+    """Flat weight key -> :func:`transfer_digest` for the quantized leaves
+    of a slept/staged tree (``metas`` aligned with its flatten order).
+    These live in a digest space disjoint from the full-precision leaf
+    digests, so the tiered pool dedupes quantized siblings against each
+    other and NEVER against the fp tensors they approximate."""
+    from jax.tree_util import tree_flatten_with_path
+
+    flat, _ = tree_flatten_with_path(state)
+    out: Dict[str, str] = {}
+    for (path, leaf), meta in zip(flat, metas):
+        if meta is None:
+            continue
+        keys = []
+        for k in path:
+            if hasattr(k, "key"):
+                keys.append(str(k.key))
+            elif hasattr(k, "idx"):
+                keys.append(str(k.idx))
+            else:  # pragma: no cover — exotic pytree key types
+                keys.append(str(k))
+        if prefix:
+            if not keys or keys[0] != prefix:
+                continue
+            keys = keys[1:]
+        out["/".join(keys)] = transfer_digest(leaf, meta)
     return out
 
 
