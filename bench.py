@@ -646,6 +646,171 @@ def _measure_coldload() -> None:
     print(json.dumps(result))
 
 
+def _measure_decode_batched() -> None:
+    """Child entry for the `decode` sub-bench: the batched-throughput
+    probe for token-packed mixed-batch serving (docs/perf.md).
+
+    Open-loop curve: at each concurrency (1/2/4/8 streams with mixed
+    prompt lengths, arrivals independent of completions) measure decode
+    tok/s and the activation pad-waste fraction for the packed path, plus
+    the bucketed baseline and TTFT under load at concurrency 4 — the
+    bucketed engine prefills arrivals one bucket at a time (later
+    arrivals wait), the packed engine carries every prompt's segments and
+    the running decodes in one [token_budget] program per step.
+
+    CPU-meaningful like the swap/coldload probes: the quantities are
+    ratios and shape-bucket padding, not absolute FLOPs."""
+    import jax
+
+    from llm_d_fast_model_actuation_tpu.engine import (
+        EngineConfig,
+        InferenceEngine,
+    )
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model = llama.LlamaConfig.tiny()
+    # mixed lengths just past powers of two — the shapes real traffic has
+    # and the bucketed path pads worst (17 -> 32, 70 -> 128, ...)
+    prompt_lens = (17, 33, 40, 70)
+    # budget sized to the c=4 step load (docs/perf.md "choosing
+    # token_budget"); the curve reports pad waste at every concurrency
+    # so over/under-sizing shows
+    token_budget = 176
+    max_new = 24 if on_tpu else 16
+    # prefix caching off: the probe repeats identical prompts per point
+    # (best-of-2) and must measure prefill packing, not cache hits
+    base = dict(
+        model=model, max_batch=8, page_size=8, num_pages=256,
+        max_seq_len=256, prefix_caching=False,
+    )
+
+    import numpy as np
+
+    def prompts_for(c: int, seed: int = 0):
+        # seeded per call: the packed and bucketed curves must see
+        # byte-identical work
+        rng = np.random.default_rng(seed)
+        return [
+            rng.integers(1, model.vocab_size, prompt_lens[i % len(prompt_lens)])
+            .tolist()
+            for i in range(c)
+        ]
+
+    def run_once(packed: bool, c: int, eng=None, seed: int = 0):
+        """Three waves of c concurrent streams through a warm engine —
+        waves 2 and 3 arrive while earlier waves are decoding, so the
+        bucketed baseline pays its prefill-stalls-decode serialization
+        and the packed path carries segments and decode rows together.
+        The injection schedule (by step count) is identical for both
+        modes. Returns (tok_s, pad_waste_frac, (ttft_mean, ttft_max),
+        engine)."""
+        if eng is None:
+            cfg = EngineConfig(
+                packed_serving=packed,
+                token_budget=token_budget if packed else 0,
+                **base,
+            )
+            eng = InferenceEngine(cfg, seed=0)
+            # warm every compiled shape outside the timed window (both
+            # packed buffer shapes, the prefill buckets, chunk + drain)
+            eng.generate(prompts_for(8), max_new_tokens=10)
+            eng.generate(prompts_for(1), max_new_tokens=2)
+        eng.pad_waste_bytes = {"packed": 0, "bucketed": 0}
+        eng.dispatch_tokens = {"packed": 0, "bucketed": 0}
+        waves = 3
+        ids = []
+        done = {}
+        t0 = time.monotonic()
+        for w in range(waves):
+            ids.extend(
+                eng.add_request(p, max_new_tokens=max_new)
+                for p in prompts_for(c, seed * 10 + w)
+            )
+            if w < waves - 1:
+                for _ in range(3):  # next wave lands mid-decode
+                    for r in eng.step():
+                        done[r.seq_id] = r
+        while eng.has_work():
+            for r in eng.step():
+                done[r.seq_id] = r
+        dt = time.monotonic() - t0
+        reqs = [done[i] for i in ids]
+        emitted = sum(len(r.out_tokens) for r in reqs)
+        ttfts = [
+            r.first_token_time - r.submit_time
+            for r in reqs
+            if r.first_token_time is not None
+        ] or [0.0]
+        pad = sum(eng.pad_waste_bytes.values())
+        valid = (
+            sum(eng.dispatch_tokens.values()) * eng._pad_token_bytes
+        )
+        frac = pad / max(1, pad + valid)
+        return (
+            emitted / dt if dt > 0 else 0.0,
+            frac,
+            (sum(ttfts) / len(ttfts), max(ttfts)),
+            eng,
+        )
+
+    concurrencies = (1, 2, 4, 8)
+
+    def curve(packed: bool):
+        out = {}
+        eng = None
+        for c in concurrencies:
+            # best-of-2 per point: CPU scheduling noise must not break
+            # the monotonicity the CI gate asserts
+            a = run_once(packed, c, eng, seed=c)
+            eng = a[3]
+            b = run_once(packed, c, eng, seed=c)
+            best = a if a[0] >= b[0] else b
+            out[c] = {
+                "tok_s": round(best[0], 2),
+                "pad_waste_frac": round(best[1], 4),
+                "ttft_mean_s": round(best[2][0], 4),
+                "ttft_max_s": round(best[2][1], 4),
+            }
+        return out
+
+    packed_curve = curve(True)
+    bucketed_curve = curve(False)
+
+    c4p, c4b = packed_curve[4], bucketed_curve[4]
+    monotonic = all(
+        packed_curve[b]["tok_s"] >= packed_curve[a]["tok_s"] * 0.98
+        for a, b in ((1, 2), (2, 4))
+    )
+    result = {
+        "metric": "packed_decode_tok_s_c4",
+        "value": c4p["tok_s"],
+        "unit": "tok/s",
+        "vs_baseline": c4b["tok_s"],
+        "extra": {
+            "platform": jax.devices()[0].platform,
+            "model": "tiny",
+            "token_budget": token_budget,
+            "prompt_lens": list(prompt_lens),
+            "max_new_tokens": max_new,
+            "packed_curve": {str(k): v for k, v in packed_curve.items()},
+            "bucketed_curve": {
+                str(k): v for k, v in bucketed_curve.items()
+            },
+            "packed_tok_s_monotonic_1_to_4": monotonic,
+            "pad_waste_frac_packed_c4": c4p["pad_waste_frac"],
+            "pad_waste_frac_bucketed_c4": c4b["pad_waste_frac"],
+            "ttft_under_load_packed_s": c4p["ttft_mean_s"],
+            "ttft_under_load_bucketed_s": c4b["ttft_mean_s"],
+            "ttft_max_under_load_packed_s": c4p["ttft_max_s"],
+            "ttft_max_under_load_bucketed_s": c4b["ttft_max_s"],
+        },
+    }
+    if _trace_out_path():
+        _emit_trace(_trace_out_path(), result)
+    print(json.dumps(result))
+
+
 def _ensure_synthetic_hf_ckpt(
     dir_env: str, default_dir: str, shard_size: str, **llama_kw
 ) -> str:
@@ -1100,9 +1265,10 @@ def _extract_json_line(stdout: str) -> str | None:
 def main() -> int:
     # `bench.py` = the actuation headline; `bench.py coldload` = the
     # cold-start loader sub-bench; `bench.py swap` = the failure-recovery
-    # probe (rollback vs full restart) — same TPU-then-CPU fallback runner.
+    # probe (rollback vs full restart); `bench.py decode` = the batched
+    # mixed-batch throughput probe — same TPU-then-CPU fallback runner.
     sub = next(
-        (s for s in ("coldload", "swap") if s in sys.argv[1:]), ""
+        (s for s in ("coldload", "swap", "decode") if s in sys.argv[1:]), ""
     )
     if "--child" in sys.argv:
         if _trace_out_path():
@@ -1114,6 +1280,8 @@ def main() -> int:
             _measure_coldload()
         elif sub == "swap":
             _measure_swap_recovery()
+        elif sub == "decode":
+            _measure_decode_batched()
         else:
             _measure()
         return 0
@@ -1182,11 +1350,12 @@ def main() -> int:
         "metric": {
             "coldload": "coldload_parallel_speedup",
             "swap": "swap_rollback_recovery",
+            "decode": "packed_decode_tok_s_c4",
         }.get(sub, "level1_wake_bandwidth"),
         "value": 0.0,
-        "unit": {"coldload": "x_vs_sequential", "swap": "s"}.get(
-            sub, "GiB/s"
-        ),
+        "unit": {
+            "coldload": "x_vs_sequential", "swap": "s", "decode": "tok/s",
+        }.get(sub, "GiB/s"),
         "vs_baseline": 0.0,
         "extra": {
             "platform": "unavailable",

@@ -100,6 +100,23 @@ class EngineConfig:
     #: compiles). "auto" = chunk on TPU, single elsewhere. Outputs are
     #: identical either way (chunk-length invariance).
     drain_tail: str = "auto"
+    #: Token-packed mixed-batch serving (docs/perf.md "Mixed-batch
+    #: serving"): whenever prefill work is pending, ONE compiled
+    #: ``mixed`` program processes a flat [token_budget] buffer packing
+    #: prefill segments AND one decode row per running sequence, then
+    #: the step falls through to the fused decode chunk — concurrent
+    #: prompts neither serialize behind each other nor stall decode,
+    #: and the per-bucket prefill/suffix programs are off the packed
+    #: path (the warmup plan shrinks to one-or-two token-budget shapes
+    #: plus the decode chunks). Off (default) preserves the bucketed
+    #: path byte-for-byte. Incompatible with pipeline_decode and
+    #: multi-host gangs; requests wanting prompt logprobs (echo) fall
+    #: back to the bucketed prefill.
+    packed_serving: bool = False
+    #: Row capacity of the packed buffer; 0 = auto (max(256, enough for
+    #: one decode row-block per slot plus one prefill block), rounded up
+    #: to the RAGGED_BLOCK alignment).
+    token_budget: int = 0
 
     @property
     def seq_len(self) -> int:
@@ -108,6 +125,20 @@ class EngineConfig:
     @property
     def pages_per_seq(self) -> int:
         return -(-self.seq_len // self.page_size)
+
+    @property
+    def packed_token_budget(self) -> int:
+        """The resolved [token_budget] buffer size: requested (or the
+        auto default), rounded up to RAGGED_BLOCK alignment and floored
+        so every slot can decode AND at least one prefill block always
+        fits — a budget too small to carry the running batch would
+        deadlock admission."""
+        from ..ops.attention import RAGGED_BLOCK as qb
+
+        want = self.token_budget or 256
+        floor = qb * (self.max_batch + 1)
+        want = max(want, floor)
+        return -(-want // qb) * qb
 
 
 def resolve_attention_impl(impl: str) -> str:
@@ -126,6 +157,49 @@ def prefill_bucket(n: int, seq_len: int) -> int:
     while b < n:
         b *= 2
     return min(b, seq_len)
+
+
+def packed_budget_shapes(cfg: EngineConfig) -> List[int]:
+    """The one-or-two compiled [token_budget] buffer shapes of a packed
+    engine, smallest first: the full budget, preceded by a quarter-size
+    buffer (when it usefully differs) so a lightly loaded step — one
+    admission, a thin decode batch — neither computes nor pad-counts the
+    full budget. The ONE definition shared by live dispatch and the AOT
+    warmup plan (exec_pool.warmup_plan), like prefill_bucket above."""
+    from ..ops.attention import RAGGED_BLOCK as qb
+
+    full = cfg.packed_token_budget
+    small = -(-max(full // 4, qb * (cfg.max_batch + 1)) // qb) * qb
+    return [small, full] if small < full else [full]
+
+
+def mixed_bucket(rows: int, kv_pages: int) -> int:
+    """AOT/dispatch bucket id of one compiled mixed-program shape:
+    (buffer rows, page-table width). The packed dispatch slices the page
+    table to the power-of-two page count the step's longest sequence
+    actually needs — BIT-EXACT (the sliced-away entries were hard-masked
+    for every row, contributing exact fp32 zeros to the softmax), and it
+    bounds the reference twin's O(rows * ctx) gather by live context
+    instead of max_seq. Like prefill_bucket, at most log2(pages_per_seq)
+    widths ever compile; the warmup plan covers the full width (always
+    correct), narrower ones jit on first touch."""
+    return (int(rows) << 16) | int(kv_pages)
+
+
+def kv_pages_bucket(max_kv: int, page_size: int, pages_per_seq: int) -> int:
+    """Page-table width covering `max_kv` cache entries, rounded up to
+    the {1, 2, 3, 4, 6, 8, 12, ...} bucket ladder (powers of two and
+    their 1.5x midpoints — halves the worst-case over-read vs plain
+    pow2 at twice the compiled widths, still O(log) shapes), clamped to
+    the full table."""
+    need = max(1, -(-max_kv // page_size))
+    k = 1
+    while k < need:
+        if k * 3 // 2 >= need and k * 3 % 2 == 0:
+            k = k * 3 // 2
+            break
+        k *= 2
+    return min(k, pages_per_seq)
 
 
 @dataclass
@@ -195,6 +269,10 @@ class Request:
     #: text in the server layer): the engine finishes the request at the
     #: next emitted token instead of decoding to eos/max_tokens
     stop_requested: bool = False
+    #: packed serving: admitted but the prompt is not fully in cache yet
+    #: (req.pos tracks progress); excluded from decode dispatch until the
+    #: final prefill segment samples the first token
+    prefilling: bool = False
 
 
 def validate_logit_bias(lb, vocab_size: int) -> "Dict[int, float] | None":
@@ -269,6 +347,12 @@ class ProgramSet:
             self._make_suffix_prefill(True), donate_argnums=(5,)
         )
         self.verify = jax.jit(self._make_verify(), donate_argnums=(4,))
+        # the token-packed mixed-batch program (packed serving): jit
+        # specializes per (buffer shape, sliced page-table width) pair —
+        # two budget shapes (packed_budget_shapes) x O(log) KV widths
+        # (kv_pages_bucket) ever dispatch, and the AOT warmup covers the
+        # two full-width shapes (exec_pool.warmup_plan)
+        self.mixed = jax.jit(self._make_mixed(), donate_argnums=(6,))
         self._chunks: Dict[int, Any] = {}
 
     # -- shared program tails -------------------------------------------------
@@ -391,6 +475,51 @@ class ProgramSet:
             return toks, lps, avs, ais.astype(jnp.int32), cache
 
         return _verify
+
+    def _make_mixed(self):
+        """The token-packed mixed-batch program: one forward over a flat
+        [token_budget] buffer (llama.mixed_step), then the shared
+        sampling tail over ONE gathered row per slot — each sequence
+        emits at most one token per packed step (a prefill segment's
+        first token or a decode step), so the in-program budget/eos
+        machinery of the chunk program is unnecessary; the host applies
+        it between steps exactly like the bucketed prefill path."""
+        model_cfg = self.model_cfg
+        alt_k = self.alt_k
+
+        def _mixed(
+            params, tokens, row_slot, positions, sample_rows, sample_on,
+            cache, page_table, temps, topps, counts, pres, freq, skeys,
+            bias,
+        ):
+            logits, cache = llama.mixed_step(
+                params, model_cfg, tokens, row_slot, positions, cache,
+                page_table,
+            )
+            last = logits[sample_rows]  # [b, vocab]
+            # per-slot key split, advanced only for slots that sample this
+            # step (same discipline as the chunk program's active mask):
+            # a request's draw count stays a function of its own progress
+            keys = jax.random.wrap_key_data(skeys)
+            pairs = jax.vmap(jax.random.split)(keys)  # [b, 2]
+            subs = pairs[:, 1]
+            new_data = jax.random.key_data(pairs[:, 0])
+            active = sample_on > 0
+            skeys = jnp.where(active[:, None], new_data, skeys)
+            out = sample(
+                last, subs, temps, top_p=topps,
+                counts=counts, presence_penalty=pres,
+                frequency_penalty=freq, alt_k=alt_k, bias=bias,
+            )
+            tok, lp = out[0], out[1]
+            if alt_k > 0:
+                av, ai = out[2], out[3]
+            else:
+                av = jnp.zeros((tok.shape[0], 0), jnp.float32)
+                ai = jnp.zeros((tok.shape[0], 0), jnp.int32)
+            return tok, lp, av, ai, cache, skeys
+
+        return _mixed
 
     def _make_chunk(self, T: int):
         model_cfg = self.model_cfg
@@ -576,6 +705,7 @@ class InferenceEngine:
             "prefill_plp": self.programs.prefill_plp,
             "suffix": self.programs.suffix,
             "suffix_plp": self.programs.suffix_plp,
+            "mixed": self.programs.mixed,
         }
         #: AOT-warmed executables keyed by (program, shape bucket / chunk
         #: T), installed by the exec-pool warmup driver; dispatch prefers
@@ -604,6 +734,41 @@ class InferenceEngine:
         #: finished outside a step() call (drain_inflight before sleep):
         #: handed back by the next step() so the service resolves futures
         self._orphan_finished: List[Request] = []
+        # -- token-packed mixed-batch serving (cfg.packed_serving) ----------
+        self._packed = bool(cfg.packed_serving)
+        if self._packed and cfg.pipeline_decode:
+            # a packed step would race the in-flight chunk for the same
+            # slots; the packed path already hides prefill behind decode
+            raise ValueError(
+                "packed_serving is incompatible with pipeline_decode"
+            )
+        self._token_budget = cfg.packed_token_budget if self._packed else 0
+        #: packing alignment: the Pallas ragged kernel requires each
+        #: sequence's run of rows to start on a RAGGED_BLOCK boundary
+        #: (a kernel block holds one sequence); the XLA twin computes
+        #: every row independently, so non-pallas engines pack DENSELY —
+        #: same outputs bit-for-bit, fewer padded rows
+        from ..ops.attention import RAGGED_BLOCK
+
+        self._pack_align = RAGGED_BLOCK if impl == "pallas" else 1
+        #: bytes per padded activation row (pad-waste accounting):
+        #: one embedding row of the model dtype
+        self._pad_token_bytes = m.hidden_size * jnp.dtype(m.dtype).itemsize
+        #: cumulative activation-padding waste per dispatch path, in
+        #: bytes (fma_engine_prefill_pad_waste_bytes_total): "bucketed"
+        #: counts power-of-two prefill bucket padding, "packed" counts
+        #: every computed-but-invalid row of the mixed buffer
+        self.pad_waste_bytes: Dict[str, int] = {"packed": 0, "bucketed": 0}
+        #: valid-token accounting mirrors for the same two paths (the
+        #: bench's pad_waste_frac denominators)
+        self.dispatch_tokens: Dict[str, int] = {"packed": 0, "bucketed": 0}
+        #: packed-step lifetime counters (observability / bench)
+        self.packed_steps = 0
+        self.packed_tokens_total = 0
+        #: per-step stats of the most recent step() (None when the step
+        #: did not dispatch the packed program) — the service mirrors
+        #: these into the packed histogram/occupancy metrics and span
+        self.last_step_stats: Optional[Dict[str, Any]] = None
 
     # -- compiled-program dispatch (AOT executables > lazy jit) --------------
 
@@ -844,6 +1009,11 @@ class InferenceEngine:
         np.add.at(self._token_counts[slot], req.prompt, 1)
         self._pres[slot] = req.presence_penalty
         self._freqs[slot] = req.frequency_penalty
+        # sampling mirrors at admission (the packed program samples from
+        # the slot-indexed mirrors mid-prefill; the bucketed prefill
+        # re-writes the same values after it runs)
+        self._temps[slot] = req.temperature
+        self._topps[slot] = req.top_p
         self._dirty = True
         return True
 
@@ -878,6 +1048,10 @@ class InferenceEngine:
         temperature>0 outputs are identical either way."""
         table = self._page_table[req.slot : req.slot + 1]
         bucket = self._prefill_bucket(len(seg))
+        self.pad_waste_bytes["bucketed"] += (
+            (bucket - len(seg)) * self._pad_token_bytes
+        )
+        self.dispatch_tokens["bucketed"] += len(seg)
         tokens = np.zeros((1, bucket), dtype=np.int32)
         tokens[0, : len(seg)] = seg
         # next prompt token at each segment position (prompt-logprob
@@ -927,6 +1101,10 @@ class InferenceEngine:
             # single cold segment: the flash-style causal program
             table = self._page_table[req.slot : req.slot + 1]
             bucket = self._prefill_bucket(n)
+            self.pad_waste_bytes["bucketed"] += (
+                (bucket - n) * self._pad_token_bytes
+            )
+            self.dispatch_tokens["bucketed"] += n
             tokens = np.zeros((1, bucket), dtype=np.int32)
             tokens[0, :n] = req.prompt
             seq_lens = np.array([n], dtype=np.int32)
@@ -1110,6 +1288,227 @@ class InferenceEngine:
         req.slot = -1
         self._dirty = True
 
+    # -- token-packed mixed-batch serving (cfg.packed_serving) ---------------
+
+    def _any_prefilling(self) -> bool:
+        return any(
+            r is not None and r.prefilling and not r.done
+            for r in self._slots
+        )
+
+    def _packed_shapes(self) -> List[int]:
+        return packed_budget_shapes(self.cfg)
+
+    def _step_packed(self, finished: List[Request]) -> bool:
+        """One token-packed mixed-batch step: pack a decode row per
+        running sequence plus prefill segments from the in-flight and
+        waiting queues into the flat [token_budget] buffer, dispatch the
+        ONE mixed program, and emit at most one token per sequence.
+
+        Returns False without dispatching when no prefill segment could
+        be packed (the waiting queue is blocked on slots/pages) — the
+        caller then runs the fused decode chunk instead, so a blocked
+        queue never degrades decode to one token per dispatch.
+
+        Packing layout (the ragged kernel's contract, ops/pallas/
+        ragged.py): each sequence's rows are contiguous with consecutive
+        positions and start on a RAGGED_BLOCK boundary; alignment gaps
+        and the buffer tail are padding rows (row_slot = -1) the model
+        computes but nobody reads.
+        """
+        from ..utils import tracing
+
+        qb = self._pack_align
+        T = self._token_budget
+        b = self.cfg.max_batch
+        tokens = np.zeros((T,), dtype=np.int32)
+        row_slot = np.full((T,), -1, dtype=np.int32)
+        positions = np.zeros((T,), dtype=np.int32)
+        sample_rows = np.zeros((b,), dtype=np.int32)
+        sample_on = np.zeros((b,), dtype=np.int32)
+        rows_used = 0
+        decode_reqs: List[Request] = []
+        segments: List[Tuple[Request, int, bool]] = []
+        seg_cap = self.cfg.max_prefill_tokens or T
+
+        def pack_segment(req: Request) -> bool:
+            nonlocal rows_used
+            room = T - rows_used
+            if room < qb:
+                return False
+            take = min(len(req.prompt) - req.pos, seg_cap, room)
+            if take <= 0:
+                return False
+            start = rows_used
+            tokens[start : start + take] = req.prompt[
+                req.pos : req.pos + take
+            ]
+            row_slot[start : start + take] = req.slot
+            positions[start : start + take] = np.arange(
+                req.pos, req.pos + take, dtype=np.int32
+            )
+            final = req.pos + take >= len(req.prompt)
+            if final:
+                # the segment's last row predicts the first generated token
+                sample_rows[req.slot] = start + take - 1
+                sample_on[req.slot] = 1
+            segments.append((req, take, final))
+            rows_used += -(-take // qb) * qb
+            return True
+
+        # 1. one decode row per running sequence — decode NEVER stalls
+        #    behind prefill; each row owns an aligned block (a kernel
+        #    block holds exactly one sequence)
+        for slot, req in enumerate(self._slots):
+            if req is None or req.done or req.prefilling:
+                continue
+            tokens[rows_used] = self._last_tokens[slot]
+            row_slot[rows_used] = slot
+            positions[rows_used] = req.pos
+            sample_rows[slot] = rows_used
+            sample_on[slot] = 1
+            decode_reqs.append(req)
+            rows_used += qb
+
+        # 2. advance in-flight chunked prefills (slot order), one segment
+        #    each per step (max_prefill_tokens bounds segment length)
+        for req in self._slots:
+            if req is not None and req.prefilling and not req.done:
+                pack_segment(req)
+
+        # 3. admit waiting requests into the remaining budget
+        while self._waiting and T - rows_used >= qb:
+            req = self._waiting[0]
+            if req.want_prompt_logprobs:
+                # echo requests need the full-bucket prompt-logprob
+                # scoring variants: bucketed fallback, same step
+                if not self._admit(req):
+                    break
+                self._waiting.pop(0)
+                self._run_prefill(req)
+                if req.done:
+                    self._retire(req)
+                    finished.append(req)
+                continue
+            if not self._admit(req):
+                break
+            self._waiting.pop(0)
+            req.prefilling = True
+            req.pos = req.cached_tokens
+            pack_segment(req)
+
+        if not segments:
+            # nothing but decode rows: the fused chunk path serves the
+            # running batch better (decode_chunk tokens per dispatch)
+            return False
+
+        # dispatch at the smallest compiled buffer shape that fits (one
+        # or two shapes ever compile; _packed_shapes), against a page
+        # table sliced to the power-of-two width the step's longest
+        # sequence needs — bit-exact, and it bounds the reference twin's
+        # gather by live context instead of max_seq (mixed_bucket)
+        shape = next(s for s in self._packed_shapes() if s >= rows_used)
+        vmask = row_slot[:shape] >= 0
+        valid = int(vmask.sum())
+        max_kv = int(positions[:shape][vmask].max()) + 1
+        kvp = kv_pages_bucket(
+            max_kv, self.cfg.page_size, self.cfg.pages_per_seq
+        )
+        prefill_tokens = sum(t for _, t, _ in segments)
+        self.packed_steps += 1
+        self.packed_tokens_total += valid
+        self.pad_waste_bytes["packed"] += (
+            (shape - valid) * self._pad_token_bytes
+        )
+        self.dispatch_tokens["packed"] += valid
+        self.last_step_stats = {
+            "mode": "packed",
+            "rows": shape,
+            "tokens": valid,
+            "pad_rows": shape - valid,
+            "decode_rows": len(decode_reqs),
+            "prefill_tokens": prefill_tokens,
+        }
+        with tracing.span(
+            "step.packed", rows=shape, tokens=valid,
+            decode_rows=len(decode_reqs), prefill_tokens=prefill_tokens,
+        ):
+            tok, lp, av, ai, cache, skeys = self._call_program(
+                "mixed", mixed_bucket(shape, kvp),
+                self.params,
+                tokens[:shape],
+                row_slot[:shape],
+                positions[:shape],
+                sample_rows,
+                sample_on,
+                self.pool.as_tuple(),
+                np.ascontiguousarray(self._page_table[:, :kvp]),
+                self._temps,
+                self._topps,
+                self._token_counts,
+                self._pres,
+                self._freqs,
+                self._slot_keys,
+                self._bias,
+            )
+            self.pool.replace(cache)
+            # ONE batched host sync for the whole step's emits
+            tok_h, lp_h, av_h, ai_h, keys_h = jax.device_get(
+                (tok, lp, av, ai, skeys)
+            )
+        # non-sampling slots' keys came back unchanged (in-program where)
+        self._slot_keys[:] = keys_h
+
+        def alts_for(req: Request, slot: int):
+            if not req.want_top_logprobs:
+                return None
+            return [
+                (int(ai_h[slot, j]), float(av_h[slot, j]))
+                for j in range(av_h.shape[1])
+            ]
+
+        # prefill segments advance; final segments emit their first token
+        for req, take, final in segments:
+            if req.done:  # aborted mid-step: pages already freed
+                continue
+            slot = req.slot
+            req.pos += take
+            if not final:
+                continue
+            req.prefilling = False
+            if self.prefix_cache is not None:
+                # the full prompt's KV is now in pages: make it reusable
+                self.prefix_cache.register(
+                    req.prompt, req.pages, req.shared_pages,
+                    known_hashes=getattr(req, "_prefix_hashes", ()),
+                )
+            first = int(tok_h[slot])
+            self._emit(req, first, float(lp_h[slot]), alts_for(req, slot))
+            self._positions[slot] = req.pos
+            self._last_tokens[slot] = first
+            self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)
+            if req.done:
+                self._retire(req)
+                finished.append(req)
+        # decode rows emit one token each
+        for req in decode_reqs:
+            if req.done:
+                continue
+            slot = req.slot
+            t = int(tok_h[slot])
+            req.pos += 1
+            self._positions[slot] = req.pos
+            self._last_tokens[slot] = t
+            self._emit(req, t, float(lp_h[slot]), alts_for(req, slot))
+            self._budgets[slot] = req.max_new_tokens - len(req.out_tokens)
+            if req.done:
+                self._retire(req)
+                finished.append(req)
+        # the packed path never uses the persistent device scheduler
+        # state; the next chunk dispatch re-uploads the (fresh) mirrors
+        self._dirty = True
+        return True
+
     # -- speculative (n-gram / prompt-lookup) decoding -----------------------
 
     def _spec_candidate(self) -> Optional[Request]:
@@ -1120,7 +1519,13 @@ class InferenceEngine:
             return None
         if self._waiting:
             return None
-        active = [r for r in self._slots if r is not None and not r.done]
+        # a mid-prefill slot (packed serving) has no sampled token yet —
+        # its last-token mirror is not a valid speculation context
+        active = [
+            r
+            for r in self._slots
+            if r is not None and not r.done and not r.prefilling
+        ]
         if len(active) != 1:
             return None
         r = active[0]
@@ -1256,18 +1661,34 @@ class InferenceEngine:
         that finished."""
         if self.params is None:
             raise EngineAsleep("engine state is offloaded (sleeping)")
+        self.last_step_stats = None
         finished: List[Request] = list(self._orphan_finished)
         self._orphan_finished.clear()
 
-        while self._waiting:
-            req = self._waiting[0]
-            if not self._admit(req):
-                break
-            self._waiting.pop(0)
-            self._run_prefill(req)
-            if req.done:
-                self._retire(req)
-                finished.append(req)
+        # Token-packed mixed-batch path (cfg.packed_serving): whenever
+        # packable prefill work is pending, ONE mixed program carries
+        # prefill segments AND a decode row per running sequence, then
+        # the step FALLS THROUGH to the fused decode chunk below — the
+        # same prefill-then-chunk step shape as the bucketed path, so
+        # decode keeps its decode_chunk-per-dispatch fusion while
+        # prompts neither serialize behind each other nor stall it (the
+        # mixed step's decode rows are the no-stall bonus token). A
+        # waiting queue blocked on slots/pages packs nothing and goes
+        # straight to the chunk.
+        packed_mode = self._packed and self.lockstep is None
+        if packed_mode and (self._waiting or self._any_prefilling()):
+            self._step_packed(finished)
+
+        if not packed_mode:
+            while self._waiting:
+                req = self._waiting[0]
+                if not self._admit(req):
+                    break
+                self._waiting.pop(0)
+                self._run_prefill(req)
+                if req.done:
+                    self._retire(req)
+                    finished.append(req)
 
         # speculation never interleaves with an in-flight chunk: a verify
         # forward would race the chunk's decode of the same slot
@@ -1333,8 +1754,13 @@ class InferenceEngine:
         return finished
 
     def _running(self) -> Dict[int, Request]:
+        # mid-prefill slots (packed serving) are not decodable yet: their
+        # budget mirror is 0, and the packed branch guarantees the chunk
+        # program never dispatches while any slot is prefilling
         return {
-            r.slot: r for r in self._slots if r is not None and not r.done
+            r.slot: r
+            for r in self._slots
+            if r is not None and not r.done and not r.prefilling
         }
 
     def _dispatch_chunk(self, running: Dict[int, Request]):

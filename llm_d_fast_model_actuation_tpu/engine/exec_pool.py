@@ -53,8 +53,9 @@ logger = logging.getLogger(__name__)
 DEFAULT_EXEC_NBYTES = 1 << 20
 
 #: programs the warmup driver knows how to compile; "chunk"'s bucket is the
-#: fused step count T, the others' is the prefill token bucket
-WARM_PROGRAMS = ("prefill", "suffix", "chunk")
+#: fused step count T, "mixed"'s is the packed [token_budget] buffer
+#: shape, the others' is the prefill token bucket
+WARM_PROGRAMS = ("prefill", "suffix", "chunk", "mixed")
 
 
 def default_spill_dir() -> str:
@@ -161,14 +162,21 @@ def exec_key(signature: str, program: str, bucket: int) -> str:
 
 
 def warmup_plan(cfg, buckets) -> List[Tuple[str, int]]:
-    """(program, bucket) pairs a warmup covers: the prefill AND
-    suffix-prefill programs at each requested shape bucket (rounded up to
-    the engine's power-of-two buckets), plus the decode chunk at
-    T=decode_chunk — and T=1 where the drain-tail policy dispatches
-    single steps."""
+    """(program, bucket) pairs a warmup covers.
+
+    Bucketed serving: the prefill AND suffix-prefill programs at each
+    requested shape bucket (rounded up to the engine's power-of-two
+    buckets), plus the decode chunk at T=decode_chunk — and T=1 where
+    the drain-tail policy dispatches single steps.
+
+    Packed serving (cfg.packed_serving): the per-bucket prefill/suffix
+    programs are OFF the serving path, so the plan shrinks to the one or
+    two [token_budget] shapes of the mixed program plus the decode
+    chunks — log2(max_seq) prefill buckets collapse into ~2 shapes,
+    which is what makes warm swaps of a packed engine faster."""
     import jax
 
-    from .engine import prefill_bucket
+    from .engine import mixed_bucket, packed_budget_shapes, prefill_bucket
 
     def _bucket(n: int) -> int:
         # the live dispatch's rounding, by construction: one shared
@@ -177,16 +185,24 @@ def warmup_plan(cfg, buckets) -> List[Tuple[str, int]]:
         return prefill_bucket(n, cfg.seq_len)
 
     plan: List[Tuple[str, int]] = []
-    for b in sorted({_bucket(int(x)) for x in buckets}):
-        plan.append(("prefill", b))
-        plan.append(("suffix", b))
-    if buckets:
-        plan.append(("chunk", cfg.decode_chunk))
-        dt = cfg.drain_tail
-        if dt == "auto":
-            dt = "chunk" if jax.default_backend() == "tpu" else "single"
-        if dt == "single":
-            plan.append(("chunk", 1))
+    if not buckets:
+        return plan
+    if getattr(cfg, "packed_serving", False):
+        # full page-table width per buffer shape: always correct for any
+        # step; live dispatch additionally jits narrower KV widths on
+        # first touch as sequences shorter than max_seq dominate
+        for shape in packed_budget_shapes(cfg):
+            plan.append(("mixed", mixed_bucket(shape, cfg.pages_per_seq)))
+    else:
+        for b in sorted({_bucket(int(x)) for x in buckets}):
+            plan.append(("prefill", b))
+            plan.append(("suffix", b))
+    plan.append(("chunk", cfg.decode_chunk))
+    dt = cfg.drain_tail
+    if dt == "auto":
+        dt = "chunk" if jax.default_backend() == "tpu" else "single"
+    if dt == "single":
+        plan.append(("chunk", 1))
     return plan
 
 
@@ -259,6 +275,17 @@ def abstract_args(cfg, program: str, bucket: int) -> list:
             S((b,), f32), S((b,), f32), S((b, 2), u32), S((b,), i32),
             S((b, V), f32),
         ]
+    if program == "mixed":
+        # bucket = engine.mixed_bucket(buffer rows, page-table width);
+        # per-row metadata and the slot-indexed sampling mirrors arrive
+        # as host numpy (placement-free), like the live packed dispatch
+        T, k = bucket >> 16, bucket & 0xFFFF
+        return [
+            params, A((T,), i32), A((T,), i32), A((T,), i32),
+            A((b,), i32), A((b,), i32), cache, A((b, k), i32),
+            A((b,), f32), A((b,), f32), A((b, V), i32), A((b,), f32),
+            A((b,), f32), A((b, 2), u32), A((b, V), f32),
+        ]
     raise ValueError(f"unknown warmup program {program!r}")
 
 
@@ -280,6 +307,7 @@ def compile_program(cfg, program: str, bucket: int, programs=None):
             "prefill_plp": ps.prefill_plp,
             "suffix": ps.suffix,
             "suffix_plp": ps.suffix_plp,
+            "mixed": ps.mixed,
         }[program]
     return fn.lower(*abstract_args(cfg, program, bucket)).compile()
 

@@ -262,6 +262,29 @@ ENGINE_EXEC_POOL_ENTRIES = Gauge(
     "Executables resident in the pool",
 )
 
+# Mixed-batch (token-packed) serving observability (docs/metrics.md): how
+# full the decode batch runs, how densely the packed buffer is used, and
+# how much activation padding each dispatch path burns — the occupancy/
+# queue signals the multi-model scheduler (ROADMAP item 1) consumes.
+ENGINE_SLOT_OCCUPANCY = Gauge(
+    "fma_engine_decode_slot_occupancy",
+    "Fraction of decode slots occupied by running requests",
+    ["model"],
+)
+ENGINE_PACKED_TOKENS = Histogram(
+    "fma_engine_packed_tokens_per_step",
+    "Valid (non-padding) tokens packed into each mixed-batch step",
+    ["model"],
+    buckets=(8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+)
+ENGINE_PAD_WASTE_BYTES = Counter(
+    "fma_engine_prefill_pad_waste_bytes_total",
+    "Activation bytes computed for padding tokens, by dispatch path "
+    "(bucketed = power-of-two prefill bucket padding; packed = invalid "
+    "rows of the mixed [token_budget] buffer)",
+    ["model", "path"],
+)
+
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
     "llama3-8b": llama.LlamaConfig.llama3_8b,
@@ -372,6 +395,28 @@ def make_arg_parser() -> argparse.ArgumentParser:
         default=0,
         help="chunked prefill: segment prompts longer than this (bounds "
         "prefill memory and compile buckets); 0 = off",
+    )
+    p.add_argument(
+        "--packed-serving",
+        choices=["on", "off"],
+        default="off",
+        help="token-packed mixed-batch serving (docs/perf.md): whenever "
+        "prefill work is pending, one compiled program processes a flat "
+        "[token-budget] buffer packing prefill segments AND a decode "
+        "step per running sequence — concurrent prompts neither "
+        "serialize nor stall decode, and the per-bucket prefill "
+        "programs leave the warmup plan. off (default) preserves the "
+        "bucketed path byte-for-byte. Single-process engines only; "
+        "incompatible with --pipeline-decode",
+    )
+    p.add_argument(
+        "--token-budget",
+        type=int,
+        default=0,
+        help="row capacity of the packed mixed-batch buffer "
+        "(--packed-serving): bounds per-step prefill work like "
+        "--max-prefill-tokens bounds segments. 0 = auto (256, floored "
+        "so every decode slot plus one prefill block always fits)",
     )
     p.add_argument(
         "--speculative-ngram",
@@ -583,6 +628,19 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--max-prefill-tokens must be >= 0")
     if args.speculative_ngram < 0:
         raise ValueError("--speculative-ngram must be >= 0")
+    if getattr(args, "token_budget", 0) < 0:
+        raise ValueError("--token-budget must be >= 0, or 0 for auto")
+    if getattr(args, "packed_serving", "off") == "on":
+        if getattr(args, "pipeline_decode", "off") == "on":
+            raise ValueError(
+                "--packed-serving is incompatible with --pipeline-decode "
+                "(a packed step would race the in-flight chunk)"
+            )
+        if args.tensor_parallel_size > 1:
+            raise ValueError(
+                "--packed-serving is single-process only (the mixed "
+                "program is not plumbed for sharded meshes yet)"
+            )
     if getattr(args, "model_pool_mib", 0) < 0:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
@@ -712,6 +770,9 @@ class EngineService:
         #: a rolled-back swap): /health stays 200 but reports DEGRADED
         #: with this reason until the next successful admin edge clears it
         self.degraded: Optional[str] = None
+        #: last-mirrored engine pad-waste byte totals per dispatch path —
+        #: the engine keeps cumulative ints, Prometheus wants increments
+        self._pad_waste_seen: Dict[str, int] = {}
         self.started_at = time.monotonic()
         # Fault-injection arming (utils/faults.py): env first, then the
         # flag — both before the first build so coldload points can fire
@@ -1215,6 +1276,10 @@ class EngineService:
             max_prefill_tokens=args.max_prefill_tokens,
             speculative_ngram=args.speculative_ngram,
             logprobs_topk=max(0, getattr(args, "logprobs_topk", 5)),
+            packed_serving=(
+                getattr(args, "packed_serving", "off") == "on"
+            ),
+            token_budget=getattr(args, "token_budget", 0),
         )
 
     def _build_runtime(
@@ -2346,6 +2411,7 @@ class EngineService:
                                         fut.set_result(req)
                                 self._observe_finished(req)
                             self._observe_kv_usage()
+                            self._observe_step()
                             stepped = True
             except Exception as e:  # device/runtime failure: fail loudly
                 logger.exception("engine loop failed")
@@ -2380,6 +2446,31 @@ class EngineService:
         ENGINE_KV_USAGE.labels(model=self.args.model).set(
             (total - alloc.available) / total
         )
+
+    def _observe_step(self) -> None:
+        """Mirror per-step scheduler observability after each engine
+        step: decode-slot occupancy, the packed-step token histogram,
+        and pad-waste byte increments (the engine keeps cumulative
+        totals; a swap installs a fresh engine whose counters restart,
+        so a backwards jump resets the mirror instead of under-counting
+        forever)."""
+        eng = self.engine
+        m = self.args.model
+        ENGINE_SLOT_OCCUPANCY.labels(model=m).set(
+            sum(1 for s in eng._slots if s is not None)
+            / max(1, eng.cfg.max_batch)
+        )
+        stats = getattr(eng, "last_step_stats", None)
+        if stats is not None and stats.get("mode") == "packed":
+            ENGINE_PACKED_TOKENS.labels(model=m).observe(stats["tokens"])
+        for path, total in getattr(eng, "pad_waste_bytes", {}).items():
+            seen = self._pad_waste_seen.get(path, 0)
+            if total > seen:
+                ENGINE_PAD_WASTE_BYTES.labels(model=m, path=path).inc(
+                    total - seen
+                )
+            if total != seen:
+                self._pad_waste_seen[path] = total
 
     def _run_follower(self) -> None:
         """Gang follower: replay the leader's compiled calls until it

@@ -26,6 +26,7 @@ from ..ops.attention import (
     causal_prefill_attention,
     paged_decode_attention,
     paged_decode_attention_inline,
+    ragged_paged_attention,
 )
 from ..ops.norm import rms_norm
 from ..ops.rope import apply_rope, rope_table
@@ -323,6 +324,20 @@ def _scatter_prefill(pages, new, page_table, positions, valid, page_size):
     )
 
 
+def _scatter_rows(pages, new, page_table, row_slot, positions, page_size):
+    """Write a flat packed buffer's K or V [T, kvh, hd] into the page pool:
+    token t goes to its OWN sequence's page (``page_table[row_slot[t]]``)
+    at its own position. Padding rows (``row_slot < 0``) scatter to an
+    out-of-bounds page -> dropped."""
+    num_pages = pages.shape[0]
+    page_of = positions // page_size  # [T] logical page per token
+    slot_of = positions % page_size
+    safe = jnp.clip(row_slot, 0, page_table.shape[0] - 1)
+    phys = page_table[safe, page_of]  # [T]
+    phys = jnp.where(row_slot >= 0, phys, num_pages)
+    return pages.at[phys, slot_of].set(new, mode="drop")
+
+
 def _scatter_decode(pages, new, page_table, positions, page_size):
     """Write one token's K or V [b,kvh,hd] at `positions` [b]."""
     page_of = positions // page_size
@@ -418,6 +433,66 @@ def prefill_continue(
         vp = _scatter_prefill(vp, v, page_table, positions, valid, page_size)
         attn = paged_suffix_attention(q, kp, vp, page_table, start)
         x = x + _post(cfg, lp, "post_attn_norm", qmat(attn.reshape(b, s, cfg.q_dim), lp["wo"]))
+        h = _norm(cfg, x, lp["mlp_norm"])
+        x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
+        return x, (kp, vp)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], k_pages, v_pages)
+    )
+    x = _norm(cfg, x, params["final_norm"])
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = qmat(x, head).astype(jnp.float32)
+    return logits, (new_k, new_v)
+
+
+def mixed_step(
+    params: Dict[str, Any],
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [T] int32 — flat packed token buffer
+    row_slot: jnp.ndarray,  # [T] int32 — page_table row per token; -1 = pad
+    positions: jnp.ndarray,  # [T] int32 — absolute position per token
+    cache: Tuple[jnp.ndarray, jnp.ndarray],
+    page_table: jnp.ndarray,  # [rows, pages_per_seq] int32
+):
+    """One token-packed mixed-batch step: prefill segments, suffix
+    continuations, and decode steps for MANY sequences in one forward
+    over a flat ``[token_budget]`` buffer (the packed serving path,
+    engine/engine.py). Each token's KV is scattered into its own
+    sequence's pages first, then ragged paged attention masks every row
+    to its own sequence at positions <= its own — causal prefill, suffix
+    continuation, and decode are all the same mask.
+
+    Returns (logits [T, vocab], new_cache); the caller gathers the rows
+    that sample (each segment's last token / each decode row). Padding
+    rows write nothing and produce garbage logits.
+    """
+    (T,) = tokens.shape
+    k_pages, v_pages = cache
+    page_size = k_pages.shape[2]
+    cos_tab, sin_tab = rope_table(
+        cfg.max_seq_len, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
+
+    x = _embed_tokens(cfg, params, tokens)  # [T, h]
+
+    def layer(x, scanned):
+        lp, kp, vp = scanned
+        h = _norm(cfg, x, lp["attn_norm"])
+        q, k, v = _project_qkv(
+            cfg, lp, h[None], positions[None], cos_tab, sin_tab
+        )
+        q, k, v = q[0], k[0], v[0]  # [T, heads/kvh, hd]
+        kp = _scatter_rows(kp, k, page_table, row_slot, positions, page_size)
+        vp = _scatter_rows(vp, v, page_table, row_slot, positions, page_size)
+        attn = ragged_paged_attention(
+            q, kp, vp, page_table, row_slot, positions,
+            impl=cfg.attention_impl,
+        )
+        x = x + _post(
+            cfg, lp, "post_attn_norm",
+            qmat(attn.reshape(T, cfg.q_dim), lp["wo"]),
+        )
         h = _norm(cfg, x, lp["mlp_norm"])
         x = x + _post(cfg, lp, "post_ffn_norm", _ffn(cfg, lp, h))
         return x, (kp, vp)

@@ -193,6 +193,85 @@ def paged_decode_attention(
     return out.astype(q.dtype)
 
 
+#: Row-block size of the ragged packing layout (ops/pallas/ragged.py): the
+#: engine's packer aligns every sequence's contiguous run of rows in the
+#: flat [token_budget] buffer to this boundary, so each kernel block
+#: belongs to at most one sequence. Waste per packed segment is < this
+#: many rows — against up to 2x for the power-of-two prefill buckets.
+RAGGED_BLOCK = 8
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [tokens, heads, head_dim] — flat packed token buffer
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    page_table: jnp.ndarray,  # [rows, pages_per_seq] int32
+    row_slot: jnp.ndarray,  # [tokens] int32 — page_table row per token;
+    #                         -1 marks a padding row (output is garbage)
+    positions: jnp.ndarray,  # [tokens] int32 — absolute position per token
+    impl: "str | None" = None,  # None -> module default
+) -> jnp.ndarray:
+    """Attention for a token-packed mixed batch over the paged cache.
+
+    One flat ``[tokens]`` buffer holds rows drawn from MANY sequences —
+    prefill segments, suffix continuations, and decode steps together
+    (the mixed-batch serving path, engine/engine.py). Each token carries
+    its own (sequence slot, absolute position); its KV has already been
+    scattered into the pages (scatter-first, like ``prefill_continue``),
+    and it attends over every cache entry of its OWN sequence at
+    positions <= its own — which is simultaneously the causal prefill
+    mask, the suffix-continuation mask, and the decode mask (the token
+    itself is the newest cache entry).
+
+    This XLA twin is the CPU-runnable parity baseline: a gather of each
+    token's pages (a dynamic-slice-friendly pattern XLA fuses, exactly
+    like ``paged_suffix_attention``) that materializes [tokens, ctx] —
+    fine for tests and CPU serving, O(tokens * ctx) HBM traffic on TPU.
+    The Pallas kernel behind the same signature (ops/pallas/ragged.py)
+    reads only the pages each row block actually needs; it additionally
+    requires the packing contract that rows of one sequence are
+    contiguous, position-consecutive, and aligned to ``RAGGED_BLOCK``.
+
+    Padding rows (``row_slot < 0``) write nothing (the model's scatter
+    drops them) and read row 0's pages fully masked — their output is
+    finite garbage the caller ignores.
+    """
+    if (impl or _IMPL) == "pallas":
+        from .pallas import ragged_paged_attention_pallas
+
+        if q.shape[0] % RAGGED_BLOCK == 0:
+            return ragged_paged_attention_pallas(
+                q, k_pages, v_pages, page_table, row_slot, positions,
+                block_rows=RAGGED_BLOCK, interpret=_pallas_interpret(),
+            )
+    t, h, d = q.shape
+    kvh = k_pages.shape[2]
+    g = h // kvh
+    pages_per_seq = page_table.shape[1]
+    page_size = k_pages.shape[1]
+    ctx = pages_per_seq * page_size
+
+    safe = jnp.clip(row_slot, 0, page_table.shape[0] - 1)
+    pt = page_table[safe]  # [t, pages_per_seq]
+    k = k_pages[pt].reshape(t, ctx, kvh, d)
+    v = v_pages[pt].reshape(t, ctx, kvh, d)
+    qg = (q.astype(jnp.float32) * (d**-0.5)).astype(q.dtype).reshape(
+        t, kvh, g, d
+    )
+    logits = jnp.einsum(
+        "tngd,tknd->tngk", qg, k, preferred_element_type=jnp.float32
+    )
+    mask = jnp.arange(ctx)[None, :] <= positions[:, None]  # [t, ctx]
+    mask = mask & (row_slot >= 0)[:, None]
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "tngk,tknd->tngd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(t, h, d).astype(q.dtype)
+
+
 def paged_suffix_attention(
     q: jnp.ndarray,  # [batch, s, heads, head_dim] — suffix queries
     k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ...utils.compat import tpu_compiler_params
+
 NEG_INF = -1e30
 
 
@@ -146,7 +148,7 @@ def causal_prefill_attention_pallas(
         kernel,
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -1,0 +1,64 @@
+"""Version-drift shims for the jax/jaxlib APIs this repo straddles.
+
+The container images this runs on carry different jax point releases, and
+two APIs have moved across them:
+
+  * ``shard_map`` graduated from ``jax.experimental.shard_map`` to
+    ``jax.shard_map``. Import it from here; both spellings resolve.
+  * Pallas-TPU compiler params were renamed
+    ``TPUCompilerParams`` -> ``CompilerParams``. ``tpu_compiler_params()``
+    builds whichever this install ships.
+
+Keep this module dependency-light: it is imported by ops/ and parallel/
+alike, before any backend is initialized.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6 spelling
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # the long-lived experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (new) or ``pltpu.TPUCompilerParams`` (old),
+    constructed with the given fields — the dataclass fields themselves
+    (``dimension_semantics`` et al.) are stable across the rename."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)
+
+
+def pallas_interpret_supported() -> bool:
+    """Capability probe: can this jaxlib run a trivial Pallas kernel in
+    interpreter mode on the current (CPU) backend? Some jax/jaxlib pairs
+    in the wild cannot lower even interpret-mode pallas_call on CPU —
+    tests gate on this instead of failing the sweep."""
+    global _PALLAS_PROBE
+    if _PALLAS_PROBE is None:
+        try:
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def _copy(x_ref, o_ref):
+                o_ref[...] = x_ref[...]
+
+            x = jnp.zeros((8, 128), jnp.float32)
+            out = pl.pallas_call(
+                _copy,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=True,
+            )(x)
+            _PALLAS_PROBE = bool(out.shape == x.shape)
+        except Exception:  # noqa: BLE001 — any failure means "can't"
+            _PALLAS_PROBE = False
+    return _PALLAS_PROBE
+
+
+_PALLAS_PROBE: "bool | None" = None
