@@ -334,10 +334,29 @@ class ProgramSet:
     unit that crosses between them.
     """
 
-    def __init__(self, model_cfg, logprobs_topk: int, eos_token_id: int) -> None:
+    def __init__(
+        self,
+        model_cfg,
+        logprobs_topk: int,
+        eos_token_id: int,
+        mixed_impl: Optional[str] = None,
+        mesh: Optional[Mesh] = None,
+    ) -> None:
         self.model_cfg = model_cfg
         self.alt_k = int(logprobs_topk)
         self.eos = int(eos_token_id)
+        #: attention impl override for the MIXED program only (sharded
+        #: meshes route the ragged op through the XLA twin —
+        #: ops/attention.py:resolve_ragged_impl); None = model config's
+        self.mixed_impl = mixed_impl
+        #: the engine's mesh: device-RESIDENT scheduler outputs (counts,
+        #: bias, last tokens, ...) are pinned replicated on it so their
+        #: sharding is a fixed point across dispatches — without the pin
+        #: GSPMD shards them however the program liked (e.g. counts over
+        #: the tp vocab axis), the next dispatch's input sharding drifts
+        #: from the uploaded/compiled one, and every AOT executable
+        #: mismatches after its first call
+        self.mesh = mesh
         self.prefill = jax.jit(self._make_prefill(False), donate_argnums=(3,))
         self.prefill_plp = jax.jit(self._make_prefill(True), donate_argnums=(3,))
         self.suffix = jax.jit(
@@ -347,13 +366,29 @@ class ProgramSet:
             self._make_suffix_prefill(True), donate_argnums=(5,)
         )
         self.verify = jax.jit(self._make_verify(), donate_argnums=(4,))
-        # the token-packed mixed-batch program (packed serving): jit
-        # specializes per (buffer shape, sliced page-table width) pair —
-        # two budget shapes (packed_budget_shapes) x O(log) KV widths
-        # (kv_pages_bucket) ever dispatch, and the AOT warmup covers the
-        # two full-width shapes (exec_pool.warmup_plan)
-        self.mixed = jax.jit(self._make_mixed(), donate_argnums=(6,))
+        #: the token-packed mixed-batch programs, one jitted function per
+        #: page-table slice width (mixed(kvp), like chunk(T)): jit then
+        #: specializes per buffer shape — two budget shapes
+        #: (packed_budget_shapes) x O(log) KV widths (kv_pages_bucket)
+        #: ever dispatch, and the AOT warmup covers the two full-width
+        #: shapes (exec_pool.warmup_plan)
+        self._mixed: Dict[int, Any] = {}
         self._chunks: Dict[int, Any] = {}
+
+    def _pin_resident(self, *xs):
+        """Constrain device-resident scheduler outputs to the replicated
+        sharding the engine uploads them with (no-op off-mesh): state
+        that round-trips through dispatches must keep a stable sharding
+        or AOT executables mismatch after one call (see __init__)."""
+        if self.mesh is None:
+            return xs if len(xs) > 1 else xs[0]
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(self.mesh, PartitionSpec())
+        pinned = tuple(
+            jax.lax.with_sharding_constraint(x, sh) for x in xs
+        )
+        return pinned if len(pinned) > 1 else pinned[0]
 
     # -- shared program tails -------------------------------------------------
 
@@ -476,25 +511,59 @@ class ProgramSet:
 
         return _verify
 
-    def _make_mixed(self):
+    def _make_mixed(self, kvp: int):
         """The token-packed mixed-batch program: one forward over a flat
         [token_budget] buffer (llama.mixed_step), then the shared
         sampling tail over ONE gathered row per slot — each sequence
         emits at most one token per packed step (a prefill segment's
         first token or a decode step), so the in-program budget/eos
         machinery of the chunk program is unnecessary; the host applies
-        it between steps exactly like the bucketed prefill path."""
+        it between steps exactly like the bucketed prefill path.
+
+        Scheduler state is DEVICE-RESIDENT, chunk-program style: the
+        [b, vocab] token counts and logit-bias mirrors arrive as device
+        arrays (donated) and the program maintains them itself —
+        ``fresh_on`` slots (admitted this step with no exact-count /
+        bias edge) zero their rows, ``count_row`` rows (streamed prompt
+        tokens) join their slot's counts BEFORE the sampling tail (so
+        the final segment's sample sees the whole prompt, exactly like
+        the bucketed prefill's counts row), and each sampling slot's
+        emitted token joins the counts after (the chunk program's
+        post-sample add). The full-width page table is device-resident
+        too; the program slices it to this function's static ``kvp``
+        width (bit-exact: the sliced-away entries were hard-masked
+        exact zeros). Steady-state per-step H2D is therefore O(rows) —
+        the [b, vocab] mirrors re-upload only on dirty edges."""
         model_cfg = self.model_cfg
+        if self.mixed_impl and model_cfg.attention_impl != self.mixed_impl:
+            import dataclasses
+
+            model_cfg = dataclasses.replace(
+                model_cfg, attention_impl=self.mixed_impl
+            )
         alt_k = self.alt_k
 
         def _mixed(
-            params, tokens, row_slot, positions, sample_rows, sample_on,
-            cache, page_table, temps, topps, counts, pres, freq, skeys,
-            bias,
+            params, tokens, row_slot, positions, count_row, sample_rows,
+            sample_on, fresh_on, cache, page_table, temps, topps, counts,
+            pres, freq, skeys, bias,
         ):
+            b = sample_rows.shape[0]
+            pt = jax.lax.slice_in_dim(page_table, 0, kvp, axis=1)
+            # device-side dirty-edge maintenance: a freshly admitted
+            # slot's rows still hold the previous occupant's state —
+            # zero them here instead of re-uploading [b, vocab] mirrors
+            fresh = fresh_on > 0
+            counts = jnp.where(fresh[:, None], 0, counts)
+            bias = jnp.where(fresh[:, None], 0.0, bias)
+            # streamed prompt rows join their slot's counts BEFORE the
+            # sample (penalties see the full prompt at the final
+            # segment); padding / decode rows scatter out of bounds
+            add_slot = jnp.where(count_row > 0, row_slot, b)
+            counts = counts.at[add_slot, tokens].add(1, mode="drop")
             logits, cache = llama.mixed_step(
                 params, model_cfg, tokens, row_slot, positions, cache,
-                page_table,
+                pt,
             )
             last = logits[sample_rows]  # [b, vocab]
             # per-slot key split, advanced only for slots that sample this
@@ -517,7 +586,13 @@ class ProgramSet:
             else:
                 av = jnp.zeros((tok.shape[0], 0), jnp.float32)
                 ai = jnp.zeros((tok.shape[0], 0), jnp.int32)
-            return tok, lp, av, ai, cache, skeys
+            # the emitted token joins the counts the NEXT step penalizes
+            # (host _emit mirrors the same add)
+            counts = counts.at[jnp.arange(b), tok].add(
+                active.astype(jnp.int32)
+            )
+            counts, bias = self._pin_resident(counts, bias)
+            return tok, lp, av, ai, cache, counts, bias, skeys
 
         return _mixed
 
@@ -577,6 +652,9 @@ class ProgramSet:
             ) = jax.lax.scan(
                 body, (lt, pos, budget, cache, counts, skeys), None, length=T
             )
+            lt, pos, budget, counts, skeys = self._pin_resident(
+                lt, pos, budget, counts, skeys
+            )
             return (
                 toks, lps, avs, ais, lt, pos, budget, cache, counts, skeys,
             )
@@ -592,6 +670,20 @@ class ProgramSet:
             # donate scheduler state + cache + counts + key data
             fn = self._chunks[T] = jax.jit(
                 self._make_chunk(T), donate_argnums=(1, 2, 3, 4, 8, 11)
+            )
+        return fn
+
+    def mixed(self, kvp: int):
+        """The jitted mixed-batch program at page-table slice width
+        `kvp` (cached per width, like chunk(T)): the slice width is a
+        closure constant, so the jit specializes per (buffer shape, kvp)
+        exactly as the old host-sliced dispatch did — same compile
+        count, but the full-width table stays device-resident."""
+        fn = self._mixed.get(kvp)
+        if fn is None:
+            # donate cache + the device-resident counts/bias mirrors
+            fn = self._mixed[kvp] = jax.jit(
+                self._make_mixed(kvp), donate_argnums=(8, 12, 16)
             )
         return fn
 
@@ -694,7 +786,13 @@ class InferenceEngine:
         # One ProgramSet per engine (jit caches key on function identity,
         # so two engines never share a cache); the flat _*_fn attributes
         # keep the historical names the lockstep follower replays through.
-        self.programs = ProgramSet(m, cfg.logprobs_topk, cfg.eos_token_id)
+        from ..ops.attention import resolve_ragged_impl
+
+        self.programs = ProgramSet(
+            m, cfg.logprobs_topk, cfg.eos_token_id,
+            mixed_impl=resolve_ragged_impl(impl, mesh),
+            mesh=mesh,
+        )
         self._prefill_fn = self.programs.prefill
         self._prefill_plp_fn = self.programs.prefill_plp
         self._suffix_prefill_fn = self.programs.suffix
@@ -705,7 +803,6 @@ class InferenceEngine:
             "prefill_plp": self.programs.prefill_plp,
             "suffix": self.programs.suffix,
             "suffix_plp": self.programs.suffix_plp,
-            "mixed": self.programs.mixed,
         }
         #: AOT-warmed executables keyed by (program, shape bucket / chunk
         #: T), installed by the exec-pool warmup driver; dispatch prefers
@@ -746,11 +843,36 @@ class InferenceEngine:
         #: packing alignment: the Pallas ragged kernel requires each
         #: sequence's run of rows to start on a RAGGED_BLOCK boundary
         #: (a kernel block holds one sequence); the XLA twin computes
-        #: every row independently, so non-pallas engines pack DENSELY —
-        #: same outputs bit-for-bit, fewer padded rows
+        #: every row independently, so non-pallas engines — and sharded
+        #: meshes, whose mixed program routes through the twin
+        #: (resolve_ragged_impl) — pack DENSELY: same outputs
+        #: bit-for-bit, fewer padded rows
         from ..ops.attention import RAGGED_BLOCK
 
-        self._pack_align = RAGGED_BLOCK if impl == "pallas" else 1
+        self._pack_align = (
+            RAGGED_BLOCK if resolve_ragged_impl(impl, mesh) == "pallas"
+            else 1
+        )
+        #: packed engines track a second, cheaper staleness tier: the
+        #: small per-slot mirrors (last tokens, positions, budgets, page
+        #: table, temps/top-p/penalties, keys, eos) changed host-side but
+        #: the [b, vocab] counts/bias device state is still exact — the
+        #: next dispatch refreshes ONLY the small arrays
+        #: (_upload_sched_rows, O(b·pages_per_seq) bytes) instead of the
+        #: O(b·vocab) full re-upload. Bucketed engines never set it.
+        self._rows_stale = False
+        #: slots admitted by the packed path whose device counts/bias
+        #: rows still hold the previous occupant's state: the next mixed
+        #: dispatch zeroes them in-program (fresh_on); a full mirror
+        #: upload makes the zeroing moot and clears the set
+        self._fresh_slots: set = set()
+        #: cumulative host->device scheduler/dispatch bytes per serving
+        #: path (fma_engine_step_h2d_bytes_total; the decode bench's
+        #: step_h2d_bytes_per_tok): "packed" counts mixed-program inputs
+        #: plus every scheduler upload of a packed engine, "bucketed"
+        #: counts the bucketed prefill/suffix/spec dispatch inputs and a
+        #: bucketed engine's scheduler uploads
+        self.step_h2d_bytes: Dict[str, int] = {"packed": 0, "bucketed": 0}
         #: bytes per padded activation row (pad-waste accounting):
         #: one embedding row of the model dtype
         self._pad_token_bytes = m.hidden_size * jnp.dtype(m.dtype).itemsize
@@ -796,10 +918,18 @@ class InferenceEngine:
         if comp is not None:
             try:
                 return comp(*args)
-            except TypeError:
+            except (TypeError, ValueError):
+                # both are pre-execution argument checks (aval mismatch
+                # = TypeError, input-sharding mismatch = ValueError), so
+                # the donated state is untouched — drop the stale entry
+                # and re-dispatch through jit
                 self._aot.pop((program, bucket), None)
         if program == "chunk":
             return self.programs.chunk(bucket)(*args)
+        if program == "mixed":
+            # bucket = mixed_bucket(rows, kvp): the page-table slice
+            # width picks the jitted specialization (engine.mixed_bucket)
+            return self.programs.mixed(bucket & 0xFFFF)(*args)
         return self._jit_programs[program](*args)
 
     def _chunk_fn(self, T: int):
@@ -814,35 +944,125 @@ class InferenceEngine:
 
     # -- device scheduler state ---------------------------------------------
 
+    def _h2d_path(self) -> str:
+        """step_h2d_bytes attribution for scheduler uploads: the engine's
+        serving path (a packed engine's chunk re-uploads are packed-path
+        cost; bucketed engines only ever have the bucketed path)."""
+        return "packed" if self._packed else "bucketed"
+
+    def _sched_sharding(self):
+        """Placement of the device scheduler arrays: plain default-device
+        on single-device engines (committed-ness stays out of the jit
+        key exactly as before); explicitly REPLICATED on a mesh, so the
+        live arrays carry the same sharding the AOT warmup lowers
+        against (exec_pool.abstract_args) — an uncommitted array and a
+        NamedSharding aval would never match at Compiled-call time.
+        Multi-host gang meshes keep the legacy uncommitted placement: a
+        host-numpy device_put onto a cross-process sharding is
+        jax-version-sensitive, and gangs never carry AOT executables
+        (warmup skips followers; the in-program _pin_resident still
+        stabilizes their resident state from the second dispatch on)."""
+        if self.mesh is None:
+            return None
+        pidx = jax.process_index()
+        if any(d.process_index != pidx for d in self.mesh.devices.flat):
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    #: the [b, vocab] scheduler mirrors the packed path's programs
+    #: maintain DEVICE-side between dirty edges — excluded from the
+    #: small-tier refresh (_upload_sched_rows)
+    _VOCAB_MIRRORS = ("counts", "bias")
+
+    def _sched_mirrors(self) -> Dict[str, np.ndarray]:
+        """The ONE canonical name -> host-mirror mapping both upload
+        tiers derive from: a mirror added here reaches the full upload
+        AND the packed path's small-tier refresh (a hand-maintained
+        second dict would silently serve stale device state on packed
+        engines only)."""
+        return {
+            "lt": self._last_tokens,
+            "pos": self._positions,
+            "budget": self._budgets,
+            "pt": self._page_table,
+            "temps": self._temps,
+            "topp": self._topps,
+            "counts": self._token_counts,
+            "pres": self._pres,
+            "freq": self._freqs,
+            "skeys": self._slot_keys,
+            "eos_on": self._eos_on,
+            "bias": self._bias,
+        }
+
     def _upload_sched(self) -> None:
         """Push host scheduler mirrors to device in ONE batched transfer —
         twelve per-array device_puts are twelve round trips on a
         high-latency link (the axon tunnel), and this runs on every
-        post-wake / post-admission chunk."""
-        self._dev = jax.device_put(
-            {
-                "lt": self._last_tokens,
-                "pos": self._positions,
-                "budget": self._budgets,
-                "pt": self._page_table,
-                "temps": self._temps,
-                "topp": self._topps,
-                "counts": self._token_counts,
-                "pres": self._pres,
-                "freq": self._freqs,
-                "skeys": self._slot_keys,
-                "eos_on": self._eos_on,
-                "bias": self._bias,
-            }
+        post-wake / post-admission chunk (bucketed path) / exact-edge
+        packed step. The FULL upload — [b, vocab] counts and bias
+        included — is the packed path's dirty-edge fallback; between
+        dirty edges packed engines refresh only the small per-slot
+        mirrors (_upload_sched_rows)."""
+        mirrors = self._sched_mirrors()
+        self.step_h2d_bytes[self._h2d_path()] += sum(
+            a.nbytes for a in mirrors.values()
         )
+        self._dev = jax.device_put(mirrors, self._sched_sharding())
         self._dirty = False
+        self._rows_stale = False
+        # the pushed [b, vocab] rows are authoritative for every slot;
+        # in-program fresh-slot zeroing would discard them
+        self._fresh_slots.clear()
+
+    def _upload_sched_rows(self) -> None:
+        """Refresh ONLY the small per-slot mirrors on device — everything
+        except the [b, vocab] counts/bias, which the packed path's
+        programs maintain device-side between dirty edges. O(b ·
+        pages_per_seq) bytes vs the full upload's O(b · vocab): this is
+        what keeps a packed step's steady-state H2D at O(rows)."""
+        small = {
+            k: v
+            for k, v in self._sched_mirrors().items()
+            if k not in self._VOCAB_MIRRORS
+        }
+        self.step_h2d_bytes[self._h2d_path()] += sum(
+            a.nbytes for a in small.values()
+        )
+        up = jax.device_put(small, self._sched_sharding())
+        d = dict(self._dev)
+        d.update(up)
+        self._dev = d
+        self._rows_stale = False
+
+    def _upload_sched_table(self) -> None:
+        """Refresh ONLY the device page table — the one piece of device
+        state the mixed program reads besides counts/bias (its other
+        per-slot inputs arrive as fresh host args each dispatch).
+        Leaves _rows_stale SET: the next chunk dispatch still owes the
+        full small-tier refresh (it reads lt/pos/budget/... from
+        device), but back-to-back packed steps stop re-uploading
+        mirrors nobody reads."""
+        pt = self._page_table
+        self.step_h2d_bytes[self._h2d_path()] += pt.nbytes
+        d = dict(self._dev)
+        d["pt"] = jax.device_put(pt, self._sched_sharding())
+        self._dev = d
 
     def drop_device_sched_state(self) -> None:
         """Forget device scheduler arrays (sleep path). Host mirrors —
         including the per-slot RNG keys, re-synced after every chunk —
-        remain the source of truth; the next chunk re-uploads them."""
+        remain the source of truth; the next chunk re-uploads them.
+        Packed engines included: the device-resident counts/bias go with
+        the client, and the host mirrors (kept exact — or, for a
+        mid-prefill slot, MORE complete than the device copy, which may
+        lack a cached prefix's counts while penalties are zero) rebuild
+        everything in the next full upload."""
         self._dev = None
         self._dirty = True
+        self._rows_stale = False
 
     def on_device_reacquire(self) -> None:
         """After a device-releasing sleep, the PJRT client was re-created:
@@ -858,6 +1078,9 @@ class InferenceEngine:
             self.mesh = rebuild_mesh(
                 tuple(self.mesh.axis_names), tuple(self.mesh.devices.shape)
             )
+            # re-traces pin resident state against the NEW mesh (the old
+            # one holds dead device handles)
+            self.programs.mesh = self.mesh
 
     # -- request lifecycle --------------------------------------------------
 
@@ -1014,7 +1237,15 @@ class InferenceEngine:
         # re-writes the same values after it runs)
         self._temps[slot] = req.temperature
         self._topps[slot] = req.top_p
-        self._dirty = True
+        if self._packed:
+            # the small mirrors re-upload on the rows edge; counts/bias
+            # device rows are handled by the packed step itself (zeroed
+            # in-program for fresh slots, full re-upload on exact edges
+            # — _step_packed decides which). The echo fallback's
+            # _run_prefill still forces the full dirty edge.
+            self._rows_stale = True
+        else:
+            self._dirty = True
         return True
 
     def _alloc_pages(self, n: int) -> List[int]:
@@ -1066,6 +1297,12 @@ class InferenceEngine:
                 req, bucket, start_pos, len(seg), advance_key=final,
                 want_plp=req.want_prompt_logprobs,
             )
+        self.step_h2d_bytes["bucketed"] += (
+            tokens.nbytes + targets.nbytes + start.nbytes + seg_lens.nbytes
+            + table.nbytes + temp.nbytes + topp.nbytes + counts_row.nbytes
+            + pres.nbytes + freq.nbytes + self._slot_keys[req.slot].nbytes
+            + self._bias[req.slot : req.slot + 1].nbytes
+        )
         tok, lp, av, ai, plp, cache, new_key = self._call_program(
             "suffix_plp" if req.want_prompt_logprobs else "suffix",
             bucket,
@@ -1112,6 +1349,12 @@ class InferenceEngine:
                 self.lockstep.prefill(
                     req, bucket, want_plp=req.want_prompt_logprobs
                 )
+            self.step_h2d_bytes["bucketed"] += (
+                tokens.nbytes + seq_lens.nbytes + table.nbytes + temp.nbytes
+                + topp.nbytes + counts_row.nbytes + pres.nbytes + freq.nbytes
+                + self._slot_keys[req.slot].nbytes
+                + self._bias[req.slot : req.slot + 1].nbytes
+            )
             tok, lp, av, ai, plp, cache, new_key = self._call_program(
                 "prefill_plp" if req.want_prompt_logprobs else "prefill",
                 bucket,
@@ -1286,7 +1529,15 @@ class InferenceEngine:
         self._eos_on[req.slot] = 1
         self._bias[req.slot] = 0.0
         req.slot = -1
-        self._dirty = True
+        if self._packed:
+            # a retired slot's device counts/bias rows go stale-but-
+            # frozen: the chunk program never samples a zero-budget slot
+            # into anything the host reads, and the next packed
+            # admission into the slot zeroes the rows in-program
+            # (fresh_on) — no O(b·vocab) re-upload per retire edge
+            self._rows_stale = True
+        else:
+            self._dirty = True
 
     # -- token-packed mixed-batch serving (cfg.packed_serving) ---------------
 
@@ -1324,6 +1575,10 @@ class InferenceEngine:
         tokens = np.zeros((T,), dtype=np.int32)
         row_slot = np.full((T,), -1, dtype=np.int32)
         positions = np.zeros((T,), dtype=np.int32)
+        #: rows whose token joins its slot's device count row BEFORE the
+        #: sampling tail: streamed prompt tokens (decode rows' tokens
+        #: were already counted when they were emitted)
+        count_row = np.zeros((T,), dtype=np.int32)
         sample_rows = np.zeros((b,), dtype=np.int32)
         sample_on = np.zeros((b,), dtype=np.int32)
         rows_used = 0
@@ -1347,6 +1602,7 @@ class InferenceEngine:
             positions[start : start + take] = np.arange(
                 req.pos, req.pos + take, dtype=np.int32
             )
+            count_row[start : start + take] = 1
             final = req.pos + take >= len(req.prompt)
             if final:
                 # the segment's last row predicts the first generated token
@@ -1395,6 +1651,28 @@ class InferenceEngine:
             self._waiting.pop(0)
             req.prefilling = True
             req.pos = req.cached_tokens
+            # Device-resident counts: the host mirror follows the
+            # STREAMING semantics the mixed program implements — cached-
+            # prefix counts now (those tokens never enter the buffer),
+            # packed rows as they stream (below). _admit's full-prompt
+            # count is rewritten; the echo fallback above keeps it.
+            self._token_counts[req.slot] = 0
+            if req.cached_tokens:
+                np.add.at(
+                    self._token_counts[req.slot],
+                    req.prompt[: req.cached_tokens], 1,
+                )
+            if req.logit_bias or (
+                (req.presence_penalty or req.frequency_penalty)
+                and req.cached_tokens
+            ):
+                # exact edges the program can't reproduce from the
+                # buffer: a non-zero bias row, or penalties over a
+                # cached prefix whose tokens never stream — fall back to
+                # the full mirror re-upload for this step
+                self._dirty = True
+            else:
+                self._fresh_slots.add(req.slot)
             pack_segment(req)
 
         if not segments:
@@ -1403,10 +1681,11 @@ class InferenceEngine:
             return False
 
         # dispatch at the smallest compiled buffer shape that fits (one
-        # or two shapes ever compile; _packed_shapes), against a page
-        # table sliced to the power-of-two width the step's longest
-        # sequence needs — bit-exact, and it bounds the reference twin's
-        # gather by live context instead of max_seq (mixed_bucket)
+        # or two shapes ever compile; _packed_shapes), against the
+        # device-resident page table sliced IN-PROGRAM to the power-of-
+        # two-ish width the step's longest sequence needs — bit-exact,
+        # and it bounds the reference twin's gather by live context
+        # instead of max_seq (mixed_bucket)
         shape = next(s for s in self._packed_shapes() if s >= rows_used)
         vmask = row_slot[:shape] >= 0
         valid = int(vmask.sum())
@@ -1421,6 +1700,30 @@ class InferenceEngine:
             (shape - valid) * self._pad_token_bytes
         )
         self.dispatch_tokens["packed"] += valid
+        # Scheduler state sync, cheapest sufficient tier: a dirty edge
+        # (exact-count/bias admission, echo fallback, sleep/wake drop)
+        # pushes the full mirrors — and makes the in-program fresh-slot
+        # zeroing moot; otherwise only the small per-slot mirrors
+        # refresh (the mixed program needs the page table rows the
+        # admissions just wrote). Ordering matters: the upload must
+        # precede the host-side streamed-count adds below, because the
+        # program pre-adds the same rows on device either way.
+        fresh_on = np.zeros((b,), dtype=np.int32)
+        if self._dirty or self._dev is None:
+            self._upload_sched()
+        else:
+            if self._fresh_slots:
+                fresh_on[list(self._fresh_slots)] = 1
+            if self._rows_stale:
+                self._upload_sched_table()
+        d = self._dev
+        self.step_h2d_bytes["packed"] += (
+            tokens[:shape].nbytes + row_slot[:shape].nbytes
+            + positions[:shape].nbytes + count_row[:shape].nbytes
+            + sample_rows.nbytes + sample_on.nbytes + fresh_on.nbytes
+            + self._temps.nbytes + self._topps.nbytes + self._pres.nbytes
+            + self._freqs.nbytes + self._slot_keys.nbytes
+        )
         self.last_step_stats = {
             "mode": "packed",
             "rows": shape,
@@ -1433,31 +1736,48 @@ class InferenceEngine:
             "step.packed", rows=shape, tokens=valid,
             decode_rows=len(decode_reqs), prefill_tokens=prefill_tokens,
         ):
-            tok, lp, av, ai, cache, skeys = self._call_program(
-                "mixed", mixed_bucket(shape, kvp),
-                self.params,
-                tokens[:shape],
-                row_slot[:shape],
-                positions[:shape],
-                sample_rows,
-                sample_on,
-                self.pool.as_tuple(),
-                np.ascontiguousarray(self._page_table[:, :kvp]),
-                self._temps,
-                self._topps,
-                self._token_counts,
-                self._pres,
-                self._freqs,
-                self._slot_keys,
-                self._bias,
+            tok, lp, av, ai, cache, counts_dev, bias_dev, skeys = (
+                self._call_program(
+                    "mixed", mixed_bucket(shape, kvp),
+                    self.params,
+                    tokens[:shape],
+                    row_slot[:shape],
+                    positions[:shape],
+                    count_row[:shape],
+                    sample_rows,
+                    sample_on,
+                    fresh_on,
+                    self.pool.as_tuple(),
+                    d["pt"],
+                    self._temps,
+                    self._topps,
+                    d["counts"],
+                    self._pres,
+                    self._freqs,
+                    self._slot_keys,
+                    d["bias"],
+                )
             )
             self.pool.replace(cache)
+            # the program consumed (donated) and re-emitted the device-
+            # resident mirrors; they stay the between-dispatch truth
+            d["counts"] = counts_dev
+            d["bias"] = bias_dev
+            self._fresh_slots.clear()
             # ONE batched host sync for the whole step's emits
             tok_h, lp_h, av_h, ai_h, keys_h = jax.device_get(
                 (tok, lp, av, ai, skeys)
             )
         # non-sampling slots' keys came back unchanged (in-program where)
         self._slot_keys[:] = keys_h
+        # host count mirrors absorb the streamed prompt rows exactly as
+        # the program pre-added them on device (req.pos still pre-step)
+        for req, take, _final in segments:
+            if req.slot >= 0:
+                np.add.at(
+                    self._token_counts[req.slot],
+                    req.prompt[req.pos : req.pos + take], 1,
+                )
 
         def alts_for(req: Request, slot: int):
             if not req.want_top_logprobs:
@@ -1504,9 +1824,11 @@ class InferenceEngine:
             if req.done:
                 self._retire(req)
                 finished.append(req)
-        # the packed path never uses the persistent device scheduler
-        # state; the next chunk dispatch re-uploads the (fresh) mirrors
-        self._dirty = True
+        # the [b, vocab] device mirrors are already exact (the program
+        # maintained them); only the small per-slot mirrors (last
+        # tokens, positions, budgets — advanced by the emits above)
+        # need the next dispatch to refresh them
+        self._rows_stale = True
         return True
 
     # -- speculative (n-gram / prompt-lookup) decoding -----------------------
@@ -1602,6 +1924,9 @@ class InferenceEngine:
         start = np.array([req.pos], dtype=np.int32)
         window_len = np.array([len(window)], dtype=np.int32)
         table = self._page_table[req.slot : req.slot + 1]
+        self.step_h2d_bytes["bucketed"] += (
+            tokens.nbytes + start.nbytes + window_len.nbytes + table.nbytes
+        )
         toks, lps_dev, avs_dev, ais_dev, cache = self._verify_fn(
             self.params, tokens, start, window_len, self.pool.as_tuple(), table
         )
@@ -1783,6 +2108,11 @@ class InferenceEngine:
             self.lockstep.chunk(T, reupload)
         if reupload:
             self._upload_sched()
+        elif self._rows_stale:
+            # packed engines only: the mixed step advanced the small
+            # per-slot mirrors host-side (and admissions/retires touched
+            # the page table); the [b, vocab] counts stay device-exact
+            self._upload_sched_rows()
         d = self._dev
         (
             toks_dev, lps_dev, avs_dev, ais_dev, lt, pos, budget, cache,

@@ -53,8 +53,8 @@ logger = logging.getLogger(__name__)
 DEFAULT_EXEC_NBYTES = 1 << 20
 
 #: programs the warmup driver knows how to compile; "chunk"'s bucket is the
-#: fused step count T, "mixed"'s is the packed [token_budget] buffer
-#: shape, the others' is the prefill token bucket
+#: fused step count T, "mixed"'s is engine.mixed_bucket(buffer rows,
+#: page-table slice width), the others' is the prefill token bucket
 WARM_PROGRAMS = ("prefill", "suffix", "chunk", "mixed")
 
 
@@ -161,6 +161,18 @@ def exec_key(signature: str, program: str, bucket: int) -> str:
     return f"{signature}/{program}@{int(bucket)}"
 
 
+def mesh_shape(mesh) -> Optional[Tuple[int, ...]]:
+    """`exec_signature`'s mesh identity of an engine's mesh (None =
+    single device) — the ONE definition shared by the warmup/compile
+    side (WarmupTask) and the install/reinstall check (engine/server.py):
+    two copies drifting apart would fail the post-build signature check
+    for every swap and silently cost mesh engines their AOT warmup."""
+    return (
+        tuple(int(x) for x in mesh.devices.shape) if mesh is not None
+        else None
+    )
+
+
 def warmup_plan(cfg, buckets) -> List[Tuple[str, int]]:
     """(program, bucket) pairs a warmup covers.
 
@@ -209,11 +221,14 @@ def warmup_plan(cfg, buckets) -> List[Tuple[str, int]]:
 # -- abstract avals -----------------------------------------------------------
 
 
-def _abstract_state(cfg, sharding):
-    """Param-tree and KV-pool avals for `cfg`, with the single-device
-    committed sharding the engine actually uses — shapes come from the
-    registry's init (the same source of truth as the HF loader), so no
-    weights are touched."""
+def _abstract_state(cfg, mesh=None):
+    """Param-tree and KV-pool avals for `cfg`, with the shardings the
+    engine actually uses — single-device committed when `mesh` is None,
+    else the NamedShardings of the live build (params via the registry's
+    logical-axis rules = exactly what ``shard_pytree`` device_puts; the
+    KV pool sharded over kv_heads = exactly ``PagePool.create``). Shapes
+    come from the registry's init (the same source of truth as the HF
+    loader), so no weights are touched."""
     import jax
 
     from ..models.registry import init_params_for
@@ -222,34 +237,68 @@ def _abstract_state(cfg, sharding):
     params = jax.eval_shape(
         lambda k: init_params_for(k, m), jax.random.key(0)
     )
-    params = jax.tree.map(
-        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding),
-        params,
-    )
+    if mesh is None:
+        from jax.sharding import SingleDeviceSharding
+
+        sharding = SingleDeviceSharding(jax.devices()[0])
+        params = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=sharding
+            ),
+            params,
+        )
+        kv_sharding = sharding
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.registry import logical_axes_for
+        from ..parallel.mesh import named_sharding
+
+        def put(s, axes):
+            sh = (
+                NamedSharding(mesh, P()) if axes is None
+                else named_sharding(mesh, axes)
+            )
+            return jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)
+
+        params = jax.tree.map(
+            put, params, logical_axes_for(m),
+            is_leaf=lambda x: x is None,
+        )
+        kv_sharding = NamedSharding(mesh, P(None, None, None, "tp", None))
     kv = jax.ShapeDtypeStruct(
         (m.num_layers, cfg.num_pages, cfg.page_size, m.num_kv_heads,
          m.head_dim),
         m.dtype,
-        sharding=sharding,
+        sharding=kv_sharding,
     )
     return params, (kv, kv)
 
 
-def abstract_args(cfg, program: str, bucket: int) -> list:
+def abstract_args(cfg, program: str, bucket: int, mesh=None) -> list:
     """The abstract call signature of one engine program, matching the
-    live engine's dispatch exactly: params/cache/scheduler arrays are
-    committed device arrays (sharded avals); per-request host mirrors
-    (tokens, temps, counts rows, keys) arrive as numpy and stay
-    placement-free."""
+    live engine's dispatch exactly: params/cache are committed device
+    arrays (sharded avals — NamedSharding under a mesh); scheduler
+    arrays carry the placement of ``_upload_sched`` — plain
+    single-device on one device, explicitly REPLICATED on a mesh
+    (engine._sched_sharding: an AOT executable's input spec must match
+    the live arrays or every dispatch TypeErrors back to jit);
+    per-request host mirrors (tokens, temps, counts rows, keys) arrive
+    as numpy and stay placement-free."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import SingleDeviceSharding
 
-    sharding = SingleDeviceSharding(jax.devices()[0])
+    if mesh is None:
+        sched_sharding = SingleDeviceSharding(jax.devices()[0])
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sched_sharding = NamedSharding(mesh, PartitionSpec())
     m = cfg.model
     V = m.vocab_size
     b, p = cfg.max_batch, cfg.pages_per_seq
-    params, cache = _abstract_state(cfg, sharding)
+    params, cache = _abstract_state(cfg, mesh)
     A = jax.ShapeDtypeStruct
     f32, i32, u32 = jnp.float32, jnp.int32, jnp.uint32
     if program in ("prefill", "prefill_plp"):
@@ -265,10 +314,11 @@ def abstract_args(cfg, program: str, bucket: int) -> list:
             A((1, V), i32), A((1,), f32), A((1,), f32), A((2,), u32),
             A((1, V), f32),
         ]
-    if program == "chunk":
-        def S(shape, dt):
-            return A(shape, dt, sharding=sharding)
 
+    def S(shape, dt):
+        return A(shape, dt, sharding=sched_sharding)
+
+    if program == "chunk":
         return [
             params, S((b,), i32), S((b,), i32), S((b,), i32), cache,
             S((b, p), i32), S((b,), f32), S((b,), f32), S((b, V), i32),
@@ -277,39 +327,58 @@ def abstract_args(cfg, program: str, bucket: int) -> list:
         ]
     if program == "mixed":
         # bucket = engine.mixed_bucket(buffer rows, page-table width);
-        # per-row metadata and the slot-indexed sampling mirrors arrive
-        # as host numpy (placement-free), like the live packed dispatch
-        T, k = bucket >> 16, bucket & 0xFFFF
+        # per-row metadata and the small slot-indexed sampling mirrors
+        # arrive as host numpy (placement-free), like the live packed
+        # dispatch; the page table and the [b, vocab] counts/bias are
+        # DEVICE-RESIDENT scheduler state (the table at FULL width — the
+        # program slices to the bucket's kvp internally)
+        T = bucket >> 16
         return [
-            params, A((T,), i32), A((T,), i32), A((T,), i32),
-            A((b,), i32), A((b,), i32), cache, A((b, k), i32),
-            A((b,), f32), A((b,), f32), A((b, V), i32), A((b,), f32),
-            A((b,), f32), A((b, 2), u32), A((b, V), f32),
+            params, A((T,), i32), A((T,), i32), A((T,), i32), A((T,), i32),
+            A((b,), i32), A((b,), i32), A((b,), i32), cache,
+            S((b, p), i32), A((b,), f32), A((b,), f32), S((b, V), i32),
+            A((b,), f32), A((b,), f32), A((b, 2), u32), S((b, V), f32),
         ]
     raise ValueError(f"unknown warmup program {program!r}")
 
 
-def compile_program(cfg, program: str, bucket: int, programs=None):
+def compile_program(cfg, program: str, bucket: int, programs=None, mesh=None):
     """AOT-compile one engine program for `cfg` at `bucket`:
     ``jit(fn).lower(*avals).compile()`` — host-CPU work only. Returns the
-    ``jax.stages.Compiled`` executable."""
+    ``jax.stages.Compiled`` executable. `mesh` switches the param/cache
+    avals to the live build's NamedShardings (sharded engines)."""
     from .engine import ProgramSet
 
     cfg = _normalize_cfg(cfg)
-    ps = programs or ProgramSet(
-        cfg.model, cfg.logprobs_topk, cfg.eos_token_id
-    )
+    ps = programs or _program_set(cfg, mesh)
     if program == "chunk":
         fn = ps.chunk(int(bucket))
+    elif program == "mixed":
+        fn = ps.mixed(int(bucket) & 0xFFFF)
     else:
         fn = {
             "prefill": ps.prefill,
             "prefill_plp": ps.prefill_plp,
             "suffix": ps.suffix,
             "suffix_plp": ps.suffix_plp,
-            "mixed": ps.mixed,
         }[program]
-    return fn.lower(*abstract_args(cfg, program, bucket)).compile()
+    return fn.lower(*abstract_args(cfg, program, bucket, mesh=mesh)).compile()
+
+
+def _program_set(cfg, mesh=None):
+    """A ProgramSet matching the live engine's for (cfg, mesh): the
+    mixed program's attention impl reroutes through the XLA ragged twin
+    on meshes, exactly like InferenceEngine.__init__ — a warmup-compiled
+    executable must trace the identical program."""
+    from ..ops.attention import resolve_ragged_impl
+    from .engine import ProgramSet
+
+    cfg = _normalize_cfg(cfg)
+    return ProgramSet(
+        cfg.model, cfg.logprobs_topk, cfg.eos_token_id,
+        mixed_impl=resolve_ragged_impl(cfg.model.attention_impl, mesh),
+        mesh=mesh,
+    )
 
 
 def executable_nbytes(compiled, default: int = DEFAULT_EXEC_NBYTES) -> int:
@@ -586,7 +655,13 @@ class WarmupTask:
     ) -> None:
         self.cfg = _normalize_cfg(cfg)
         self.pool = pool
-        self.signature = exec_signature(self.cfg)
+        #: the engine's mesh (None = single device): sharded engines
+        #: compile against NamedSharding avals and key their pool
+        #: entries by mesh shape — an executable lowered for tp=2 must
+        #: never install into a tp=4 build
+        self.mesh = mesh
+        self.mesh_shape = mesh_shape(mesh)
+        self.signature = exec_signature(self.cfg, self.mesh_shape)
         self.plan = warmup_plan(self.cfg, buckets)
         self.results: Dict[Tuple[str, int], Any] = {}
         self.stats: Dict[str, Any] = {
@@ -614,14 +689,7 @@ class WarmupTask:
         self._trace_parent = trace_parent
         self._on_program = on_program
         self._thread: Optional[threading.Thread] = None
-        if mesh is not None:
-            # sharded engines fall back to first-touch jit + the
-            # persistent cache: abstract NamedSharding avals for every
-            # program variant are not plumbed yet (the pool key already
-            # carries the mesh shape for when they are)
-            self.stats["skipped"] = "mesh"
-            self.t_start = self.t_end = time.monotonic()
-        elif not self.plan:
+        if not self.plan:
             self.stats["skipped"] = "no buckets"
             self.t_start = self.t_end = time.monotonic()
         elif start:
@@ -698,8 +766,6 @@ class WarmupTask:
     # -- thread body ----------------------------------------------------------
 
     def _run(self) -> None:
-        from .engine import ProgramSet
-
         self.t_start = time.monotonic()
         root = tracing.begin(
             "warmup.overlap",
@@ -737,13 +803,10 @@ class WarmupTask:
                 t0 = time.monotonic()
                 try:
                     if ps is None:
-                        ps = ProgramSet(
-                            self.cfg.model,
-                            self.cfg.logprobs_topk,
-                            self.cfg.eos_token_id,
-                        )
+                        ps = _program_set(self.cfg, self.mesh)
                     compiled = compile_program(
-                        self.cfg, program, bucket, programs=ps
+                        self.cfg, program, bucket, programs=ps,
+                        mesh=self.mesh,
                     )
                 except Exception as e:  # noqa: BLE001 — warmup never fails a swap
                     self.stats["errors"].append(

@@ -284,6 +284,16 @@ ENGINE_PAD_WASTE_BYTES = Counter(
     "rows of the mixed [token_budget] buffer)",
     ["model", "path"],
 )
+ENGINE_STEP_H2D_BYTES = Counter(
+    "fma_engine_step_h2d_bytes_total",
+    "Host->device scheduler/dispatch bytes moved by engine steps, by "
+    "serving path (packed = mixed-program row inputs + a packed "
+    "engine's scheduler uploads, which are O(rows) per step at steady "
+    "state — the [max_batch, vocab] mirrors re-upload only on dirty "
+    "edges; bucketed = prefill/suffix/spec dispatch inputs + a "
+    "bucketed engine's scheduler uploads)",
+    ["model", "path"],
+)
 
 MODEL_CONFIGS = {
     "tiny": llama.LlamaConfig.tiny,
@@ -406,8 +416,9 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "step per running sequence — concurrent prompts neither "
         "serialize nor stall decode, and the per-bucket prefill "
         "programs leave the warmup plan. off (default) preserves the "
-        "bucketed path byte-for-byte. Single-process engines only; "
-        "incompatible with --pipeline-decode",
+        "bucketed path byte-for-byte. Composes with sharded meshes "
+        "(--tensor-parallel-size); incompatible with --pipeline-decode "
+        "and multi-host gangs",
     )
     p.add_argument(
         "--token-budget",
@@ -636,10 +647,15 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
                 "--packed-serving is incompatible with --pipeline-decode "
                 "(a packed step would race the in-flight chunk)"
             )
-        if args.tensor_parallel_size > 1:
+        gang = getattr(args, "num_processes", 0) or int(
+            os.environ.get("FMA_NUM_PROCESSES", "0") or 0
+        )
+        if gang > 1:
             raise ValueError(
-                "--packed-serving is single-process only (the mixed "
-                "program is not plumbed for sharded meshes yet)"
+                "--packed-serving is incompatible with multi-host gangs "
+                "(the per-step packing layout is too large for the "
+                "lockstep control frame); sharded single-process meshes "
+                "via --tensor-parallel-size compose fine"
             )
     if getattr(args, "model_pool_mib", 0) < 0:
         raise ValueError("--model-pool-mib must be >= 0")
@@ -773,6 +789,7 @@ class EngineService:
         #: last-mirrored engine pad-waste byte totals per dispatch path —
         #: the engine keeps cumulative ints, Prometheus wants increments
         self._pad_waste_seen: Dict[str, int] = {}
+        self._step_h2d_seen: Dict[str, int] = {}
         self.started_at = time.monotonic()
         # Fault-injection arming (utils/faults.py): env first, then the
         # flag — both before the first build so coldload points can fire
@@ -823,6 +840,12 @@ class EngineService:
         # (serves + broadcasts control frames); others follow (replay).
         self.process_id = dist["process_id"] if dist else 0
         self.is_follower = dist is not None and self.process_id > 0
+        #: any member of a multi-host gang (leader included): gangs never
+        #: carry AOT executables — their scheduler arrays keep the legacy
+        #: uncommitted placement (engine._sched_sharding), so a warmed
+        #: executable's replicated-NamedSharding avals could never match,
+        #: and a leader-AOT/follower-jit split would desync the lockstep
+        self.is_gang = dist is not None
         self.watchdog = None
         hb_timeout = float(
             os.environ.get("FMA_GANG_HEARTBEAT_TIMEOUT", "20") or 0
@@ -1126,9 +1149,11 @@ class EngineService:
         worst case the build falls back to first-touch jit."""
         if not self._warmup_buckets:
             return None
-        if self.args.tensor_parallel_size > 1 or self.is_follower:
-            # sharded/gang engines fall back to first-touch jit + the
-            # persistent cache (exec_pool.WarmupTask skips meshes)
+        if self.is_gang:
+            # no gang member carries AOT entries — followers replay the
+            # leader's dispatches through jit, and a leader running AOT
+            # programs against follower jit recompiles could desync the
+            # lockstep (see is_gang)
             return None
         try:
             if resolved is None:
@@ -1137,10 +1162,21 @@ class EngineService:
             cfg = self._engine_cfg_for(model_cfg, eos, extra_eos)
             from .exec_pool import WarmupTask
 
+            mesh = None
+            if self.args.tensor_parallel_size > 1:
+                # the same mesh the build will construct (Mesh equality
+                # is by devices + axis names, so the warmed executables'
+                # NamedSharding avals match the built engine's arrays)
+                from ..parallel.mesh import MeshPlan, make_mesh
+
+                mesh = make_mesh(
+                    MeshPlan(tp=self.args.tensor_parallel_size)
+                )
             task = WarmupTask(
                 cfg,
                 self._warmup_buckets,
                 pool=self.exec_pool,
+                mesh=mesh,
                 trace_parent=tracing.current_context(),
                 on_program=lambda program, secs: ENGINE_WARMUP_SECONDS.labels(
                     program=program
@@ -1160,13 +1196,13 @@ class EngineService:
         where reload is trusted) are reinstalled into the engine's AOT
         table; anything missing jit-compiles on first touch through the
         persistent cache — the pre-existing wake behavior."""
-        if not self._warmup_buckets or self.engine.mesh is not None:
+        if not self._warmup_buckets or self.is_gang:
             return 0
-        from .exec_pool import exec_key, exec_signature, warmup_plan
+        from .exec_pool import exec_key, exec_signature, mesh_shape, warmup_plan
 
         eng = self.engine
         try:
-            sig = exec_signature(eng.cfg)
+            sig = exec_signature(eng.cfg, mesh_shape(eng.mesh))
         except Exception:  # noqa: BLE001 — revalidation is best-effort
             return 0
         n = 0
@@ -1464,10 +1500,12 @@ class EngineService:
             # warmup resolved its config through the same _resolve_model,
             # but an executable compiled for the wrong eos/shape must
             # never install silently.
-            from .exec_pool import exec_signature
+            from .exec_pool import exec_signature, mesh_shape
 
             t_transfer1 = time.monotonic()
-            if warmup.signature == exec_signature(engine.cfg):
+            if warmup.signature == exec_signature(
+                engine.cfg, mesh_shape(engine.mesh)
+            ):
                 warmup.install(engine, timeout=600)
             else:
                 warmup.abort()
@@ -2463,14 +2501,27 @@ class EngineService:
         stats = getattr(eng, "last_step_stats", None)
         if stats is not None and stats.get("mode") == "packed":
             ENGINE_PACKED_TOKENS.labels(model=m).observe(stats["tokens"])
-        for path, total in getattr(eng, "pad_waste_bytes", {}).items():
-            seen = self._pad_waste_seen.get(path, 0)
-            if total > seen:
-                ENGINE_PAD_WASTE_BYTES.labels(model=m, path=path).inc(
-                    total - seen
-                )
-            if total != seen:
-                self._pad_waste_seen[path] = total
+
+        def mirror_path_totals(totals, seen_map, counter):
+            # one delta/reset discipline for every cumulative per-path
+            # engine byte dict (a swap installs a fresh engine whose
+            # counters restart, so a backwards jump resets the mirror
+            # instead of under-counting forever)
+            for path, total in totals.items():
+                seen = seen_map.get(path, 0)
+                if total > seen:
+                    counter.labels(model=m, path=path).inc(total - seen)
+                if total != seen:
+                    seen_map[path] = total
+
+        mirror_path_totals(
+            getattr(eng, "pad_waste_bytes", {}),
+            self._pad_waste_seen, ENGINE_PAD_WASTE_BYTES,
+        )
+        mirror_path_totals(
+            getattr(eng, "step_h2d_bytes", {}),
+            self._step_h2d_seen, ENGINE_STEP_H2D_BYTES,
+        )
 
     def _run_follower(self) -> None:
         """Gang follower: replay the leader's compiled calls until it

@@ -463,6 +463,14 @@ def mixed_step(
     to its own sequence at positions <= its own — causal prefill, suffix
     continuation, and decode are all the same mask.
 
+    ``page_table`` arrives already sliced to the step's KV width — the
+    mixed program slices the device-resident full-width table with a
+    static ``kv_pages_bucket`` bound before calling here (bit-exact:
+    the dropped entries were hard-masked exact zeros for every row).
+    Under a sharded mesh the gather/scatter and einsums GSPMD-partition
+    over the kv_heads/heads shards; the ragged op runs its XLA twin
+    there (ops/attention.py:resolve_ragged_impl).
+
     Returns (logits [T, vocab], new_cache); the caller gathers the rows
     that sample (each segment's last token / each decode row). Padding
     rows write nothing and produce garbage logits.

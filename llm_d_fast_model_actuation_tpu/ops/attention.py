@@ -201,6 +201,23 @@ def paged_decode_attention(
 RAGGED_BLOCK = 8
 
 
+def resolve_ragged_impl(impl: str, mesh) -> str:
+    """The implementation the RAGGED op runs under for an engine on
+    `mesh` (None = single device). The hand-written Pallas kernel is a
+    single-device program — it walks the page pool with raw HBM DMA and
+    has no shard_map plumbing yet — so sharded engines route the mixed
+    program through the XLA twin below, whose gather/scatter GSPMD
+    partitions: ``k_pages[pt]`` gathers on the (replicated) page axis of
+    a pool sharded over kv_heads, so each device reads only its own head
+    shard, and the einsums contract the head-sharded axes in place. The
+    engine's bucketed programs keep their configured impl — only the
+    packed path is rerouted (and packs densely: the twin computes every
+    row independently, so RAGGED_BLOCK alignment buys nothing)."""
+    if mesh is not None and impl == "pallas":
+        return "grouped"
+    return impl
+
+
 def ragged_paged_attention(
     q: jnp.ndarray,  # [tokens, heads, head_dim] — flat packed token buffer
     k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]

@@ -26,6 +26,14 @@ Packing contract (the engine's packer upholds it, engine/engine.py):
 Grid: one program per row block. GQA reads each KV head's page tile once
 per block and loops the query heads of its group over it — repeated KV
 heads are never materialized, mirroring the decode kernel.
+
+Scope: SINGLE-DEVICE. The kernel walks the page pool with raw HBM DMA
+and has no shard_map plumbing, so sharded-mesh engines route the mixed
+program through the XLA twin instead (whose gather/scatter GSPMD
+partitions over the kv_heads shards) — see
+``ops/attention.py:resolve_ragged_impl``. They also pack densely: the
+``block_rows`` alignment below buys nothing when every row computes
+independently.
 """
 
 from __future__ import annotations

@@ -264,7 +264,7 @@ def test_warmup_abort_drop_results_discards_inflight_compile(monkeypatch):
     started = threading.Event()
     release = threading.Event()
 
-    def slow_compile(cfg_, program, bucket, programs=None):
+    def slow_compile(cfg_, program, bucket, programs=None, mesh=None):
         started.set()
         assert release.wait(30)
         return object()
@@ -280,10 +280,27 @@ def test_warmup_abort_drop_results_discards_inflight_compile(monkeypatch):
     assert task.stats["aborted"] and task.stats["compiled"] == 0
 
 
-def test_warmup_skips_meshes():
-    task = WarmupTask(tiny_cfg(), (16,), mesh=object())
-    assert task.stats["skipped"] == "mesh"
-    assert task.wait(0) and task.results == {}
+def test_warmup_compiles_for_meshes():
+    """Sharded engines get real AOT warmup now: the task lowers every
+    program against the mesh's NamedSharding avals (host-CPU work, no
+    device state) and keys the results by mesh shape."""
+    import jax
+
+    from llm_d_fast_model_actuation_tpu.parallel.mesh import (
+        MeshPlan,
+        make_mesh,
+    )
+
+    mesh = make_mesh(MeshPlan(tp=2), jax.devices()[:2])
+    task = WarmupTask(tiny_cfg(), (16,), mesh=mesh)
+    assert task.wait(120)
+    assert not task.stats["skipped"]
+    assert task.stats["errors"] == []
+    assert task.stats["compiled"] == len(task.plan) > 0
+    # the pool key carries the mesh shape: a single-device warmup of the
+    # same config must not collide with the sharded one
+    single = WarmupTask(tiny_cfg(), (16,), start=False)
+    assert single.signature != task.signature
 
 
 # -- service-level contracts --------------------------------------------------

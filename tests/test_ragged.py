@@ -369,6 +369,135 @@ def test_packed_incompatible_with_pipeline_decode():
         InferenceEngine(_cfg(True, pipeline_decode=True), seed=0)
 
 
+# -- device-resident scheduler state (dirty edges + per-step H2D) -------------
+#
+# The packed step keeps the [max_batch, vocab] count/bias mirrors ON
+# DEVICE between dispatches (the mixed program maintains them, like the
+# chunk program always has); host mirrors re-upload only on dirty edges.
+# Every edge below must leave greedy outputs bit-exact vs the bucketed
+# path — and the steady-state H2D must stay O(rows).
+
+
+def test_packed_sched_drop_mid_stream_exact():
+    """The sleep/wake edge (engine.drop_device_sched_state): dropping
+    the device scheduler state mid-generation — with one request still
+    mid-chunked-prefill and penalties active — must rebuild bit-exactly
+    from the host mirrors on the next dispatch."""
+    long_p = [5, 4, 3, 2, 1] * 8  # chunked at 6/step
+    short_p = [1, 2, 3]
+
+    def run(packed, drop):
+        eng = InferenceEngine(_cfg(packed, max_prefill_tokens=6), seed=0)
+        out = {}
+        a = eng.add_request(long_p, 6, presence_penalty=0.5)
+        b = eng.add_request(short_p, 6, frequency_penalty=0.4)
+        for _ in range(2):  # long prompt mid-prefill, short one decoding
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        if drop:
+            eng.drop_device_sched_state()
+        while eng.has_work():
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        return out[a], out[b]
+
+    gold = run(False, drop=False)
+    assert run(True, drop=False) == gold
+    assert run(True, drop=True) == gold
+    assert run(False, drop=True) == gold  # the bucketed edge still holds
+
+
+def test_packed_penalties_over_cached_prefix_exact():
+    """The exact-count edge: a penalty request whose prompt hits the
+    prefix cache (its cached tokens never stream through the packed
+    buffer) forces the full-mirror re-upload instead of in-program
+    accumulation — counts must still cover the whole prompt."""
+    shared = [11, 12, 13, 14, 15, 16, 17, 18]  # one full page at size 8
+
+    def run(packed):
+        eng = InferenceEngine(_cfg(packed), seed=0)
+        out = {}
+        first = eng.add_request(shared + [1, 2], 4)
+        while eng.has_work():
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        # same prefix -> cache hit; penalties must count the cached part
+        second = eng.add_request(
+            shared + [3, 4], 8, presence_penalty=0.9, frequency_penalty=0.7
+        )
+        while eng.has_work():
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        return out[first], out[second]
+
+    got = run(True)
+    assert got == run(False)
+
+
+def test_packed_bias_admission_mid_stream_exact():
+    """The bias edge: a logit_bias request admitted while another stream
+    is mid-decode re-uploads the mirrors once; the biased sample and the
+    neighbor's decode stay bit-exact vs bucketed."""
+    def run(packed):
+        eng = InferenceEngine(_cfg(packed), seed=0)
+        out = {}
+        a = eng.add_request([7, 6, 5, 4], 10)
+        for _ in range(2):
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        b = eng.add_request([1, 2, 3], 6, logit_bias={5: 50.0})
+        while eng.has_work():
+            for r in eng.step():
+                out[r.seq_id] = r.out_tokens
+        return out[a], out[b]
+
+    assert run(True) == run(False)
+
+
+def test_packed_steady_state_h2d_o_rows():
+    """The headline: steady-state packed decode moves O(rows) H2D per
+    step — no [max_batch, vocab] mirror re-upload. With a vocab big
+    enough to dominate, the packed path's per-step bytes must be at
+    least 10x below what per-step mirror re-uploads (the pre-device-
+    resident behavior, and what admission-heavy bucketed serving still
+    pays) would cost."""
+    model = llama.LlamaConfig.tiny(vocab=4096)
+    cfg = EngineConfig(
+        model=model, max_batch=4, page_size=8, num_pages=64,
+        max_seq_len=128, packed_serving=True, token_budget=96,
+        prefix_caching=False,
+    )
+    eng = InferenceEngine(cfg, seed=0)
+    prompts = [[i + 1, i + 2, i + 3, i + 4, i + 5] for i in range(4)]
+    eng.generate(prompts, max_new_tokens=4)  # warm + first full upload
+    eng.step_h2d_bytes = {"packed": 0, "bucketed": 0}
+    steps0 = eng.packed_steps
+    # two waves of admissions mid-decode: every step has prefill work,
+    # so the packed program dispatches continuously
+    ids = [eng.add_request(p, 8) for p in prompts]
+    for _ in range(2):
+        eng.step()
+    ids += [eng.add_request([9, 8, 7, 6], 8) for _ in range(2)]
+    while eng.has_work():
+        eng.step()
+    packed_steps = eng.packed_steps - steps0
+    assert packed_steps >= 2
+    spent = eng.step_h2d_bytes["packed"]
+    assert spent > 0
+    # what the old path paid per packed step: the [b, vocab] counts +
+    # bias mirrors alone (ignoring its page-table and small-mirror
+    # uploads — being generous to the baseline)
+    b, V = cfg.max_batch, model.vocab_size
+    mirrors_per_step = b * V * (4 + 4)
+    assert spent * 10 <= packed_steps * mirrors_per_step, (
+        spent, packed_steps, mirrors_per_step
+    )
+    # and no full upload happened at all in this window (admissions had
+    # no bias / cached-prefix penalties): the total stays under ONE
+    # mirror re-upload
+    assert spent < mirrors_per_step
+
+
 # -- warmup plan / exec pool --------------------------------------------------
 
 
@@ -462,6 +591,10 @@ def test_service_packed_metrics_and_span():
             'fma_engine_prefill_pad_waste_bytes_total{model="tiny",'
             'path="packed"}' in exposition
         )
+        assert (
+            'fma_engine_step_h2d_bytes_total{model="tiny",'
+            'path="packed"}' in exposition
+        )
     finally:
         svc.shutdown()
 
@@ -475,9 +608,17 @@ def test_service_packed_flag_validation():
         parse_engine_options(
             "--model tiny --packed-serving on --pipeline-decode on"
         )
+    # sharded single-process meshes compose with packed serving now
+    args = parse_engine_options(
+        "--model tiny --packed-serving on --tensor-parallel-size 2"
+    )
+    assert args.packed_serving == "on"
+    # ... multi-host gangs do not (the lockstep frame can't carry the
+    # per-step packing layout)
     with pytest.raises(ValueError):
         parse_engine_options(
-            "--model tiny --packed-serving on --tensor-parallel-size 2"
+            "--model tiny --packed-serving on --num-processes 2 "
+            "--process-id 0 --coordinator-address 127.0.0.1:1234"
         )
     with pytest.raises(ValueError):
         parse_engine_options("--model tiny --token-budget -1")
