@@ -1298,6 +1298,456 @@ def _measure_swap_recovery() -> None:
     print(json.dumps(result))
 
 
+def _argv_value(flag: str, default: str) -> str:
+    """``--flag VALUE`` (or ``--flag=VALUE``) from sys.argv, forwarded to
+    the measurement child by _run_child."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == flag and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return default
+
+
+def _http_json(
+    method: str, url: str, body=None, timeout: float = 30
+):
+    """Tiny urllib JSON helper (the fleet harness's only HTTP client —
+    no dependency on `requests`). Returns (status, parsed-or-text)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            try:
+                return resp.status, json.loads(raw or b"{}")
+            except ValueError:
+                return resp.status, raw.decode(errors="replace")
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")[:300]
+        return e.code, detail
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http_ok(url: str, timeout_s: float = 240.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            status, _ = _http_json("GET", url, timeout=2)
+            if status == 200:
+                return
+            last = status
+        except Exception as e:  # noqa: BLE001 — not up yet
+            last = e
+        time.sleep(0.2)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+def _measure_fleet() -> None:
+    """Child entry for the `fleet` sub-bench: the fleet traffic harness
+    (ROADMAP item 2).
+
+    Drives a REAL launcher subprocess holding one engine instance over N
+    sibling tiny variants with the deterministic open-loop arrival trace
+    from benchmark/fleet.py (Zipf-skewed popularity, bursty phases, all
+    precomputed from --seed): requests for the resident variant go
+    straight to the engine's /v1/completions; requests for a non-resident
+    variant queue behind a minimal router that hot-swaps the instance
+    toward the deepest queue — so delta swap, the executable pool, packed
+    host pools and the new SLO telemetry all compose under live load.
+    Reported: SLO attainment (client-judged arrival -> first token vs
+    --slo-ttft-ms, the same targets the engine judges), goodput tok/s,
+    actuations/hour, and queue-wait p50/p95/p99 (router hold + the
+    engine's own queue_wait_s from the usage block). Meaningful on the
+    CPU backend: every number is a ratio/latency of the same tiny-model
+    work, and the arrival trace is platform-independent."""
+    import shutil
+    import threading
+    from collections import deque
+
+    import jax
+    import numpy as np
+
+    from llm_d_fast_model_actuation_tpu.benchmark import fleet as fleetmod
+    from llm_d_fast_model_actuation_tpu.models import checkpoint as ckpt_mod
+    from llm_d_fast_model_actuation_tpu.models import llama
+
+    seed = int(_argv_value("--seed", "0"))
+    n_models = max(2, int(os.environ.get("FMA_FLEETBENCH_MODELS", "3")))
+    duration = float(os.environ.get("FMA_FLEETBENCH_DURATION", "12"))
+    base_rate = float(os.environ.get("FMA_FLEETBENCH_RATE", "6"))
+    burst_rate = float(os.environ.get("FMA_FLEETBENCH_BURST", "18"))
+    slo_ttft_ms = float(
+        os.environ.get("FMA_FLEETBENCH_SLO_TTFT_MS", "2000")
+    )
+    slo_tpot_ms = float(
+        os.environ.get("FMA_FLEETBENCH_SLO_TPOT_MS", "1000")
+    )
+    min_residency_s = 0.5  # router: no thrash — one swap per window
+    max_hold_s = 3.0  # ...unless a queued model starved this long
+
+    # --- N sibling Orbax variants of the tiny model (final_norm delta:
+    # the fine-tune shape the tiered pool dedupes / delta-swaps) ---------
+    vdir = os.environ.get("FMA_FLEETBENCH_DIR", "/tmp/fma-fleetbench")
+    shutil.rmtree(vdir, ignore_errors=True)
+    vcfg = llama.LlamaConfig.tiny()
+    base_params = llama.init_params(jax.random.key(11), vcfg)
+    vrng = np.random.default_rng(17)
+    ckpts = []
+    for i in range(n_models):
+        params = dict(base_params)
+        if i:
+            fn = np.asarray(base_params["final_norm"])
+            params["final_norm"] = (
+                fn + vrng.standard_normal(fn.shape).astype(np.float32)
+            )
+        ck = os.path.join(vdir, f"variant-{i}")
+        ckpt_mod.save_params(ck, vcfg, params)
+        ckpts.append(ck)
+
+    # --- launcher subprocess + one engine instance ----------------------
+    lport, eport = _free_port(), _free_port()
+    log_dir = os.path.join(vdir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    lbase = f"http://127.0.0.1:{lport}"
+    ebase = f"http://127.0.0.1:{eport}"
+    env = dict(os.environ)
+    env.setdefault("PYTHONPATH", REPO_ROOT)
+    with open(os.path.join(log_dir, "launcher.log"), "wb") as lout:
+        launcher = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "llm_d_fast_model_actuation_tpu.launcher.main",
+                "--mock-chips", "--mock-chip-count", "4",
+                "--mock-topology", "2x2",
+                "--host", "127.0.0.1", "--port", str(lport),
+                "--log-dir", log_dir,
+            ],
+            env=env, stdout=lout, stderr=subprocess.STDOUT,
+        )
+    try:
+        _wait_http_ok(lbase + "/health", 240)
+        options = (
+            f"--model tiny --checkpoint-dir {ckpts[0]} --port {eport} "
+            f"--num-pages 64 --page-size 8 --max-batch 4 "
+            f"--max-model-len 96 --swap-bucket-mib 1 "
+            f"--model-pool-mib 512 --content-hash on "
+            f"--slo-ttft-ms {slo_ttft_ms} --slo-tpot-ms {slo_tpot_ms} "
+            f"--arrival-ewma-tau-s 10"
+        )
+        env_vars = {}
+        if jax.devices()[0].platform != "tpu":
+            env_vars["JAX_PLATFORMS"] = "cpu"
+        status, body = _http_json(
+            "PUT", lbase + "/v2/vllm/instances/fleet-0",
+            {"options": options, "env_vars": env_vars}, timeout=60,
+        )
+        assert status == 201, (status, body)
+        _wait_http_ok(ebase + "/health", 300)
+
+        def swap_to(i: int) -> dict:
+            for attempt in (1, 2):
+                status, body = _http_json(
+                    "POST", lbase + "/v2/vllm/instances/fleet-0/swap",
+                    {"model": "tiny", "checkpoint_dir": ckpts[i]},
+                    timeout=180,
+                )
+                if status == 200:
+                    return body
+                if status != 503 or attempt == 2:
+                    # 503 = transactional rollback (retryable); anything
+                    # else is a real harness failure
+                    raise AssertionError((status, body))
+                time.sleep(0.2)
+
+        # Pre-warm: one cold build per variant (pools them all, compiles
+        # once into the shared executable pool), ending resident on 0 —
+        # the measured window then exercises warm delta swaps, which is
+        # the steady state of a long-running fleet.
+        for i in list(range(1, n_models)) + [0]:
+            swap_to(i)
+
+        cfg = fleetmod.FleetTrafficConfig(
+            seed=seed,
+            num_models=n_models,
+            duration_s=duration,
+            base_rate_rps=base_rate,
+            burst_rate_rps=burst_rate,
+            vocab=vcfg.vocab_size,
+        )
+        arrivals = fleetmod.generate_arrivals(cfg)
+        trace_sha = fleetmod.trace_digest(arrivals)
+
+        # --- open-loop run ----------------------------------------------
+        mu = threading.Lock()
+        results = []
+        queues = {i: deque() for i in range(n_models)}
+        resident = [0]
+        inflight_by_model = {i: 0 for i in range(n_models)}
+        swaps = [0]
+        last_swap = [time.monotonic()]
+        threads = []
+
+        def fire(arr, t_arr: float) -> None:
+            def run():
+                t_disp = time.monotonic()
+                try:
+                    status, body = _http_json(
+                        "POST", ebase + "/v1/completions",
+                        {
+                            "prompt": list(arr.prompt),
+                            "max_tokens": arr.max_tokens,
+                            "ignore_eos": True,
+                        },
+                        timeout=120,
+                    )
+                except Exception as e:  # noqa: BLE001 — refused/reset mid-swap
+                    status, body = 0, f"{type(e).__name__}: {e}"
+                rec = {
+                    "model": arr.model,
+                    "hold_s": t_disp - t_arr,
+                }
+                if status == 200 and isinstance(body, dict):
+                    u = body.get("usage") or {}
+                    rec.update(
+                        ok=True,
+                        tokens=u.get("completion_tokens", 0),
+                        ttft_s=u.get("time_to_first_token_s") or 0.0,
+                        queue_wait_s=u.get("queue_wait_s") or 0.0,
+                        tpot_s=u.get("decode_tpot_s"),
+                    )
+                else:
+                    # a 5xx here is (virtually always) the router's own
+                    # swap preempting the in-flight request — the cost of
+                    # actuating under load, charged as a violation
+                    rec.update(ok=False, tokens=0, status=status)
+                with mu:
+                    inflight_by_model[arr.model] -= 1
+                    results.append(rec)
+
+            with mu:
+                inflight_by_model[arr.model] += 1
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+
+        def router_step(force: bool = False) -> None:
+            """Swap toward the deepest starved queue (one policy knob
+            shy of ROADMAP item 1's scheduler — this harness only has to
+            EXERCISE actuation under load, not optimize it). The router
+            normally waits for the resident model's in-flight work to
+            finish (a swap aborts it), but a queue starved past
+            max_hold_s forces the swap anyway — the abort-under-
+            actuation path the `reason="swap"` attribution exists for."""
+            now = time.monotonic()
+            with mu:
+                candidates = [
+                    (len(q), i)
+                    for i, q in queues.items()
+                    if q and i != resident[0]
+                ]
+                if not candidates:
+                    return
+                depth, target = max(candidates)
+                oldest = queues[target][0][1]
+                resident_busy = inflight_by_model[resident[0]] > 0
+                recent = now - last_swap[0] < min_residency_s
+                starved = now - oldest > max_hold_s
+            if not force:
+                if recent and not starved:
+                    return
+                if resident_busy and not starved:
+                    return
+            swap_to(target)
+            with mu:
+                resident[0] = target
+                last_swap[0] = time.monotonic()
+                swaps[0] += 1
+                drained = list(queues[target])
+                queues[target].clear()
+            for arr, t_arr in drained:
+                fire(arr, t_arr)
+
+        t0 = time.monotonic()
+        for arr in arrivals:
+            # t_arr is the SCHEDULED arrival: if a synchronous swap (or
+            # anything else) stalls this loop, the lag lands in hold_s —
+            # open-loop load never gets quietly deferred
+            sched = t0 + arr.t_s
+            delay = sched - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            with mu:
+                direct = arr.model == resident[0]
+                if not direct:
+                    queues[arr.model].append((arr, sched))
+            if direct:
+                fire(arr, sched)
+            router_step()
+        # drain: every queued model gets its swap (letting each fired
+        # batch finish first — draining is not part of the offered load,
+        # so it shouldn't manufacture extra aborts); then join the tails
+        drain_deadline = time.monotonic() + 300
+        while time.monotonic() < drain_deadline:
+            with mu:
+                pending = any(queues.values())
+                busy = inflight_by_model[resident[0]] > 0
+            if not pending:
+                break
+            if busy:
+                time.sleep(0.05)
+                continue
+            router_step(force=True)
+        # no silent caps: arrivals still queued when the drain deadline
+        # expired were offered load that never got served — they must
+        # count against attainment, loudly, not vanish from the result
+        with mu:
+            undrained = sum(len(q) for q in queues.values())
+            for q in queues.values():
+                q.clear()
+        if undrained:
+            print(
+                f"fleet drain deadline: {undrained} queued requests "
+                f"never dispatched (counted as violated)",
+                file=sys.stderr,
+            )
+        for t in threads:
+            t.join(timeout=180)
+        wall_s = time.monotonic() - t0
+
+        # --- score ------------------------------------------------------
+        met = 0
+        goodput_tokens = 0
+        queue_waits = []
+        aborted = 0
+        for rec in results:
+            qw = rec["hold_s"] + rec.get("queue_wait_s", 0.0)
+            queue_waits.append(qw)
+            if not rec["ok"]:
+                aborted += 1
+                continue
+            ttft_total = rec["hold_s"] + rec["ttft_s"]
+            ok = ttft_total <= slo_ttft_ms / 1e3
+            if rec.get("tpot_s") is not None:
+                ok = ok and rec["tpot_s"] <= slo_tpot_ms / 1e3
+            if ok:
+                met += 1
+                goodput_tokens += rec["tokens"]
+        # undrained arrivals are violated by definition (never served);
+        # they count in attainment's denominator but not in the queue-wait
+        # percentiles, which describe requests that were dispatched
+        total = len(results) + undrained
+        attainment = met / total if total else 0.0
+        p50 = fleetmod.percentile(queue_waits, 50)
+        p95 = fleetmod.percentile(queue_waits, 95)
+        p99 = fleetmod.percentile(queue_waits, 99)
+
+        # --- the observability surfaces this PR exists for --------------
+        _, engine_metrics = _http_json("GET", ebase + "/metrics", timeout=15)
+        _, engine_stats = _http_json("GET", ebase + "/v1/stats", timeout=15)
+        _, instances = _http_json(
+            "GET", lbase + "/v2/vllm/instances", timeout=30
+        )
+        _, launcher_metrics = _http_json(
+            "GET", lbase + "/metrics", timeout=30
+        )
+        fleet_block = (
+            instances.get("fleet", {}) if isinstance(instances, dict) else {}
+        )
+        families_present = {
+            name: isinstance(engine_metrics, str) and name in engine_metrics
+            for name in (
+                "fma_engine_queue_wait_seconds",
+                "fma_engine_slo_requests_total",
+                "fma_engine_goodput_tokens_total",
+                "fma_engine_request_arrival_rate",
+            )
+        }
+
+        _http_json("DELETE", lbase + "/v2/vllm/instances", timeout=60)
+    finally:
+        launcher.terminate()
+        try:
+            launcher.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            launcher.kill()
+
+    result = {
+        "metric": "fleet_slo_attainment",
+        "value": round(attainment, 4),
+        "unit": "frac",
+        # vs the perfect-attainment target: the headline IS the fraction
+        "vs_baseline": round(attainment, 4),
+        "extra": {
+            "platform": jax.devices()[0].platform,
+            "seed": seed,
+            "traffic": {
+                "num_models": cfg.num_models,
+                "duration_s": cfg.duration_s,
+                "base_rate_rps": cfg.base_rate_rps,
+                "burst_rate_rps": cfg.burst_rate_rps,
+                "phase_s": cfg.phase_s,
+                "zipf_s": cfg.zipf_s,
+                "burst_hot_frac": cfg.burst_hot_frac,
+                "prompt_len_min": cfg.prompt_len_min,
+                "prompt_len_max": cfg.prompt_len_max,
+                "max_tokens_min": cfg.max_tokens_min,
+                "max_tokens_max": cfg.max_tokens_max,
+                "vocab": cfg.vocab,
+            },
+            "arrival_trace_sha256": trace_sha,
+            "requests_total": total,
+            "requests_met": met,
+            "requests_aborted": aborted,
+            "requests_undrained": undrained,
+            "slo_ttft_ms": slo_ttft_ms,
+            "slo_tpot_ms": slo_tpot_ms,
+            "slo_attainment": round(attainment, 4),
+            "goodput_tok_s": round(goodput_tokens / wall_s, 2)
+            if wall_s > 0
+            else 0.0,
+            "goodput_tokens": goodput_tokens,
+            "actuations_per_hour": round(swaps[0] * 3600.0 / wall_s, 1)
+            if wall_s > 0
+            else 0.0,
+            "swaps": swaps[0],
+            "queue_wait_p50_s": round(p50, 4),
+            "queue_wait_p95_s": round(p95, 4),
+            "queue_wait_p99_s": round(p99, 4),
+            "wall_s": round(wall_s, 3),
+            # cross-checks from the three observability surfaces
+            "engine_metrics_present": families_present,
+            "engine_stats": engine_stats
+            if isinstance(engine_stats, dict)
+            else {},
+            "fleet": fleet_block,
+            "launcher_fleet_metrics_present": (
+                isinstance(launcher_metrics, str)
+                and "fma_launcher_fleet_slo_attainment" in launcher_metrics
+            ),
+        },
+    }
+    if _trace_out_path():
+        _emit_trace(_trace_out_path(), result)
+    print(json.dumps(result))
+
+
 def _run_child(
     env: dict, sub: str = ""
 ) -> "subprocess.CompletedProcess[str]":
@@ -1309,6 +1759,9 @@ def _run_child(
     trace_out = _trace_out_path()
     if trace_out:
         argv += ["--trace-out", trace_out]
+    seed = _argv_value("--seed", "")
+    if seed:
+        argv += ["--seed", seed]
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )
@@ -1334,9 +1787,16 @@ def main() -> int:
     # `bench.py` = the actuation headline; `bench.py coldload` = the
     # cold-start loader sub-bench; `bench.py swap` = the failure-recovery
     # probe (rollback vs full restart); `bench.py decode` = the batched
-    # mixed-batch throughput probe — same TPU-then-CPU fallback runner.
+    # mixed-batch throughput probe; `bench.py fleet` = the open-loop
+    # multi-tenant SLO/goodput harness — same TPU-then-CPU fallback
+    # runner.
     sub = next(
-        (s for s in ("coldload", "swap", "decode") if s in sys.argv[1:]), ""
+        (
+            s
+            for s in ("coldload", "swap", "decode", "fleet")
+            if s in sys.argv[1:]
+        ),
+        "",
     )
     if "--child" in sys.argv:
         if _trace_out_path():
@@ -1350,6 +1810,8 @@ def main() -> int:
             _measure_swap_recovery()
         elif sub == "decode":
             _measure_decode_batched()
+        elif sub == "fleet":
+            _measure_fleet()
         else:
             _measure()
         return 0
@@ -1419,10 +1881,12 @@ def main() -> int:
             "coldload": "coldload_parallel_speedup",
             "swap": "swap_rollback_recovery",
             "decode": "packed_decode_tok_s_c4",
+            "fleet": "fleet_slo_attainment",
         }.get(sub, "level1_wake_bandwidth"),
         "value": 0.0,
         "unit": {
             "coldload": "x_vs_sequential", "swap": "s", "decode": "tok/s",
+            "fleet": "frac",
         }.get(sub, "GiB/s"),
         "vs_baseline": 0.0,
         "extra": {

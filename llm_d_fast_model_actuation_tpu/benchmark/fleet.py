@@ -1,0 +1,166 @@
+"""Fleet traffic model: deterministic open-loop multi-tenant arrivals.
+
+The north-star workload (ROADMAP item 2) is many models on few chips under
+heavy, *skewed* traffic: popularity follows a Zipf law (a few hot models
+take most requests; "Towards Multi-Model LLM Schedulers" measures exactly
+this shape), and load arrives in bursts, not a steady stream. This module
+generates that arrival process ahead of time from an explicit seed so a
+run is reproducible end to end:
+
+- **Open loop**: arrival times are drawn from a piecewise-homogeneous
+  Poisson process (exponential gaps at the phase's rate) and never depend
+  on service completions — a slow server builds queue, it does not slow
+  the offered load (the closed-loop fallacy every serving benchmark warns
+  about).
+- **Bursty phases**: the rate alternates ``base_rate_rps`` /
+  ``burst_rate_rps`` every ``phase_s`` seconds, and each burst phase
+  rotates a different "hot" model whose popularity is boosted — the
+  diurnal/hotspot shape that forces actuations instead of letting one
+  resident model absorb everything.
+- **Zipf popularity**: model ``i`` draws with weight ``1/(i+1)**zipf_s``
+  outside bursts.
+
+Everything is ``random.Random(seed)`` (stdlib, platform-stable): the same
+config MUST produce the identical trace on every machine — CI asserts it,
+and ``trace_digest`` gives the one-line fingerprint benches embed in their
+result JSON.
+
+Consumed by ``bench.py fleet`` (the load harness over a live launcher) and
+by tests; it deliberately has no HTTP or jax dependencies.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+from dataclasses import asdict, dataclass, field
+from typing import List, Sequence
+
+
+@dataclass(frozen=True)
+class FleetTrafficConfig:
+    """Knobs of the synthetic multi-tenant arrival process. All fields
+    feed the deterministic generator — two equal configs (seed included)
+    produce byte-identical traces."""
+
+    seed: int = 0
+    num_models: int = 3
+    duration_s: float = 12.0
+    #: offered load outside / inside burst phases (requests per second,
+    #: summed over all models — open loop)
+    base_rate_rps: float = 6.0
+    burst_rate_rps: float = 18.0
+    #: phase length; phases alternate base, burst, base, burst, ...
+    phase_s: float = 3.0
+    #: Zipf skew exponent for model popularity (0 = uniform)
+    zipf_s: float = 1.1
+    #: during a burst phase this fraction of draws goes to the phase's
+    #: rotating hot model, the rest to the Zipf base distribution
+    burst_hot_frac: float = 0.6
+    #: per-request shape (token ids drawn uniformly from [1, vocab))
+    prompt_len_min: int = 4
+    prompt_len_max: int = 12
+    max_tokens_min: int = 4
+    max_tokens_max: int = 8
+    vocab: int = 400
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One precomputed request of the open-loop trace."""
+
+    #: offset from trace start, seconds
+    t_s: float
+    #: model index in [0, num_models)
+    model: int
+    prompt: tuple = field(default_factory=tuple)
+    max_tokens: int = 4
+
+
+def _zipf_weights(n: int, s: float) -> List[float]:
+    w = [1.0 / ((i + 1) ** s) for i in range(n)]
+    total = sum(w)
+    return [x / total for x in w]
+
+
+def _pick(rng: random.Random, weights: Sequence[float]) -> int:
+    x = rng.random()
+    acc = 0.0
+    for i, w in enumerate(weights):
+        acc += w
+        if x < acc:
+            return i
+    return len(weights) - 1
+
+
+def generate_arrivals(cfg: FleetTrafficConfig) -> List[Arrival]:
+    """Precompute the whole arrival trace for ``cfg``. Deterministic:
+    equal configs yield identical traces (the bench's seeded-CI
+    contract)."""
+    if cfg.num_models < 1:
+        raise ValueError("num_models must be >= 1")
+    if cfg.duration_s <= 0:
+        raise ValueError("duration_s must be > 0")
+    if cfg.phase_s <= 0:
+        raise ValueError("phase_s must be > 0")
+    if not (0.0 <= cfg.burst_hot_frac <= 1.0):
+        raise ValueError("burst_hot_frac must be in [0, 1]")
+    if cfg.prompt_len_min < 1 or cfg.prompt_len_max < cfg.prompt_len_min:
+        raise ValueError("bad prompt_len range")
+    if cfg.max_tokens_min < 1 or cfg.max_tokens_max < cfg.max_tokens_min:
+        raise ValueError("bad max_tokens range")
+    rng = random.Random(cfg.seed)
+    base_w = _zipf_weights(cfg.num_models, cfg.zipf_s)
+    out: List[Arrival] = []
+    t = 0.0
+    while True:
+        phase = int(t / cfg.phase_s)
+        burst = phase % 2 == 1
+        rate = cfg.burst_rate_rps if burst else cfg.base_rate_rps
+        # exponential gap at the *current* phase's rate: a phase boundary
+        # mid-gap slightly blurs the edge, which is fine for a load model
+        # (and keeps the draw count — hence determinism — simple)
+        t += rng.expovariate(max(1e-9, rate))
+        if t >= cfg.duration_s:
+            break
+        if burst and cfg.num_models > 1 and rng.random() < cfg.burst_hot_frac:
+            # rotate the hot model per burst phase so every variant takes
+            # a turn being the one the fleet must actuate toward
+            model = (phase // 2) % cfg.num_models
+        else:
+            model = _pick(rng, base_w)
+        plen = rng.randint(cfg.prompt_len_min, cfg.prompt_len_max)
+        prompt = tuple(rng.randrange(1, cfg.vocab) for _ in range(plen))
+        out.append(
+            Arrival(
+                t_s=round(t, 6),
+                model=model,
+                prompt=prompt,
+                max_tokens=rng.randint(
+                    cfg.max_tokens_min, cfg.max_tokens_max
+                ),
+            )
+        )
+    return out
+
+
+def trace_digest(arrivals: Sequence[Arrival]) -> str:
+    """sha256 fingerprint of a trace: what two same-seed runs must agree
+    on byte-for-byte (CI's determinism gate and the bench result's
+    ``arrival_trace_sha256``)."""
+    h = hashlib.sha256()
+    for a in arrivals:
+        h.update(json.dumps(asdict(a), sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) — no numpy dependency, and
+    nearest-rank keeps p50 <= p95 <= p99 trivially monotonic."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    rank = min(len(xs), max(1, math.ceil(q / 100.0 * len(xs))))
+    return xs[rank - 1]

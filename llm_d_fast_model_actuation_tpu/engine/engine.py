@@ -256,7 +256,14 @@ class Request:
     finish_reason: str = ""
     error: Optional[str] = None
     submit_time: float = field(default_factory=time.monotonic)
+    #: when the request first won a slot (_admit) — with submit_time this
+    #: separates queue wait from prefill inside TTFT
+    #: (fma_engine_queue_wait_seconds)
+    first_sched_time: Optional[float] = None
     first_token_time: Optional[float] = None
+    #: stamped by the serving loop when the finished request leaves the
+    #: engine (SLO TPOT judgment + the usage block's decode_tpot_s)
+    done_time: Optional[float] = None
     #: Streaming hook: called as on_token(req, token) for every emitted
     #: token, on the engine thread. Keep it cheap (enqueue, don't compute).
     #: Tokens that could be the start of a stop sequence are held back
@@ -1099,6 +1106,7 @@ class InferenceEngine:
         seed: Optional[int] = None,
         ignore_eos: bool = False,
         logit_bias: "Dict[int, float] | None" = None,
+        submit_time: Optional[float] = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -1153,6 +1161,11 @@ class InferenceEngine:
             ignore_eos=ignore_eos,
             logit_bias=logit_bias or {},
         )
+        if submit_time is not None:
+            # the HTTP layer's enqueue time, not this (possibly later)
+            # engine-thread admission: queue-wait and TTFT then cover the
+            # whole server-side wait, including the pre-engine pending list
+            req.submit_time = submit_time
         self._next_seq_id += 1
         self._waiting.append(req)
         return req.seq_id
@@ -1218,6 +1231,11 @@ class InferenceEngine:
             self.prefix_cache.acquire(own)
             self.prefix_cache.commit(hashes)
         req.slot = slot
+        if req.first_sched_time is None:
+            # every admission path (bucketed prefill, packed segments,
+            # echo fallback) funnels through here: the one stamp that
+            # closes the queue-wait window
+            req.first_sched_time = time.monotonic()
         self._slots[slot] = req
         self._init_slot_key(req)
         self._eos_on[slot] = 0 if req.ignore_eos else 1

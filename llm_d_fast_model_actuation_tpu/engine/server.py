@@ -88,8 +88,9 @@ ENGINE_GENERATED_TOKENS = Counter(
 )
 ENGINE_ABORTS = Counter(
     "fma_engine_aborted_requests_total",
-    "Requests aborted (client disconnect or engine state loss)",
-    ["model"],
+    "Requests aborted, by cause: client (disconnect), swap (actuation "
+    "preempted queued/in-flight work), state_loss (level-2 wake)",
+    ["model", "reason"],
 )
 ENGINE_KV_USAGE = Gauge(
     "fma_engine_kv_cache_usage_ratio",
@@ -109,6 +110,36 @@ ENGINE_SPEC_PROPOSED = Gauge(
 ENGINE_SPEC_ACCEPTED = Gauge(
     "fma_engine_spec_accepted_tokens",
     "Proposed tokens accepted by the verify forward",
+    ["model"],
+)
+
+# SLO / goodput telemetry (docs/perf.md "Fleet benchmarking and goodput"):
+# the request-lifecycle observables the multi-model scheduler (ROADMAP
+# item 1) optimizes and the fleet harness (`bench.py fleet`) reports.
+# Queue wait separates "sat behind other work / an actuation" from "the
+# prefill itself was slow" inside the existing TTFT histogram.
+ENGINE_QUEUE_WAIT = Histogram(
+    "fma_engine_queue_wait_seconds",
+    "Submit to first scheduled (queue time; prefill excluded)",
+    ["model"],
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30),
+)
+ENGINE_SLO_REQUESTS = Counter(
+    "fma_engine_slo_requests_total",
+    "Finished requests judged against a configured SLO target "
+    "(--slo-ttft-ms / --slo-tpot-ms; one observation per enabled slo)",
+    ["model", "slo", "outcome"],  # slo: ttft|tpot, outcome: met|violated
+)
+ENGINE_GOODPUT_TOKENS = Counter(
+    "fma_engine_goodput_tokens_total",
+    "Generated tokens from requests that met every configured SLO "
+    "(equals generation_tokens_total when no SLO target is set)",
+    ["model"],
+)
+ENGINE_ARRIVAL_RATE = Gauge(
+    "fma_engine_request_arrival_rate",
+    "EWMA of request arrivals (requests/s) for the resident model — the "
+    "demand signal a multi-model scheduler consumes",
     ["model"],
 )
 
@@ -445,6 +476,32 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "compiled programs (OpenAI logprobs/top_logprobs; 0 disables)",
     )
     p.add_argument(
+        "--slo-ttft-ms",
+        type=float,
+        default=0.0,
+        help="TTFT SLO target in milliseconds (submit -> first token). "
+        "Finished requests are judged against it "
+        "(fma_engine_slo_requests_total{slo=ttft}) and only SLO-met "
+        "requests count toward fma_engine_goodput_tokens_total "
+        "(docs/perf.md 'Fleet benchmarking and goodput'); 0 disables",
+    )
+    p.add_argument(
+        "--slo-tpot-ms",
+        type=float,
+        default=0.0,
+        help="time-per-output-token SLO target in milliseconds (mean "
+        "inter-token time after the first token); judged per finished "
+        "request like --slo-ttft-ms; 0 disables",
+    )
+    p.add_argument(
+        "--arrival-ewma-tau-s",
+        type=float,
+        default=30.0,
+        help="time constant (seconds) of the request arrival-rate EWMA "
+        "(fma_engine_request_arrival_rate): the demand signal's memory — "
+        "shorter reacts faster to bursts, longer smooths them",
+    )
+    p.add_argument(
         "--sleep-release-devices",
         default="auto",
         choices=["auto", "always", "never"],
@@ -657,6 +714,12 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
                 "lockstep control frame); sharded single-process meshes "
                 "via --tensor-parallel-size compose fine"
             )
+    if getattr(args, "slo_ttft_ms", 0.0) < 0:
+        raise ValueError("--slo-ttft-ms must be >= 0 (0 = off)")
+    if getattr(args, "slo_tpot_ms", 0.0) < 0:
+        raise ValueError("--slo-tpot-ms must be >= 0 (0 = off)")
+    if getattr(args, "arrival_ewma_tau_s", 30.0) <= 0:
+        raise ValueError("--arrival-ewma-tau-s must be > 0")
     if getattr(args, "model_pool_mib", 0) < 0:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
@@ -712,6 +775,41 @@ def parse_engine_options(options: str) -> argparse.Namespace:
 class ProfileConflict(Exception):
     """POST /v1/profile while a capture is running (jax.profiler is
     process-global: exactly one concurrent capture), or DELETE with none."""
+
+
+class _RateEWMA:
+    """Exponentially-decayed event rate (events/second).
+
+    Each arrival adds ``1/tau`` and the estimate decays by
+    ``exp(-dt/tau)`` between observations, so a Poisson stream of rate
+    lambda converges to lambda regardless of scrape cadence — and the
+    estimate keeps decaying toward zero after traffic stops (reading is
+    side-effect free on the event count). Not thread-safe; callers hold
+    the service's SLO lock."""
+
+    def __init__(self, tau_s: float = 30.0) -> None:
+        self.tau_s = max(1e-6, float(tau_s))
+        self._rate = 0.0
+        self._t: Optional[float] = None
+
+    def _decay(self, now: float) -> None:
+        if self._t is None:
+            self._t = now
+            return
+        dt = now - self._t
+        if dt > 0:
+            import math
+
+            self._rate *= math.exp(-dt / self.tau_s)
+            self._t = now
+
+    def observe(self, now: float) -> None:
+        self._decay(now)
+        self._rate += 1.0 / self.tau_s
+
+    def rate(self, now: float) -> float:
+        self._decay(now)
+        return self._rate
 
 
 def _pool_key(model: str, checkpoint_dir: str) -> str:
@@ -791,6 +889,30 @@ class EngineService:
         self._pad_waste_seen: Dict[str, int] = {}
         self._step_h2d_seen: Dict[str, int] = {}
         self.started_at = time.monotonic()
+        # Request-lifecycle SLO/goodput accounting (docs/perf.md "Fleet
+        # benchmarking and goodput"): targets in seconds (0 = off), plain
+        # counters mirrored into Prometheus and served whole by GET
+        # /v1/stats — the one-call instance row the launcher's fleet
+        # rollup aggregates. Guarded by _slo_mu: submit() runs on the
+        # event loop, _observe_finished on the engine thread, stats() on
+        # executor threads.
+        self._slo_ttft_s = max(0.0, getattr(args, "slo_ttft_ms", 0.0)) / 1e3
+        self._slo_tpot_s = max(0.0, getattr(args, "slo_tpot_ms", 0.0)) / 1e3
+        self._slo_mu = threading.Lock()
+        self._slo_met = 0
+        self._slo_violated = 0
+        self._goodput_tokens = 0
+        self._generated_tokens = 0
+        self._finished_requests = 0
+        #: per-cause abort counts (client | swap | state_loss), the
+        #: /v1/stats mirror of fma_engine_aborted_requests_total
+        self._aborted: Dict[str, int] = {}
+        #: actuation edges this process performed (swap | sleep | wake):
+        #: with uptime, the fleet rollup's actuations/hour
+        self._actuations: Dict[str, int] = {}
+        self._arrival = _RateEWMA(
+            getattr(args, "arrival_ewma_tau_s", 30.0) or 30.0
+        )
         # Fault-injection arming (utils/faults.py): env first, then the
         # flag — both before the first build so coldload points can fire
         # on the initial model too.
@@ -995,12 +1117,29 @@ class EngineService:
         )
         self._thread.start()
 
-    def _abort_engine_work(self, reason: str, exc: Exception) -> int:
+    def _count_abort(self, cause: str, n: int = 1) -> None:
+        """One abort-accounting choke point: the Prometheus counter's
+        ``reason`` label and the /v1/stats mirror move together, so the
+        fleet harness can attribute SLO violations to actuation
+        preemption (swap/state_loss) vs client behavior."""
+        if n <= 0:
+            return
+        ENGINE_ABORTS.labels(model=self.args.model, reason=cause).inc(n)
+        with self._slo_mu:
+            self._aborted[cause] = self._aborted.get(cause, 0) + n
+
+    def _bump_actuation(self, kind: str) -> None:
+        with self._slo_mu:
+            self._actuations[kind] = self._actuations.get(kind, 0) + 1
+
+    def _abort_engine_work(
+        self, reason: str, exc: Exception, cause: str = "state_loss"
+    ) -> int:
         """Abort everything waiting or in flight in the engine and fail the
         matching futures (state-loss edges: level-2 wake, model swap).
         Caller holds the step lock."""
         aborted = self.engine.abort_all(reason)
-        ENGINE_ABORTS.labels(model=self.args.model).inc(len(aborted))
+        self._count_abort(cause, len(aborted))
         for req in aborted:
             fut = self._futures.pop(req.seq_id, None)
             if fut is not None:
@@ -1552,6 +1691,30 @@ class EngineService:
     def _current_runtime(self) -> _ModelRuntime:
         return self._runtime
 
+    def _retire_model_series(self, previous: str) -> None:
+        """Drop the outgoing model's per-model GAUGE label series on swap.
+        These gauges are only ever written for the resident model, so
+        after a swap the old series would report its last pre-swap value
+        forever (a swapped-out model showing phantom queue depth /
+        occupancy to the HPA and the fleet rollup). Histograms and
+        counters are cumulative and stay. The arrival EWMA restarts too:
+        its observations belonged to the outgoing model."""
+        for g in (
+            ENGINE_QUEUE_DEPTH,
+            ENGINE_SLOT_OCCUPANCY,
+            ENGINE_KV_USAGE,
+            ENGINE_PREFIX_HIT_TOKENS,
+            ENGINE_SPEC_PROPOSED,
+            ENGINE_SPEC_ACCEPTED,
+            ENGINE_ARRIVAL_RATE,
+        ):
+            try:
+                g.remove(previous)
+            except KeyError:
+                pass
+        with self._slo_mu:
+            self._arrival = _RateEWMA(self._arrival.tau_s)
+
     def swap(
         self, model: str, checkpoint_dir: str = "", request_id: str = ""
     ) -> Dict[str, Any]:
@@ -1645,9 +1808,13 @@ class EngineService:
                 fut = self._pending.pop(0)[3]
                 if not fut.done():
                     fut.set_exception(exc)
+                    # still-queued requests the swap kills count too — an
+                    # entry here never reached the engine, so abort_all
+                    # below can't see it
+                    self._count_abort("swap")
             if self.engine.has_work():
                 self._abort_engine_work(
-                    f"model swapped out for {model}", exc
+                    f"model swapped out for {model}", exc, cause="swap"
                 )
             outgoing = self._current_runtime()
             # the pool key carries the checkpoint identity: the same model
@@ -1933,12 +2100,19 @@ class EngineService:
             )
             self._free_pooled(evicted, "evicted over pool budget")
             self._install_runtime(rt)
+            if model != previous:
+                # same-name variant swaps (sibling checkpoints) keep the
+                # label series AND the arrival EWMA: the name — which is
+                # what every per-model series is keyed by — didn't change,
+                # so nothing went stale and demand history is still true
+                self._retire_model_series(previous)
             total = time.monotonic() - t0
             metrics["swap_total_s"] = total
             ENGINE_SWAP_SECONDS.labels(model=model).observe(total)
             ENGINE_SWAPS.labels(
                 model=model, source="pool" if pool_hit else "cold"
             ).inc()
+            self._bump_actuation("swap")
             if pool_hit:
                 ENGINE_POOL_HITS.inc()
             ENGINE_SWAP_OVERLAP_FRAC.labels(model=model).set(
@@ -2404,7 +2578,7 @@ class EngineService:
             seq_id = self._fut_seq.pop(id(fut), None)
             if seq_id is not None:
                 if self.engine.abort(seq_id, reason="client disconnected"):
-                    ENGINE_ABORTS.labels(model=self.args.model).inc()
+                    self._count_abort("client")
                 self._futures.pop(seq_id, None)
             if not fut.done():
                 fut.cancel()
@@ -2421,7 +2595,7 @@ class EngineService:
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
                                 want_alts, want_plp, seed, ignore_eos,
-                                logit_bias,
+                                logit_bias, submit_t,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -2435,6 +2609,7 @@ class EngineService:
                                     seed=seed,
                                     ignore_eos=ignore_eos,
                                     logit_bias=logit_bias,
+                                    submit_time=submit_t,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
@@ -2442,6 +2617,7 @@ class EngineService:
                                 fut.set_exception(e)
                         if self.engine.has_work():
                             for req in self.engine.step():
+                                req.done_time = time.monotonic()
                                 fut = self._futures.pop(req.seq_id, None)
                                 if fut is not None:
                                     self._fut_seq.pop(id(fut), None)
@@ -2470,13 +2646,64 @@ class EngineService:
     def _observe_finished(self, req) -> None:
         m = self.args.model
         now = time.monotonic()
+        if req.done_time is not None:
+            # step() stamps this before resolving the future; direct
+            # engine users (tests) may not have a serving loop
+            now = req.done_time
+        ttft = None
         if req.first_token_time is not None:
-            ENGINE_TTFT.labels(model=m).observe(
-                req.first_token_time - req.submit_time
+            ttft = req.first_token_time - req.submit_time
+            ENGINE_TTFT.labels(model=m).observe(ttft)
+        if req.first_sched_time is not None:
+            # the queue leg of TTFT: submit -> first slot (prefill and
+            # decode come after) — what an actuation-induced stall shows
+            # up in, separately from prefill speed
+            ENGINE_QUEUE_WAIT.labels(model=m).observe(
+                max(0.0, req.first_sched_time - req.submit_time)
             )
         ENGINE_E2E_LATENCY.labels(model=m).observe(now - req.submit_time)
         ENGINE_PROMPT_TOKENS.labels(model=m).inc(len(req.prompt))
-        ENGINE_GENERATED_TOKENS.labels(model=m).inc(len(req.out_tokens))
+        gen = len(req.out_tokens)
+        ENGINE_GENERATED_TOKENS.labels(model=m).inc(gen)
+
+        # SLO judgment (docs/perf.md "Fleet benchmarking and goodput"):
+        # each enabled target is judged independently; goodput counts a
+        # request's tokens only when NO enabled target was violated
+        # (vacuously all of them, when none is configured).
+        met_all = True
+        evaluated = False
+        if self._slo_ttft_s > 0:
+            ok = ttft is not None and ttft <= self._slo_ttft_s
+            ENGINE_SLO_REQUESTS.labels(
+                model=m, slo="ttft", outcome="met" if ok else "violated"
+            ).inc()
+            met_all = met_all and ok
+            evaluated = True
+        if self._slo_tpot_s > 0:
+            if req.first_token_time is not None and gen > 1:
+                tpot = (now - req.first_token_time) / (gen - 1)
+                ok = tpot <= self._slo_tpot_s
+            else:
+                # a single-token (or token-less error) request has no
+                # inter-token interval to judge
+                ok = req.first_token_time is not None
+            ENGINE_SLO_REQUESTS.labels(
+                model=m, slo="tpot", outcome="met" if ok else "violated"
+            ).inc()
+            met_all = met_all and ok
+            evaluated = True
+        if met_all:
+            ENGINE_GOODPUT_TOKENS.labels(model=m).inc(gen)
+        with self._slo_mu:
+            self._finished_requests += 1
+            self._generated_tokens += gen
+            if met_all:
+                self._goodput_tokens += gen
+            if evaluated:
+                if met_all:
+                    self._slo_met += 1
+                else:
+                    self._slo_violated += 1
 
     def _observe_kv_usage(self) -> None:
         alloc = self.engine.allocator
@@ -2558,6 +2785,39 @@ class EngineService:
         running = sum(1 for s in eng._slots if s is not None)
         return len(self._pending) + len(eng._waiting) + running
 
+    def stats(self) -> Dict[str, Any]:
+        """One-call instance stats row (GET /v1/stats): queue depth,
+        arrival-rate EWMA, SLO attainment, goodput, per-cause aborts and
+        actuation counts — exactly what the launcher's fleet rollup
+        aggregates across instances without parsing Prometheus text.
+        Cheap and lock-bounded: safe while sleeping or under load."""
+        now = time.monotonic()
+        with self._slo_mu:
+            met, violated = self._slo_met, self._slo_violated
+            judged = met + violated
+            out = {
+                "model": self.args.model,
+                "queue_depth": self.queue_depth(),
+                "arrival_rate_rps": round(self._arrival.rate(now), 6),
+                "slo": {
+                    "ttft_ms": self._slo_ttft_s * 1e3,
+                    "tpot_ms": self._slo_tpot_s * 1e3,
+                    "met": met,
+                    "violated": violated,
+                    "attainment": (
+                        round(met / judged, 6) if judged else None
+                    ),
+                },
+                "finished_requests": self._finished_requests,
+                "generated_tokens": self._generated_tokens,
+                "goodput_tokens": self._goodput_tokens,
+                "aborted": dict(self._aborted),
+                "actuations": dict(self._actuations),
+                "uptime_s": round(now - self.started_at, 3),
+                "is_sleeping": self.sleeper.is_sleeping,
+            }
+        return out
+
     def submit(
         self,
         prompt: List[int],
@@ -2589,10 +2849,15 @@ class EngineService:
         if self.failure is not None:
             fut.set_exception(RuntimeError(self.failure))
             return fut
+        now = time.monotonic()
+        with self._slo_mu:
+            # demand signal, stamped at the HTTP edge: the EWMA must see
+            # offered load even when the engine is saturated or asleep
+            self._arrival.observe(now)
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
              presence_penalty, frequency_penalty, want_top_logprobs,
-             want_prompt_logprobs, seed, ignore_eos, logit_bias)
+             want_prompt_logprobs, seed, ignore_eos, logit_bias, now)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -2625,6 +2890,8 @@ class EngineService:
             # follower loop, deadlocking the gang's next collective)
             raise ValueError("sleep level must be 1 or 2")
         with self._admin_lock():
+            was_sleeping = self.sleeper.is_sleeping
+            prev_level = self.sleeper.level
             if self.engine.lockstep is not None:
                 if level >= 2:
                     raise ValueError(
@@ -2659,11 +2926,20 @@ class EngineService:
                 self.engine.clear_executables()
                 self._last_warmup = None
             out = self.sleeper.sleep(level, release=self.release_on_sleep)
-        if out.get("bytes_offloaded"):
-            # per-mode wire bytes: payload bytes under --sleep-quant
+        if out.get("bytes_offloaded") and not was_sleeping:
+            # per-mode wire bytes: payload bytes under --sleep-quant.
+            # Guarded like the actuation count below — a re-sent sleep's
+            # answer still describes the ORIGINAL offload's bytes, and
+            # charging them again would double wire-byte telemetry.
             ENGINE_ACTUATION_BYTES.labels(
                 mode=out.get("quant", "off") or "off", dir="d2h"
             ).inc(out["bytes_offloaded"])
+        if not was_sleeping or self.sleeper.level != prev_level:
+            # count state CHANGES only: a fresh sleep or an L1->L2
+            # escalation (real state movement — the host copy drops), but
+            # never an idempotent re-sent sleep, which moved nothing and
+            # must not inflate the fleet rollup's actuations/hour
+            self._bump_actuation("sleep")
         self._publish_usage()
         return out
 
@@ -2678,6 +2954,7 @@ class EngineService:
                 "reason": "gang follower; wake is driven by the leader",
             }
         with self._admin_lock():
+            was_sleeping = self.sleeper.is_sleeping
             was_l1 = (
                 self.sleeper.level == 1
                 and not getattr(self.sleeper, "_staged", None)
@@ -2765,6 +3042,10 @@ class EngineService:
                 ENGINE_ACTUATION_BYTES.labels(
                     mode=self.sleeper.stats.last_quant or "off", dir="h2d"
                 ).inc(self.sleeper.stats.last_wake_bytes)
+        if was_sleeping:
+            # a wake on an already-awake engine is a no-op, not an
+            # actuation the fleet rollup should charge for
+            self._bump_actuation("wake")
         self._publish_usage()
         self._new_work.set()
         return out
@@ -2815,6 +3096,26 @@ def _validate_messages(messages: Any) -> List[Dict[str, Any]]:
             # they would also crash HF chat templates with a 500
             raise ValueError("message content must be a string")
     return messages
+
+
+def _lifecycle_usage(req: Any) -> Dict[str, Any]:
+    """Per-request lifecycle extras for the OpenAI usage block — the
+    engine-side measurements an open-loop load harness needs without
+    streaming (`bench.py fleet` reads these): queue wait (submit ->
+    first scheduled, the leg an actuation stall lands in) and decode
+    TPOT (mean inter-token seconds after the first token)."""
+    qw = None
+    if req.first_sched_time is not None:
+        qw = max(0.0, req.first_sched_time - req.submit_time)
+    tpot = None
+    n = len(req.out_tokens)
+    if (
+        req.first_token_time is not None
+        and req.done_time is not None
+        and n > 1
+    ):
+        tpot = max(0.0, (req.done_time - req.first_token_time) / (n - 1))
+    return {"queue_wait_s": qw, "decode_tpot_s": tpot}
 
 
 def _finish_reason(service: "EngineService", req: Any) -> str:
@@ -2984,12 +3285,23 @@ def build_app(service: EngineService) -> web.Application:
             {"object": "list", "data": [{"id": service.args.model, "object": "model"}]}
         )
 
+    async def engine_stats(request: web.Request) -> web.Response:
+        """JSON lifecycle stats (GET /v1/stats): the launcher's fleet
+        rollup polls this instead of scraping+parsing /metrics."""
+        return web.json_response(service.stats())
+
     async def metrics(request: web.Request) -> web.Response:
         from prometheus_client import generate_latest
 
         ENGINE_QUEUE_DEPTH.labels(model=service.args.model).set(
             service.queue_depth()
         )
+        with service._slo_mu:
+            # decayed to scrape time: after traffic stops the demand
+            # signal visibly ramps down instead of freezing
+            ENGINE_ARRIVAL_RATE.labels(model=service.args.model).set(
+                service._arrival.rate(time.monotonic())
+            )
         if service.engine.prefix_cache is not None:
             ENGINE_PREFIX_HIT_TOKENS.labels(model=service.args.model).set(
                 service.engine.prefix_cache.hit_tokens
@@ -3503,6 +3815,7 @@ def build_app(service: EngineService) -> web.Application:
                     "prompt_tokens": len(tokens),
                     "completion_tokens": total_completion,
                     "time_to_first_token_s": ttft,
+                    **_lifecycle_usage(req),
                 },
             }
         )
@@ -3608,6 +3921,7 @@ def build_app(service: EngineService) -> web.Application:
                 "usage": {
                     "prompt_tokens": len(tokens),
                     "completion_tokens": total_completion,
+                    **_lifecycle_usage(reqs[0]),
                 },
             }
         )
@@ -3698,6 +4012,7 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_delete("/v1/profile", profile_stop)
     app.router.add_get("/v1/profile", profile_status)
     app.router.add_get("/v1/models", models)
+    app.router.add_get("/v1/stats", engine_stats)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)

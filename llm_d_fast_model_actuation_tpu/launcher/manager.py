@@ -31,7 +31,7 @@ import uuid as uuidlib
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from prometheus_client import Histogram
+from prometheus_client import Gauge, Histogram
 
 from ..utils import faults, tracing
 from ..utils.events import EventBroadcaster
@@ -51,6 +51,39 @@ LAUNCHER_RPC_SECONDS = Histogram(
     "Latency of launcher -> engine-child admin RPCs",
     ["verb", "outcome"],
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2, 5, 15, 60, 300),
+)
+
+# Fleet rollup (docs/launcher.md "The fleet block"): node-level SLO /
+# goodput / demand aggregates over every live engine child's GET
+# /v1/stats, refreshed by fleet_rollup() on instance-list and /metrics
+# reads — the one-scrape fleet view the multi-model scheduler (ROADMAP
+# item 1) and the fleet bench consume.
+LAUNCHER_FLEET_INSTANCES = Gauge(
+    "fma_launcher_fleet_instances",
+    "Engine instances by stats-poll outcome",
+    ["state"],  # reporting | unreachable
+)
+LAUNCHER_FLEET_QUEUE_DEPTH = Gauge(
+    "fma_launcher_fleet_queue_depth",
+    "Waiting + in-flight requests summed over reporting instances",
+)
+LAUNCHER_FLEET_ARRIVAL_RATE = Gauge(
+    "fma_launcher_fleet_arrival_rate",
+    "Summed per-instance request arrival-rate EWMAs (requests/s)",
+)
+LAUNCHER_FLEET_SLO_ATTAINMENT = Gauge(
+    "fma_launcher_fleet_slo_attainment",
+    "Fraction of SLO-judged requests that met every configured target "
+    "across the fleet (1.0 when nothing has been judged yet)",
+)
+LAUNCHER_FLEET_GOODPUT_TOKENS = Gauge(
+    "fma_launcher_fleet_goodput_tokens",
+    "Cumulative generated tokens from SLO-met requests, fleet-wide",
+)
+LAUNCHER_FLEET_ACTUATIONS_PER_HOUR = Gauge(
+    "fma_launcher_fleet_actuations_per_hour",
+    "Summed per-instance actuation rates (swap+sleep+wake per uptime "
+    "hour)",
 )
 
 STATUS_STOPPED = "stopped"
@@ -96,6 +129,19 @@ class PrefetchFailed(Exception):
     def __init__(self, instance_id: str, status: int, detail: str) -> None:
         super().__init__(
             f"prefetch on instance {instance_id} failed ({status}): {detail}"
+        )
+        self.instance_id = instance_id
+        self.status = status
+        self.detail = detail
+
+
+class StatsFailed(Exception):
+    """The engine child never answered a stats poll (fleet rollup marks
+    the instance unreachable instead of failing the whole read)."""
+
+    def __init__(self, instance_id: str, status: int, detail: str) -> None:
+        super().__init__(
+            f"stats on instance {instance_id} failed ({status}): {detail}"
         )
         self.instance_id = instance_id
         self.status = status
@@ -337,6 +383,11 @@ class EngineProcessManager:
         # spawn-failure path re-enters via _restart_allowed/_schedule
         self._restart_lock = threading.RLock()
         self._loop = None  # captured from the sentinel callback's loop
+        # fleet_rollup cache: instance-list reads and /metrics scrapes
+        # both refresh the rollup; a short TTL keeps back-to-back reads
+        # from double-polling every child
+        self._fleet_lock = threading.Lock()
+        self._fleet_cache: Optional[tuple] = None  # (monotonic_t, block)
 
     # -- revisions -----------------------------------------------------------
 
@@ -931,6 +982,119 @@ class EngineProcessManager:
             self.ledger.set_prefetched(instance_id, None)
         return {"instance_id": instance_id, "prefetch": body}
 
+    def _poll_instance_stats(
+        self, instance_id: str, timeout: float
+    ) -> Dict[str, Any]:
+        return self._engine_request(
+            instance_id, "GET", "/v1/stats", None, timeout, StatsFailed,
+            retries=0,
+        )
+
+    def fleet_rollup(
+        self, timeout: float = 1.5, ttl_s: float = 1.0
+    ) -> Dict[str, Any]:
+        """Aggregate every live engine child's GET /v1/stats into the
+        node-level SLO/goodput view (the ``fleet`` block of GET
+        /v2/vllm/instances) and mirror the aggregates onto the
+        fma_launcher_fleet_* gauges. Children are polled concurrently
+        with a short per-poll timeout and no retries: an unreachable or
+        free-form-options instance degrades to an ``unreachable`` row,
+        never an error for the whole read."""
+        now = time.monotonic()
+        with self._fleet_lock:
+            cached = self._fleet_cache
+            if cached is not None and now - cached[0] < ttl_s:
+                return cached[1]
+            ids = list(self.instances)
+        # Poll OUTSIDE the lock: a degraded fleet (several unreachable
+        # children timing out) must slow only this refresher, not every
+        # concurrent /metrics scrape queued behind the lock. Two cold
+        # readers may both poll; the second write just wins the cache.
+        per_instance: Dict[str, Dict[str, Any]] = {}
+        if ids:
+            import concurrent.futures as _cf
+
+            with _cf.ThreadPoolExecutor(
+                max_workers=min(8, len(ids))
+            ) as pool:
+                futs = {
+                    iid: pool.submit(
+                        self._poll_instance_stats, iid, timeout
+                    )
+                    for iid in ids
+                }
+            for iid, fut in futs.items():
+                try:
+                    stats = fut.result()
+                except (StatsFailed, KeyError) as e:
+                    per_instance[iid] = {
+                        "reporting": False,
+                        "error": str(e)[:200],
+                    }
+                    continue
+                per_instance[iid] = {"reporting": True, **stats}
+        met = violated = 0
+        queue_depth = 0
+        arrival = 0.0
+        goodput = generated = finished = 0
+        actuations = 0
+        actuations_per_hour = 0.0
+        aborted: Dict[str, int] = {}
+        reporting = 0
+        for row in per_instance.values():
+            if not row.get("reporting"):
+                continue
+            reporting += 1
+            slo = row.get("slo") or {}
+            met += int(slo.get("met", 0))
+            violated += int(slo.get("violated", 0))
+            queue_depth += int(row.get("queue_depth", 0))
+            arrival += float(row.get("arrival_rate_rps", 0.0))
+            goodput += int(row.get("goodput_tokens", 0))
+            generated += int(row.get("generated_tokens", 0))
+            finished += int(row.get("finished_requests", 0))
+            acts = sum(
+                int(v) for v in (row.get("actuations") or {}).values()
+            )
+            actuations += acts
+            uptime = float(row.get("uptime_s", 0.0))
+            if uptime > 0:
+                actuations_per_hour += acts * 3600.0 / uptime
+            for cause, n in (row.get("aborted") or {}).items():
+                aborted[cause] = aborted.get(cause, 0) + int(n)
+        judged = met + violated
+        attainment = round(met / judged, 6) if judged else None
+        fleet = {
+            "instances_total": len(ids),
+            "instances_reporting": reporting,
+            "queue_depth": queue_depth,
+            "arrival_rate_rps": round(arrival, 6),
+            "slo_requests_met": met,
+            "slo_requests_violated": violated,
+            "slo_attainment": attainment,
+            "finished_requests": finished,
+            "generated_tokens": generated,
+            "goodput_tokens": goodput,
+            "actuations": actuations,
+            "actuations_per_hour": round(actuations_per_hour, 3),
+            "aborted": aborted,
+            "per_instance": per_instance,
+        }
+        LAUNCHER_FLEET_INSTANCES.labels(state="reporting").set(reporting)
+        LAUNCHER_FLEET_INSTANCES.labels(state="unreachable").set(
+            len(ids) - reporting
+        )
+        LAUNCHER_FLEET_QUEUE_DEPTH.set(queue_depth)
+        LAUNCHER_FLEET_ARRIVAL_RATE.set(arrival)
+        LAUNCHER_FLEET_SLO_ATTAINMENT.set(
+            attainment if attainment is not None else 1.0
+        )
+        LAUNCHER_FLEET_GOODPUT_TOKENS.set(goodput)
+        LAUNCHER_FLEET_ACTUATIONS_PER_HOUR.set(actuations_per_hour)
+        with self._fleet_lock:
+            self._fleet_cache = (time.monotonic(), fleet)
+        return fleet
+
     def stop_all_instances(self, timeout: float = 10) -> Dict[str, Any]:
         stopped = []
         for iid in list(self.instances):
@@ -943,7 +1107,9 @@ class EngineProcessManager:
             raise KeyError(instance_id)
         return self.instances[instance_id].get_status()
 
-    def get_all_instances_status(self) -> Dict[str, Any]:
+    def get_all_instances_status(
+        self, include_fleet: bool = False
+    ) -> Dict[str, Any]:
         statuses = []
         running = 0
         for instance in self.instances.values():
@@ -951,7 +1117,7 @@ class EngineProcessManager:
             statuses.append(st)
             if st["status"] == STATUS_RUNNING:
                 running += 1
-        return {
+        out: Dict[str, Any] = {
             "total_instances": len(statuses),
             "running_instances": running,
             "instances": statuses,
@@ -968,6 +1134,16 @@ class EngineProcessManager:
                 "quant": self.ledger.quants(),
             },
         }
+        if include_fleet:
+            # blocking child polls: only REST's executor-threaded GET
+            # /v2/vllm/instances asks for it — in-process callers on the
+            # event loop (the notifier's lister) must not
+            try:
+                out["fleet"] = self.fleet_rollup()
+            except Exception as e:  # noqa: BLE001 — rollup never fails the read
+                logger.warning("fleet rollup failed: %s", e)
+                out["fleet"] = {"error": str(e)[:200]}
+        return out
 
     def list_instances(self) -> List[str]:
         return list(self.instances.keys())

@@ -63,9 +63,17 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     async def metrics(request: web.Request) -> web.Response:
         """Launcher-process prometheus exposition: the launcher RPC
         latency family (fma_launcher_rpc_seconds) lives in THIS process —
-        without this route it would be registered but unscrapeable."""
+        without this route it would be registered but unscrapeable. The
+        fleet rollup refreshes first (executor: it polls engine children
+        over HTTP) so one scrape carries current fma_launcher_fleet_*
+        aggregates."""
         from prometheus_client import generate_latest
 
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, manager.fleet_rollup)
+        except Exception:  # noqa: BLE001 — stale gauges beat a failed scrape
+            logger.warning("fleet rollup during scrape failed", exc_info=True)
         return web.Response(
             body=generate_latest(), content_type="text/plain"
         )
@@ -165,7 +173,16 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     async def get_all(request: web.Request) -> web.Response:
         detail = request.query.get("detail", "true").lower() != "false"
         if detail:
-            return web.json_response(manager.get_all_instances_status())
+            # executor: the fleet block polls engine children over HTTP
+            # (short per-child timeout); the loop must stay free
+            return web.json_response(
+                await asyncio.get_running_loop().run_in_executor(
+                    None,
+                    lambda: manager.get_all_instances_status(
+                        include_fleet=True
+                    ),
+                )
+            )
         ids = manager.list_instances()
         return web.json_response(
             {"revision": manager.revision, "instance_ids": ids, "count": len(ids)}

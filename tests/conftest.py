@@ -52,6 +52,27 @@ def devices8():
     return devs[:8]
 
 
+def wait_http(url: str, timeout: float = 180.0) -> None:
+    """Poll `url` until it answers 200 (shared helper for subprocess
+    e2e suites driving launcher/engine children over HTTP)."""
+    import time
+
+    import requests
+
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            r = requests.get(url, timeout=2)
+            if r.status_code == 200:
+                return
+            last = r.status_code
+        except requests.RequestException as e:
+            last = e
+        time.sleep(0.2)
+    raise TimeoutError(f"{url} never became healthy: {last}")
+
+
 def free_port() -> int:
     """An OS-assigned free TCP port (shared helper for subprocess e2e)."""
     import socket

@@ -1,10 +1,14 @@
 """Observability: every registered fma_* family is exercised by real code
-paths, and the metrics+debug server serves the reference's prom-and-debug
+paths, the metrics+debug server serves the reference's prom-and-debug
 surface (pkg/observability/prom-and-debug.go:34-79; dashboards ported from
-docs/metrics.md must not flatline).
+docs/metrics.md must not flatline), and the request-lifecycle SLO/goodput
+telemetry (queue wait, SLO split, goodput, arrival EWMA, abort
+attribution, fleet rollup) reports what actually happened.
 """
 
+import asyncio
 import json
+import time
 import urllib.request
 
 import pytest
@@ -138,3 +142,488 @@ def test_debug_server_endpoints():
             urllib.request.urlopen(base + "/nope", timeout=5)
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Request-lifecycle SLO/goodput telemetry (engine/server.py; docs/perf.md
+# "Fleet benchmarking and goodput"): what `bench.py fleet` and the
+# launcher's fleet rollup consume. Exposition-level asserts: the numbers
+# must land in the actual Prometheus samples, not just internal state.
+# ---------------------------------------------------------------------------
+
+
+def _sample(name, **labels):
+    return REGISTRY.get_sample_value(name, labels) or 0.0
+
+
+def _run_async(coro):
+    return asyncio.run(coro)
+
+
+async def _engine_client(service, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_d_fast_model_actuation_tpu.engine.server import build_app
+
+    client = TestClient(TestServer(build_app(service)))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+@pytest.fixture(scope="module")
+def lifecycle_service():
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64 --slo-ttft-ms 60000 --slo-tpot-ms 60000 "
+            "--arrival-ewma-tau-s 5"
+        )
+    )
+    yield svc
+    svc.shutdown()
+
+
+def _gen(svc, n=3, prompt=(1, 2, 3)):
+    return svc.submit(list(prompt), n, 0.0).result(timeout=120)
+
+
+@pytest.mark.fleet
+def test_queue_wait_observed_once_per_request(lifecycle_service):
+    svc = lifecycle_service
+    before = _sample("fma_engine_queue_wait_seconds_count", model="tiny")
+    reqs = [_gen(svc) for _ in range(3)]
+    after = _sample("fma_engine_queue_wait_seconds_count", model="tiny")
+    assert after == before + 3
+    for r in reqs:
+        # the lifecycle stamps are ordered: submit <= first_sched <=
+        # first_token <= done
+        assert r.first_sched_time is not None
+        assert r.first_sched_time >= r.submit_time
+        assert r.first_token_time >= r.first_sched_time
+        assert r.done_time >= r.first_token_time
+
+
+@pytest.mark.fleet
+def test_slo_split_met_violated_and_goodput(lifecycle_service):
+    svc = lifecycle_service
+
+    def counts():
+        return {
+            (slo, outcome): _sample(
+                "fma_engine_slo_requests_total",
+                model="tiny", slo=slo, outcome=outcome,
+            )
+            for slo in ("ttft", "tpot")
+            for outcome in ("met", "violated")
+        }
+
+    # generous targets (the fixture's 60 s): everything meets, goodput
+    # counts the generated tokens
+    before, gp0 = counts(), _sample(
+        "fma_engine_goodput_tokens_total", model="tiny"
+    )
+    r = _gen(svc, n=4)
+    after, gp1 = counts(), _sample(
+        "fma_engine_goodput_tokens_total", model="tiny"
+    )
+    assert after[("ttft", "met")] == before[("ttft", "met")] + 1
+    assert after[("tpot", "met")] == before[("tpot", "met")] + 1
+    assert gp1 == gp0 + len(r.out_tokens)
+
+    # forced-slow TTFT threshold: the same request shape now violates,
+    # and its tokens are EXCLUDED from goodput while
+    # generation_tokens_total still counts them
+    svc._slo_ttft_s = 1e-9
+    try:
+        gen0 = _sample("fma_engine_generation_tokens_total", model="tiny")
+        r = _gen(svc, n=4)
+        after2, gp2 = counts(), _sample(
+            "fma_engine_goodput_tokens_total", model="tiny"
+        )
+        gen1 = _sample("fma_engine_generation_tokens_total", model="tiny")
+        assert (
+            after2[("ttft", "violated")] == after[("ttft", "violated")] + 1
+        )
+        assert gp2 == gp1  # violated request contributed nothing
+        assert gen1 == gen0 + len(r.out_tokens)
+        st = svc.stats()
+        assert st["slo"]["violated"] >= 1 and st["slo"]["met"] >= 1
+        assert st["goodput_tokens"] < st["generated_tokens"]
+        assert 0.0 <= st["slo"]["attainment"] <= 1.0
+    finally:
+        svc._slo_ttft_s = 60.0
+
+
+@pytest.mark.fleet
+def test_tpot_slo_judged_independently(lifecycle_service):
+    svc = lifecycle_service
+    svc._slo_tpot_s = 1e-9
+    try:
+        before = _sample(
+            "fma_engine_slo_requests_total",
+            model="tiny", slo="tpot", outcome="violated",
+        )
+        _gen(svc, n=4)  # >1 token: a real inter-token interval to judge
+        after = _sample(
+            "fma_engine_slo_requests_total",
+            model="tiny", slo="tpot", outcome="violated",
+        )
+        assert after == before + 1
+    finally:
+        svc._slo_tpot_s = 60.0
+
+
+@pytest.mark.fleet
+def test_arrival_rate_ewma_decays():
+    from llm_d_fast_model_actuation_tpu.engine.server import _RateEWMA
+
+    ew = _RateEWMA(tau_s=5.0)
+    t = 100.0
+    for _ in range(50):  # 10 req/s for 5 s
+        ew.observe(t)
+        t += 0.1
+    peak = ew.rate(t)
+    assert peak > 2.0  # converging toward 10/s
+    later = ew.rate(t + 5.0)
+    much_later = ew.rate(t + 30.0)
+    # reading is side-effect free on the event count: the estimate only
+    # decays once arrivals stop
+    assert later < peak
+    assert much_later < later
+    assert much_later < 0.05 * peak
+
+
+@pytest.mark.fleet
+def test_stats_endpoint_and_exposition(lifecycle_service):
+    svc = lifecycle_service
+    _gen(svc)
+
+    async def scenario(client):
+        r = await client.get("/v1/stats")
+        assert r.status == 200
+        st = await r.json()
+        r = await client.get("/metrics")
+        text = await r.text()
+        return st, text
+
+    st, text = _run_async(_engine_client(svc, scenario))
+    assert st["model"] == "tiny"
+    assert st["arrival_rate_rps"] > 0  # requests just arrived
+    assert st["finished_requests"] >= 1
+    assert st["uptime_s"] > 0
+    assert "fma_engine_queue_wait_seconds_bucket" in text
+    assert "fma_engine_slo_requests_total" in text
+    assert "fma_engine_goodput_tokens_total" in text
+    assert 'fma_engine_request_arrival_rate{model="tiny"}' in text
+
+    # actuation counts feed the fleet rollup's actuations/hour
+    acts0 = dict(st["actuations"])
+    svc.sleep(1)
+    svc.wake_up()
+    st2 = svc.stats()
+    assert st2["actuations"].get("sleep", 0) == acts0.get("sleep", 0) + 1
+    assert st2["actuations"].get("wake", 0) == acts0.get("wake", 0) + 1
+
+
+@pytest.mark.fleet
+def test_usage_block_carries_lifecycle_fields(lifecycle_service):
+    async def scenario(client):
+        r = await client.post(
+            "/v1/completions", json={"prompt": [1, 2, 3], "max_tokens": 4}
+        )
+        assert r.status == 200
+        return (await r.json())["usage"]
+
+    usage = _run_async(_engine_client(lifecycle_service, scenario))
+    assert usage["queue_wait_s"] is not None and usage["queue_wait_s"] >= 0
+    assert usage["decode_tpot_s"] is not None and usage["decode_tpot_s"] >= 0
+    assert usage["time_to_first_token_s"] >= usage["queue_wait_s"]
+
+
+@pytest.mark.fleet
+def test_swap_abort_attribution_and_stale_series():
+    """A swap's preempted work lands in
+    fma_engine_aborted_requests_total{reason="swap"}, a level-2 wake's in
+    reason="state_loss", a client disconnect in reason="client" — and the
+    outgoing model's per-model gauge series disappear at the swap instead
+    of reporting their last pre-swap value forever."""
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    svc = EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 32 --page-size 8 --max-batch 2 "
+            "--max-model-len 64"
+        )
+    )
+    try:
+        _gen(svc)  # compile the serving path
+
+        # make steps slow so submitted work is reliably still in flight
+        orig_step = svc.engine.step
+
+        def slow_step():
+            time.sleep(0.2)
+            return orig_step()
+
+        svc.engine.step = slow_step
+        # a scrape materializes the resident model's gauge series
+        _run_async(_engine_client(svc, lambda c: c.get("/metrics")))
+        assert (
+            REGISTRY.get_sample_value(
+                "fma_engine_queue_depth", {"model": "tiny"}
+            )
+            is not None
+        )
+
+        before = _sample(
+            "fma_engine_aborted_requests_total",
+            model="tiny", reason="swap",
+        )
+        futs = [svc.submit([5, 6], 40, 0.0) for _ in range(2)]
+        time.sleep(0.4)  # let them admit / start decoding
+        svc.swap("tiny-gemma")
+        after = _sample(
+            "fma_engine_aborted_requests_total",
+            model="tiny", reason="swap",
+        )
+        assert after >= before + 2
+        for f in futs:
+            with pytest.raises(Exception):
+                f.result(timeout=30)
+        assert svc.stats()["aborted"].get("swap", 0) >= 2
+
+        # stale-series fix: the outgoing model's gauge series are gone
+        for fam in (
+            "fma_engine_queue_depth",
+            "fma_engine_decode_slot_occupancy",
+            "fma_engine_kv_cache_usage_ratio",
+        ):
+            assert (
+                REGISTRY.get_sample_value(fam, {"model": "tiny"}) is None
+            ), fam
+
+        # state_loss attribution: level-2 sleep + wake with work in flight
+        orig_step2 = svc.engine.step
+
+        def slow_step2():
+            time.sleep(0.2)
+            return orig_step2()
+
+        svc.engine.step = slow_step2
+        fut = svc.submit([5, 6], 40, 0.0)
+        time.sleep(0.4)
+        svc.sleep(2)
+        svc.wake_up()
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        assert _sample(
+            "fma_engine_aborted_requests_total",
+            model="tiny-gemma", reason="state_loss",
+        ) >= 1
+
+        # client attribution: abort a pending request explicitly
+        fut = svc.submit([5, 6], 40, 0.0)
+        time.sleep(0.3)
+        svc.abort(fut)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if _sample(
+                "fma_engine_aborted_requests_total",
+                model="tiny-gemma", reason="client",
+            ) >= 1:
+                break
+            time.sleep(0.05)
+        assert _sample(
+            "fma_engine_aborted_requests_total",
+            model="tiny-gemma", reason="client",
+        ) >= 1
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Launcher fleet rollup (launcher/manager.py): aggregation + gauges,
+# with the engine polls faked — the live path is covered by the fleet
+# e2e (tests/test_fleet.py) and the CI `bench.py fleet` sanity step.
+# ---------------------------------------------------------------------------
+
+
+def _fake_engine_kickoff(config, log_path):
+    """Fake forked child body (test_launcher.py's strategy): no real
+    engine; the rollup's engine polls are monkeypatched instead."""
+    with open(log_path, "ab", buffering=0) as f:
+        f.write(b"fake engine\n")
+    time.sleep(300)
+
+
+@pytest.mark.fleet
+def test_fleet_rollup_aggregates_and_mirrors_gauges(
+    monkeypatch, tmp_path, request
+):
+    from llm_d_fast_model_actuation_tpu.launcher.chiptranslator import (
+        ChipTranslator,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.instance import (
+        InstanceConfig,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        StatsFailed,
+    )
+
+    manager = EngineProcessManager(
+        ChipTranslator.create(
+            mock_chips=True, mock_chip_count=4, mock_topology="2x2"
+        ),
+        log_dir=str(tmp_path),
+        kickoff=_fake_engine_kickoff,
+        enforce_chip_exclusivity=False,
+    )
+    request.addfinalizer(lambda: manager.stop_all_instances(timeout=2))
+    for iid in ("i-a", "i-b", "i-down"):
+        manager.create_instance(
+            InstanceConfig(options="--model tiny", chip_ids=None),
+            instance_id=iid,
+        )
+
+    canned = {
+        "i-a": {
+            "model": "tiny",
+            "queue_depth": 3,
+            "arrival_rate_rps": 1.5,
+            "slo": {"ttft_ms": 500, "tpot_ms": 0, "met": 8, "violated": 2},
+            "finished_requests": 10,
+            "generated_tokens": 100,
+            "goodput_tokens": 80,
+            "aborted": {"swap": 2},
+            "actuations": {"swap": 2, "sleep": 1},
+            "uptime_s": 3600.0,
+        },
+        "i-b": {
+            "model": "tiny-gemma",
+            "queue_depth": 1,
+            "arrival_rate_rps": 0.5,
+            "slo": {"ttft_ms": 500, "tpot_ms": 0, "met": 2, "violated": 3},
+            "finished_requests": 5,
+            "generated_tokens": 50,
+            "goodput_tokens": 20,
+            "aborted": {"client": 1, "swap": 1},
+            "actuations": {"wake": 3},
+            "uptime_s": 1800.0,
+        },
+    }
+
+    def fake_poll(iid, timeout):
+        if iid == "i-down":
+            raise StatsFailed(iid, 502, "engine unreachable")
+        return canned[iid]
+
+    monkeypatch.setattr(manager, "_poll_instance_stats", fake_poll)
+    out = manager.get_all_instances_status(include_fleet=True)
+    fleet = out["fleet"]
+    assert fleet["instances_total"] == 3
+    assert fleet["instances_reporting"] == 2
+    assert fleet["queue_depth"] == 4
+    assert fleet["arrival_rate_rps"] == pytest.approx(2.0)
+    assert fleet["slo_requests_met"] == 10
+    assert fleet["slo_requests_violated"] == 5
+    assert fleet["slo_attainment"] == pytest.approx(10 / 15)
+    assert fleet["goodput_tokens"] == 100
+    assert fleet["generated_tokens"] == 150
+    assert fleet["actuations"] == 6
+    # per-instance rates sum: 3/h (i-a) + 6/h (i-b)
+    assert fleet["actuations_per_hour"] == pytest.approx(9.0)
+    assert fleet["aborted"] == {"swap": 3, "client": 1}
+    assert fleet["per_instance"]["i-down"]["reporting"] is False
+
+    # mirrored onto the launcher's own exposition
+    assert _sample(
+        "fma_launcher_fleet_instances", state="reporting"
+    ) == 2
+    assert _sample(
+        "fma_launcher_fleet_instances", state="unreachable"
+    ) == 1
+    assert _sample("fma_launcher_fleet_queue_depth") == 4
+    assert _sample("fma_launcher_fleet_slo_attainment") == pytest.approx(
+        10 / 15
+    )
+    assert _sample("fma_launcher_fleet_goodput_tokens") == 100
+    assert _sample(
+        "fma_launcher_fleet_actuations_per_hour"
+    ) == pytest.approx(9.0)
+
+    # the TTL cache serves repeat reads without re-polling
+    monkeypatch.setattr(
+        manager, "_poll_instance_stats",
+        lambda *a: (_ for _ in ()).throw(AssertionError("re-polled")),
+    )
+    again = manager.fleet_rollup()
+    assert again["slo_attainment"] == fleet["slo_attainment"]
+
+    # default instance reads stay fleet-free (the notifier's lister runs
+    # on the event loop and must never block on child polls)
+    assert "fleet" not in manager.get_all_instances_status()
+
+
+# ---------------------------------------------------------------------------
+# Fleet arrival generator (benchmark/fleet.py): seeded determinism — the
+# contract the CI sanity step and cross-run comparisons rest on.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fleet
+def test_fleet_arrival_generator_seeded_determinism():
+    from llm_d_fast_model_actuation_tpu.benchmark import fleet
+
+    cfg = fleet.FleetTrafficConfig(seed=7, duration_s=20.0, num_models=4)
+    a = fleet.generate_arrivals(cfg)
+    b = fleet.generate_arrivals(cfg)
+    assert a == b  # identical trace, element for element
+    assert fleet.trace_digest(a) == fleet.trace_digest(b)
+    assert fleet.generate_arrivals(
+        fleet.FleetTrafficConfig(seed=8, duration_s=20.0, num_models=4)
+    ) != a
+
+    assert all(0 <= x.t_s < cfg.duration_s for x in a)
+    assert all(x.t_s <= y.t_s for x, y in zip(a, a[1:]))  # time-ordered
+    assert all(0 <= x.model < 4 for x in a)
+    assert all(
+        cfg.prompt_len_min <= len(x.prompt) <= cfg.prompt_len_max
+        for x in a
+    )
+    assert all(1 <= t < cfg.vocab for x in a for t in x.prompt)
+
+    # Zipf skew: the head model out-draws the tail model
+    from collections import Counter
+
+    by_model = Counter(x.model for x in a)
+    assert by_model[0] > by_model[3]
+
+
+@pytest.mark.fleet
+def test_fleet_traffic_config_validation():
+    from llm_d_fast_model_actuation_tpu.benchmark import fleet
+
+    with pytest.raises(ValueError):
+        fleet.generate_arrivals(fleet.FleetTrafficConfig(num_models=0))
+    with pytest.raises(ValueError):
+        fleet.generate_arrivals(fleet.FleetTrafficConfig(duration_s=0))
+    with pytest.raises(ValueError):
+        fleet.generate_arrivals(
+            fleet.FleetTrafficConfig(burst_hot_frac=1.5)
+        )
+    with pytest.raises(ValueError):
+        fleet.generate_arrivals(
+            fleet.FleetTrafficConfig(prompt_len_min=0)
+        )
