@@ -670,6 +670,11 @@ def _measure_decode_batched() -> None:
 
     on_tpu = jax.devices()[0].platform == "tpu"
     model = llama.LlamaConfig.tiny()
+    # mesh variant (--tensor-parallel-size N > 1): every engine below
+    # runs on a tp mesh — what the ragged CI gate uses to assert the
+    # mesh packed path keeps its O(rows) steady-state H2D ratio
+    bench_tp = _bench_tp()
+    bench_mesh, bench_mesh_shape = _bench_mesh(bench_tp)
     # mixed lengths just past powers of two — the shapes real traffic has
     # and the bucketed path pads worst (17 -> 32, 70 -> 128, ...)
     prompt_lens = (17, 33, 40, 70)
@@ -711,7 +716,7 @@ def _measure_decode_batched() -> None:
                 token_budget=token_budget if packed else 0,
                 **base,
             )
-            eng = InferenceEngine(cfg, seed=0)
+            eng = InferenceEngine(cfg, mesh=bench_mesh, seed=0)
             # warm every compiled shape outside the timed window (both
             # packed buffer shapes, the prefill buckets, chunk + drain)
             eng.generate(prompts_for(8), max_new_tokens=10)
@@ -811,6 +816,7 @@ def _measure_decode_batched() -> None:
                     packed_serving=packed,
                     token_budget=token_budget if packed else 0,
                 ),
+                mesh=bench_mesh,
                 seed=0,
             )
             eng.generate(waves[0], max_new_tokens=4)  # warm the shapes
@@ -847,6 +853,11 @@ def _measure_decode_batched() -> None:
         "vs_baseline": c4b["tok_s"],
         "extra": {
             "platform": jax.devices()[0].platform,
+            # mesh identity: [dp, pp, sp, tp, ep] axis sizes (None =
+            # single device) — the mesh packed path's ratios land in the
+            # bench trajectory next to the single-device ones
+            "tensor_parallel_size": bench_tp,
+            "mesh_shape": bench_mesh_shape,
             "model": "tiny",
             "token_budget": token_budget,
             "prompt_lens": list(prompt_lens),
@@ -1079,11 +1090,22 @@ def _measure_swap_recovery() -> None:
         ).astype(np.float32)
     )
     ckpt_mod.save_params(ck_var, vcfg, vparams_b)
+    # mesh variant (--tensor-parallel-size N > 1): the variant and quant
+    # probes below build their engines on a tp mesh — mesh-qualified
+    # digests, shard-local quantized transfers — so the same byte-ratio
+    # gates can be read for sharded engines (docs/perf.md "Sharded
+    # delta and quantized actuation")
+    bench_tp = _bench_tp()
+    tp_opt = (
+        f" --tensor-parallel-size {bench_tp}" if bench_tp > 1 else ""
+    )
+    _, bench_mesh_shape = _bench_mesh(bench_tp)
     # num-pages kept small so the KV pool (never content-matched — its
     # content is per-variant) doesn't drown the weight dedup signal
     vopts = (
         f"--model tiny --num-pages 8 --page-size 8 --max-batch 2 "
-        f"--max-model-len 64 --swap-bucket-mib 1 --checkpoint-dir {ck_base}"
+        f"--max-model-len 64 --swap-bucket-mib 1 "
+        f"--checkpoint-dir {ck_base}{tp_opt}"
     )
 
     def _variant_cycle(extra_opts: str):
@@ -1131,7 +1153,7 @@ def _measure_swap_recovery() -> None:
     qbase = (
         "--model tiny --num-pages 8 --page-size 8 --max-batch 2 "
         "--max-model-len 64 --swap-bucket-mib 1 --model-pool-mib 512 "
-        "--content-hash off "
+        f"--content-hash off{tp_opt} "
     )
 
     def _quant_cycle(extra_opts: str):
@@ -1204,6 +1226,12 @@ def _measure_swap_recovery() -> None:
         ),
         "extra": {
             "platform": jax.devices()[0].platform,
+            # mesh identity of the variant/quant probes: [dp, pp, sp,
+            # tp, ep] axis sizes (None = single device), so mesh vs
+            # single-device byte ratios land distinguishable in the
+            # bench trajectory
+            "tensor_parallel_size": bench_tp,
+            "mesh_shape": bench_mesh_shape,
             "rolled_back": rolled_back,
             "health_ok": health_ok,
             "degraded_after_rollback": bool(degraded),
@@ -1748,6 +1776,29 @@ def _measure_fleet() -> None:
     print(json.dumps(result))
 
 
+def _bench_tp() -> int:
+    """``--tensor-parallel-size N`` for the mesh variants of the swap and
+    decode sub-benches (default 1 = single device; the CPU fallback
+    forces enough virtual host devices for the mesh)."""
+    try:
+        return max(1, int(_argv_value("--tensor-parallel-size", "1") or 1))
+    except ValueError:
+        return 1
+
+
+def _bench_mesh(tp: int):
+    """(mesh, [dp, pp, sp, tp, ep]) for a mesh bench leg, (None, None)
+    when tp == 1 — the one place the sub-benches derive the serving mesh
+    and the mesh_shape their result JSON records."""
+    if tp <= 1:
+        return None, None
+    from llm_d_fast_model_actuation_tpu.engine.exec_pool import mesh_shape
+    from llm_d_fast_model_actuation_tpu.parallel.mesh import serving_mesh
+
+    mesh = serving_mesh(tp)
+    return mesh, list(mesh_shape(mesh))
+
+
 def _run_child(
     env: dict, sub: str = ""
 ) -> "subprocess.CompletedProcess[str]":
@@ -1762,6 +1813,9 @@ def _run_child(
     seed = _argv_value("--seed", "")
     if seed:
         argv += ["--seed", seed]
+    tp = _bench_tp()
+    if tp > 1:
+        argv += ["--tensor-parallel-size", str(tp)]
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )
@@ -1840,6 +1894,17 @@ def main() -> int:
     ]
     cpu_env["PYTHONPATH"] = os.pathsep.join([REPO_ROOT] + kept)
     attempts.append(("cpu", cpu_env))
+    tp = _bench_tp()
+    if tp > 1:
+        # mesh variants need >= tp devices; the flag only affects the
+        # host (CPU) platform, so it is harmless on the TPU attempt
+        for _, env in attempts:
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={tp}"
+                ).strip()
 
     last = None
     prior_failures = {}

@@ -151,9 +151,43 @@ def aligned_digests(
 #: churn the disk tier
 QUANT_DIGEST_PREFIX = "q:"
 
+#: digest prefix of MESH-qualified digests: ``m:<qual>:<content>`` where
+#: ``qual`` hashes (mesh shape | per-leaf sharding spec) and ``content``
+#: is the plain :func:`leaf_digest` of the full (global) host array. The
+#: qualifier makes sharded weight identity shard-qualified — a tp=2
+#: entry never content-matches (or is served the disk blob of) the same
+#: bytes placed single-device or under another mesh shape — while the
+#: content suffix keeps disk blobs re-verifiable on reload.
+MESH_DIGEST_PREFIX = "m:"
+
 
 def digest_spillable(digest: str) -> bool:
     return not digest.startswith(QUANT_DIGEST_PREFIX)
+
+
+def qualify_digest(content_digest: str, qualifier: str) -> str:
+    """Shard-qualify a plain content digest for a mesh placement
+    (``qualifier`` = "tp=<N>|<PartitionSpec str>" — parallel.mesh.
+    flat_spec_strs). Collectively the result covers dtype | global shape
+    | sharding spec | bytes. Idempotent: an already-qualified (or
+    transfer-quantized ``q:``) digest passes through unchanged, so the
+    tier/prefetch staging paths can re-qualify carried-through maps
+    safely."""
+    if content_digest.startswith(
+        (MESH_DIGEST_PREFIX, QUANT_DIGEST_PREFIX)
+    ):
+        return content_digest
+    qual = hashlib.sha256(qualifier.encode()).hexdigest()[:12]
+    return f"{MESH_DIGEST_PREFIX}{qual}:{content_digest}"
+
+
+def digest_content_hash(digest: str) -> str:
+    """The plain content-hash part of a (possibly mesh-qualified)
+    digest: what the disk tier's reload re-verification recomputes over
+    the file bytes."""
+    if digest.startswith(MESH_DIGEST_PREFIX):
+        return digest.rsplit(":", 1)[-1]
+    return digest
 
 
 @dataclass
@@ -418,10 +452,16 @@ class ChunkStore:
             # CONTENT verify on every reload: the digest names the bytes,
             # so recompute it over what the file actually holds — a stale
             # blob, bitrot, or an (astronomically unlikely) collision
-            # must be a miss, never silently-wrong weights.
+            # must be a miss, never silently-wrong weights. Mesh-
+            # qualified digests verify their content suffix (the blob
+            # holds the full global array; the qualifier is part of the
+            # lookup key, already matched by reaching this path).
             dtype = np.dtype(header["dtype"])
             arr = np.frombuffer(raw, dtype=dtype).reshape(header["shape"])
-            if header.get("digest") != digest or leaf_digest(arr) != digest:
+            if (
+                header.get("digest") != digest
+                or leaf_digest(arr) != digest_content_hash(digest)
+            ):
                 raise ValueError("content digest mismatch")
         except Exception:  # noqa: BLE001 — any malformed blob is a miss
             with self._mu:

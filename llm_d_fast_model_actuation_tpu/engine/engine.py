@@ -352,9 +352,12 @@ class ProgramSet:
         self.model_cfg = model_cfg
         self.alt_k = int(logprobs_topk)
         self.eos = int(eos_token_id)
-        #: attention impl override for the MIXED program only (sharded
-        #: meshes route the ragged op through the XLA twin —
-        #: ops/attention.py:resolve_ragged_impl); None = model config's
+        #: attention impl override for the MIXED program only (the
+        #: routing matrix of device kind x mesh x impl flag —
+        #: ops/attention.py:resolve_ragged_impl: pallas engines keep
+        #: the kernel on meshes via its shard_map port, non-pallas and
+        #: interpret-incapable CPU meshes run the XLA twin); None =
+        #: model config's
         self.mixed_impl = mixed_impl
         #: the engine's mesh: device-RESIDENT scheduler outputs (counts,
         #: bias, last tokens, ...) are pinned replicated on it so their
@@ -570,7 +573,7 @@ class ProgramSet:
             counts = counts.at[add_slot, tokens].add(1, mode="drop")
             logits, cache = llama.mixed_step(
                 params, model_cfg, tokens, row_slot, positions, cache,
-                pt,
+                pt, mesh=self.mesh,
             )
             last = logits[sample_rows]  # [b, vocab]
             # per-slot key split, advanced only for slots that sample this
@@ -849,11 +852,12 @@ class InferenceEngine:
         self._token_budget = cfg.packed_token_budget if self._packed else 0
         #: packing alignment: the Pallas ragged kernel requires each
         #: sequence's run of rows to start on a RAGGED_BLOCK boundary
-        #: (a kernel block holds one sequence); the XLA twin computes
-        #: every row independently, so non-pallas engines — and sharded
-        #: meshes, whose mixed program routes through the twin
-        #: (resolve_ragged_impl) — pack DENSELY: same outputs
-        #: bit-for-bit, fewer padded rows
+        #: (a kernel block holds one sequence) — on meshes too, where
+        #: each shard_map shard replays the same block metadata over
+        #: its head slice (resolve_ragged_impl). The XLA twin computes
+        #: every row independently, so engines resolved to a non-pallas
+        #: impl pack DENSELY: same outputs bit-for-bit, fewer padded
+        #: rows
         from ..ops.attention import RAGGED_BLOCK
 
         self._pack_align = (

@@ -367,9 +367,12 @@ def compile_program(cfg, program: str, bucket: int, programs=None, mesh=None):
 
 def _program_set(cfg, mesh=None):
     """A ProgramSet matching the live engine's for (cfg, mesh): the
-    mixed program's attention impl reroutes through the XLA ragged twin
-    on meshes, exactly like InferenceEngine.__init__ — a warmup-compiled
-    executable must trace the identical program."""
+    mixed program's attention impl follows the device-kind x mesh x
+    impl-flag routing matrix (ops/attention.py:resolve_ragged_impl —
+    pallas stays pallas on meshes via the kernel's shard_map port,
+    interpret-incapable CPU builds fall back to the XLA twin), exactly
+    like InferenceEngine.__init__ — a warmup-compiled executable must
+    trace the identical program."""
     from ..ops.attention import resolve_ragged_impl
     from .engine import ProgramSet
 

@@ -541,8 +541,10 @@ def make_arg_parser() -> argparse.ArgumentParser:
         help="content-address pooled weights (sha256 per leaf, computed "
         "once at load): dedupes sibling fine-tune variants in the host "
         "pool and lets hot-swaps move only the delta between models "
-        "sharing tensors. Ignored (off) for sharded or quantized "
-        "engines",
+        "sharing tensors. Sharded single-process meshes participate "
+        "with mesh-qualified digests (content hash + mesh shape + "
+        "per-leaf sharding spec); ignored (off) for multi-host gangs "
+        "and --quantization engines",
     )
     p.add_argument(
         "--sleep-quant",
@@ -555,7 +557,9 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "~half the transfer bytes per actuation. OPT-IN AND LOSSY-ONCE: "
         "the first quantized offload rounds the weights; every later "
         "cycle reproduces the same bits. off (default) keeps every "
-        "sleep/wake/swap bit-exact",
+        "sleep/wake/swap bit-exact. Composes with single-process "
+        "--tensor-parallel-size meshes (shard-local quant/dequant on "
+        "device); multi-host gangs are rejected",
     )
     p.add_argument(
         "--sleep-quant-hot-head",
@@ -737,10 +741,15 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
                 "a --quantization int8 engine already holds (and moves) "
                 "int8 weights"
             )
-        if args.tensor_parallel_size > 1:
+        if (
+            getattr(args, "num_processes", 0)
+            or int(os.environ.get("FMA_NUM_PROCESSES", "0") or 0)
+        ) > 1:
             raise ValueError(
-                "--sleep-quant requires --tensor-parallel-size 1 (sharded "
-                "offloads stage per-shard and reassemble bit-for-bit)"
+                "--sleep-quant is not supported for multi-host gangs "
+                "(gang offloads stage per-shard and reassemble "
+                "bit-for-bit); single-process --tensor-parallel-size "
+                "meshes compose fine"
             )
     if getattr(args, "pool_disk_mib", 0) < 0:
         raise ValueError("--pool-disk-mib must be >= 0")
@@ -1003,11 +1012,18 @@ class EngineService:
         # content-addressed so sibling fine-tunes dedupe their shared
         # tensors and swaps between them move only the delta — with a
         # local-disk spill tier below for evicted models' chunks.
-        # Content hashing is meaningful only where host weight trees are
-        # plain numpy with stable identity: single-device, unquantized.
+        # Content hashing covers single-device AND single-process tp
+        # meshes: sharded entries carry mesh-qualified digests (the
+        # content hash shard-qualified with mesh shape + per-leaf
+        # sharding spec — chunk_store.qualify_digest), computed from the
+        # same per-process host views the sleeper stages, so sibling
+        # variants on one mesh dedupe and delta-swap exactly like
+        # single-device ones. Off for multi-host gangs (no host-resident
+        # global trees to hash) and for --quantization engines (the
+        # serving tree's {"q","s"} leaves have no stable identity).
         self._content_hash = (
             getattr(args, "content_hash", "on") == "on"
-            and args.tensor_parallel_size == 1
+            and dist is None
             and not getattr(args, "quantization", "")
         )
         # Compressed actuation transfers (docs/perf.md "Compressed
@@ -1306,11 +1322,9 @@ class EngineService:
                 # the same mesh the build will construct (Mesh equality
                 # is by devices + axis names, so the warmed executables'
                 # NamedSharding avals match the built engine's arrays)
-                from ..parallel.mesh import MeshPlan, make_mesh
+                from ..parallel.mesh import serving_mesh
 
-                mesh = make_mesh(
-                    MeshPlan(tp=self.args.tensor_parallel_size)
-                )
+                mesh = serving_mesh(self.args.tensor_parallel_size)
             task = WarmupTask(
                 cfg,
                 self._warmup_buckets,
@@ -1457,6 +1471,44 @@ class EngineService:
             token_budget=getattr(args, "token_budget", 0),
         )
 
+    def _qualify_digests(
+        self, digests: Optional[Dict[str, str]], model_cfg
+    ) -> Optional[Dict[str, str]]:
+        """Shard-qualify a flat content-digest map for this engine's mesh
+        placement (no-op single-device): each digest becomes
+        ``m:<hash(tp|spec)>:<content>`` (chunk_store.qualify_digest) with
+        the per-leaf sharding spec derived from the MODEL CONFIG's
+        logical axes — the same rule table shard_pytree places with — so
+        the host-only prefetch staging path and the placed build qualify
+        identically, and a digest can only ever match content under the
+        same mesh shape AND the same per-leaf spec. Idempotent on
+        already-qualified maps (tier manifests carried through
+        take_staged)."""
+        tp = self.args.tensor_parallel_size
+        if not digests or tp <= 1:
+            return digests
+        from ..models.registry import logical_axes_for
+        from ..parallel.mesh import flat_spec_strs
+        from .chunk_store import qualify_digest
+
+        specs = flat_spec_strs(logical_axes_for(model_cfg))
+        missing = [k for k in digests if k not in specs]
+        if missing:
+            # a digest key with no logical-axes entry qualifies with an
+            # empty spec — still tp-qualified, and swap's sharding
+            # equality check keeps matches safe, but the "re-sharded
+            # leaf never matches by digest" guarantee is weakened for
+            # these leaves: surface the key drift instead of hiding it
+            logger.warning(
+                "content digests have no sharding spec for %d leaves "
+                "(digest keys drifted from the model's logical axes?): %s",
+                len(missing), sorted(missing)[:8],
+            )
+        return {
+            k: qualify_digest(d, f"tp={tp}|{specs.get(k, '')}")
+            for k, d in digests.items()
+        }
+
     def _build_runtime(
         self,
         model_id: str,
@@ -1511,9 +1563,9 @@ class EngineService:
         model_cfg, eos_token_id, extra_eos, hf_dir, tokenizer = resolved
         mesh = None
         if args.tensor_parallel_size > 1:
-            from ..parallel.mesh import MeshPlan, make_mesh
+            from ..parallel.mesh import serving_mesh
 
-            mesh = make_mesh(MeshPlan(tp=args.tensor_parallel_size))
+            mesh = serving_mesh(args.tensor_parallel_size)
         # Build transfer accounting: a pool-miss swap moves the whole
         # incoming model to HBM inside this build, and the swap metrics
         # must say so (h2d seconds/bytes were reported as 0 before).
@@ -1671,7 +1723,14 @@ class EngineService:
             tokenizer=tokenizer,
             hf_dir=hf_dir,
             checkpoint_dir=checkpoint_dir,
-            digests=digests if self._content_hash else None,
+            # mesh builds carry shard-qualified digests (idempotent for
+            # tier-staged maps that already are): sharded weight
+            # identity = content + mesh shape + per-leaf spec
+            digests=(
+                self._qualify_digests(digests, model_cfg)
+                if self._content_hash
+                else None
+            ),
         )
 
     def _install_runtime(self, rt: _ModelRuntime) -> None:
@@ -2412,7 +2471,9 @@ class EngineService:
             params_host=staged,
             nbytes=nbytes,
             digests=(
-                dict(lstats.digests) or None
+                self._qualify_digests(
+                    dict(lstats.digests) or None, model_cfg
+                )
                 if self._content_hash and quant_metas is None
                 else None
             ),

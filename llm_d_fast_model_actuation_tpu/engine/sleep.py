@@ -225,7 +225,10 @@ class SleepManager:
     def _quant_plan(self, state) -> Optional[list]:
         """Per-leaf quantize-for-transfer flags for this state, or None
         when the mode is off / nothing is eligible (multi-host staged
-        offloads never quantize — shards reassemble bit-for-bit)."""
+        offloads never quantize — shards reassemble bit-for-bit).
+        Single-process tp meshes DO quantize: the quantize/dequantize
+        ops run shard-local on device (models/quant.py:quantize_leaf)
+        and only the payload's shards cross the boundary."""
         if not self.quant_mode or jax.process_count() > 1:
             return None
         plan = transfer_quant.transfer_quant_plan(
@@ -361,7 +364,22 @@ class SleepManager:
         ``metas`` (aligned TransferQuant-or-None) marks quantized-payload
         leaves: the payload moves H2D, then dequantizes ON DEVICE — the
         dequant of bucket k is dispatched async and rides under bucket
-        k+1's transfer, the same overlap discipline AOT warmup uses."""
+        k+1's transfer, the same overlap discipline AOT warmup uses. On
+        meshes the payload lands pre-sharded (device_put to the leaf's
+        original NamedSharding) and the expansion runs shard-local; a
+        payload recording a shard view (meta.spec) is cross-checked
+        against its placement target — expanding under a different
+        sharding than it quantized from must fail loudly, never serve."""
+        if metas is not None:
+            for i, m in enumerate(metas):
+                if m is None or m.spec is None:
+                    continue
+                tspec = getattr(targets[i], "spec", None)
+                if tspec is not None and str(tspec) != m.spec:
+                    raise RuntimeError(
+                        f"quantized payload {i} was sharded {m.spec} but "
+                        f"would restore to {tspec}"
+                    )
         out: list = [None] * len(leaves)
         buckets = partition_buckets(
             [x.nbytes for x in leaves], self.bucket_bytes

@@ -454,6 +454,7 @@ def mixed_step(
     positions: jnp.ndarray,  # [T] int32 — absolute position per token
     cache: Tuple[jnp.ndarray, jnp.ndarray],
     page_table: jnp.ndarray,  # [rows, pages_per_seq] int32
+    mesh=None,  # tp mesh: the pallas ragged impl runs under shard_map
 ):
     """One token-packed mixed-batch step: prefill segments, suffix
     continuations, and decode steps for MANY sequences in one forward
@@ -468,8 +469,10 @@ def mixed_step(
     static ``kv_pages_bucket`` bound before calling here (bit-exact:
     the dropped entries were hard-masked exact zeros for every row).
     Under a sharded mesh the gather/scatter and einsums GSPMD-partition
-    over the kv_heads/heads shards; the ragged op runs its XLA twin
-    there (ops/attention.py:resolve_ragged_impl).
+    over the kv_heads/heads shards; the ragged op routes per
+    ops/attention.py:resolve_ragged_impl — the pallas kernel runs under
+    ``shard_map`` over ``mesh``'s tp axis, the XLA twin partitions
+    without it.
 
     Returns (logits [T, vocab], new_cache); the caller gathers the rows
     that sample (each segment's last token / each decode row). Padding
@@ -495,7 +498,7 @@ def mixed_step(
         vp = _scatter_rows(vp, v, page_table, row_slot, positions, page_size)
         attn = ragged_paged_attention(
             q, kp, vp, page_table, row_slot, positions,
-            impl=cfg.attention_impl,
+            impl=cfg.attention_impl, mesh=mesh,
         )
         x = x + _post(
             cfg, lp, "post_attn_norm",

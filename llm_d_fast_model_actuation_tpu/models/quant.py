@@ -137,6 +137,16 @@ class TransferQuant:
     orig_dtype: str  #: numpy dtype string of the full-precision leaf
     #: float32 per-output-channel scale, broadcastable (int8 only)
     scale: Optional[np.ndarray] = None
+    #: shard view of the leaf this payload was quantized FROM (the
+    #: ``str(PartitionSpec)`` of a mesh-sharded device leaf; None for
+    #: single-device / host-staged payloads): quantize/dequantize run
+    #: shard-locally on device — the per-output-channel scale reduction
+    #: is over the fan-in axis, which XLA computes shard-local where
+    #: that axis is unsharded and via one exact all-reduce max where it
+    #: is ('tp'-sharded ``w_down``) — and the restore path cross-checks
+    #: this spec against its placement target so a payload can never be
+    #: silently expanded under a different sharding than it came from
+    spec: Optional[str] = None
 
     @property
     def scale_nbytes(self) -> int:
@@ -207,20 +217,36 @@ def payload_nbytes(shape: Tuple[int, ...], mode: str) -> int:
     return elems + scale
 
 
+def _shard_spec_str(arr: Any) -> Optional[str]:
+    """``str(PartitionSpec)`` of a mesh-sharded device array (the shard
+    view recorded in :class:`TransferQuant`); None for single-device and
+    host arrays."""
+    sh = getattr(arr, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None or getattr(sh, "num_devices", 1) <= 1:
+        return None
+    return str(spec)
+
+
 def quantize_leaf(
     arr: Any, mode: str, scale: Optional[Any] = None
 ) -> Tuple[Any, TransferQuant]:
     """Quantize one leaf for transfer with jnp ops — ON DEVICE when `arr`
-    is a device array, so only the payload crosses the boundary.
+    is a device array, so only the payload crosses the boundary. A
+    mesh-sharded leaf quantizes SHARD-LOCALLY (elementwise ops keep the
+    input's sharding; the amax reduction is shard-local except over a
+    'tp'-sharded fan-in axis, where XLA inserts one exact all-reduce
+    max), and the leaf's shard view is recorded in the metadata.
 
     ``scale`` (the sleeper's cached scale from this leaf's first
     quantization) makes re-quantization bit-idempotent: round(w'/s) with
     w' = dequant(q, s) recovers exactly q. Returns (payload, meta); the
     meta's scale is normalized to host numpy."""
     orig = str(np.dtype(arr.dtype))
+    spec = _shard_spec_str(arr)
     if mode == "fp8":
         return jnp.asarray(arr).astype(fp8_dtype()), TransferQuant(
-            mode="fp8", orig_dtype=orig
+            mode="fp8", orig_dtype=orig, spec=spec
         )
     w = jnp.asarray(arr).astype(jnp.float32)
     if scale is None:
@@ -233,6 +259,7 @@ def quantize_leaf(
         mode="int8",
         orig_dtype=orig,
         scale=np.asarray(s, dtype=np.float32),
+        spec=spec,
     )
 
 

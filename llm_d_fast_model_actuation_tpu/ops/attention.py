@@ -203,19 +203,47 @@ RAGGED_BLOCK = 8
 
 def resolve_ragged_impl(impl: str, mesh) -> str:
     """The implementation the RAGGED op runs under for an engine on
-    `mesh` (None = single device). The hand-written Pallas kernel is a
-    single-device program — it walks the page pool with raw HBM DMA and
-    has no shard_map plumbing yet — so sharded engines route the mixed
-    program through the XLA twin below, whose gather/scatter GSPMD
-    partitions: ``k_pages[pt]`` gathers on the (replicated) page axis of
-    a pool sharded over kv_heads, so each device reads only its own head
-    shard, and the einsums contract the head-sharded axes in place. The
+    `mesh` (None = single device) — the ONE routing decision for the
+    packed data plane, a matrix of device kind x mesh x impl flag:
+
+    ==========  ====================  =================================
+    impl flag   mesh=None             single-process tp mesh
+    ==========  ====================  =================================
+    pallas      Pallas kernel         Pallas kernel under ``shard_map``
+                (interpret on CPU)    over the ``tp`` axis
+                                      (ops/pallas/ragged.py:
+                                      ragged_paged_attention_pallas_
+                                      sharded) on TPU meshes — and in
+                                      interpreter mode on CPU meshes
+                                      whose jaxlib can lower it
+                                      (``pallas_interpret_supported``);
+                                      the XLA twin otherwise
+    grouped /   XLA twin              XLA twin — its gather/scatter
+    reference                         GSPMD-partitions: ``k_pages[pt]``
+                                      gathers on the replicated page
+                                      axis of a pool sharded over
+                                      kv_heads, so each device reads
+                                      only its own head shard, and the
+                                      einsums contract the head-sharded
+                                      axes in place
+    ==========  ====================  =================================
+
+    KV heads and the page pool are sharded over ``tp`` already
+    (``PagePool.create``), so the shard_map port gives each shard the
+    same scalar-prefetched block metadata over its own head slice of
+    the pool — no cross-shard softmax for head-sharded GQA. The
     engine's bucketed programs keep their configured impl — only the
-    packed path is rerouted (and packs densely: the twin computes every
-    row independently, so RAGGED_BLOCK alignment buys nothing)."""
-    if mesh is not None and impl == "pallas":
-        return "grouped"
-    return impl
+    packed path routes here. Engines resolved to a non-pallas impl pack
+    densely (the twin computes every row independently, so RAGGED_BLOCK
+    alignment buys nothing); pallas engines keep the block alignment on
+    meshes too."""
+    if impl != "pallas" or mesh is None:
+        return impl
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    from ..utils.compat import pallas_interpret_supported
+
+    return "pallas" if pallas_interpret_supported() else "grouped"
 
 
 def ragged_paged_attention(
@@ -227,6 +255,8 @@ def ragged_paged_attention(
     #                         -1 marks a padding row (output is garbage)
     positions: jnp.ndarray,  # [tokens] int32 — absolute position per token
     impl: "str | None" = None,  # None -> module default
+    mesh=None,  # tp mesh for the pallas impl's shard_map port; the XLA
+    #            twin never needs it (GSPMD partitions it in place)
 ) -> jnp.ndarray:
     """Attention for a token-packed mixed batch over the paged cache.
 
@@ -254,9 +284,17 @@ def ragged_paged_attention(
     finite garbage the caller ignores.
     """
     if (impl or _IMPL) == "pallas":
-        from .pallas import ragged_paged_attention_pallas
-
         if q.shape[0] % RAGGED_BLOCK == 0:
+            if mesh is not None:
+                from .pallas import ragged_paged_attention_pallas_sharded
+
+                return ragged_paged_attention_pallas_sharded(
+                    mesh, q, k_pages, v_pages, page_table, row_slot,
+                    positions, block_rows=RAGGED_BLOCK,
+                    interpret=_pallas_interpret(),
+                )
+            from .pallas import ragged_paged_attention_pallas
+
             return ragged_paged_attention_pallas(
                 q, k_pages, v_pages, page_table, row_slot, positions,
                 block_rows=RAGGED_BLOCK, interpret=_pallas_interpret(),

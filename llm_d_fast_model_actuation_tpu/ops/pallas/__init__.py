@@ -10,11 +10,15 @@ from .decode import (
     paged_decode_attention_pallas,
 )
 from .prefill import causal_prefill_attention_pallas
-from .ragged import ragged_paged_attention_pallas
+from .ragged import (
+    ragged_paged_attention_pallas,
+    ragged_paged_attention_pallas_sharded,
+)
 
 __all__ = [
     "paged_decode_attention_inline_pallas",
     "paged_decode_attention_pallas",
     "causal_prefill_attention_pallas",
     "ragged_paged_attention_pallas",
+    "ragged_paged_attention_pallas_sharded",
 ]

@@ -27,13 +27,16 @@ Grid: one program per row block. GQA reads each KV head's page tile once
 per block and loops the query heads of its group over it — repeated KV
 heads are never materialized, mirroring the decode kernel.
 
-Scope: SINGLE-DEVICE. The kernel walks the page pool with raw HBM DMA
-and has no shard_map plumbing, so sharded-mesh engines route the mixed
-program through the XLA twin instead (whose gather/scatter GSPMD
-partitions over the kv_heads shards) — see
-``ops/attention.py:resolve_ragged_impl``. They also pack densely: the
-``block_rows`` alignment below buys nothing when every row computes
-independently.
+Meshes: the kernel body is a single-device program (it walks the page
+pool with raw HBM DMA), and :func:`ragged_paged_attention_pallas_sharded`
+ports it to tp meshes by wrapping it in ``shard_map`` over the ``tp``
+axis — the axis the engine already shards KV heads and the page pool
+over (``PagePool.create`` places pages at ``P(None, None, None, 'tp',
+None)``). Each shard walks its OWN head slice of the page pool with the
+same replicated block metadata; head-sharded GQA needs no cross-shard
+softmax, because every query head's softmax completes inside the shard
+that owns its KV-head group. Routing between the two entry points (and
+the XLA twin) lives in ``ops/attention.py:resolve_ragged_impl``.
 """
 
 from __future__ import annotations
@@ -236,3 +239,61 @@ def ragged_paged_attention_pallas(
         grid_spec=grid_spec,
         interpret=interpret,
     )(meta, page_table.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def ragged_paged_attention_pallas_sharded(
+    mesh,
+    q: jnp.ndarray,  # [tokens, heads, head_dim]
+    k_pages: jnp.ndarray,  # [num_pages, page_size, kv_heads, head_dim]
+    v_pages: jnp.ndarray,
+    page_table: jnp.ndarray,  # [rows, pages_per_seq] int32
+    row_slot: jnp.ndarray,  # [tokens] int32; -1 = padding row
+    positions: jnp.ndarray,  # [tokens] int32 absolute positions
+    block_rows: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The kernel above on a tp mesh: ``shard_map`` over the ``tp`` axis.
+
+    Query heads, KV heads, and the page pool's kv_heads axis are all
+    sharded over ``tp`` (the engine's serving placement), so each shard
+    runs the unmodified single-device kernel over its own head slice of
+    the pool; the page table and the per-row (slot, position) metadata
+    are replicated, and the per-block scalar-prefetch metadata is
+    recomputed identically on every shard. No cross-shard collective
+    runs inside the attention: with heads grouped to their KV head
+    (GQA), every softmax is complete within one shard — the reason a
+    head-sharded port needs no distributed online-softmax. Requires
+    ``num_kv_heads % tp == 0`` (the same divisibility the NamedSharding
+    placement already enforces).
+
+    Composes with jit: the mixed program calls this inside its traced
+    body and GSPMD reshards inputs to the declared specs (a no-op for
+    activations already sharded over heads). ``interpret=True`` runs the
+    per-shard kernel in interpreter mode — how CPU tp-meshes validate
+    bit-exactness against the XLA twin (tests/test_ragged.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ...utils.compat import shard_map
+
+    kernel = functools.partial(
+        ragged_paged_attention_pallas,
+        block_rows=block_rows,
+        interpret=interpret,
+    )
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(
+            P(None, "tp", None),  # q: query heads sharded
+            P(None, None, "tp", None),  # k_pages: kv heads sharded
+            P(None, None, "tp", None),  # v_pages
+            P(None, None),  # page_table: replicated
+            P(None),  # row_slot: replicated
+            P(None),  # positions: replicated
+        ),
+        out_specs=P(None, "tp", None),
+        # the pallas body is opaque to the replication checker; the
+        # out_specs above are the contract the caller relies on
+        check_rep=False,
+    )(q, k_pages, v_pages, page_table, row_slot, positions)

@@ -132,6 +132,46 @@ def shard_pytree(tree: Any, mesh: Mesh, axes_tree: Any, rules=None) -> Any:
     return jax.tree.map(put, tree, axes_tree, is_leaf=lambda x: x is None)
 
 
+def serving_mesh(tp: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The engine's tp-mesh over the first ``tp`` local devices — the ONE
+    construction every serving-path site uses (runtime build, AOT warmup,
+    digest qualification), so Mesh equality (devices + axis names) holds
+    across all of them and NamedShardings captured at sleep compare equal
+    to the ones a later build produces. A host with more visible devices
+    than ``tp`` serves from the leading ones (the launcher pins visible
+    chips per instance; the 8-virtual-device CPU test runner relies on
+    the slice too)."""
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < tp:
+        raise ValueError(
+            f"tensor_parallel_size {tp} needs {tp} devices, have "
+            f"{len(devices)}"
+        )
+    return make_mesh(MeshPlan(tp=tp), list(devices)[:tp])
+
+
+def flat_spec_strs(axes_tree: Any, rules=None) -> Dict[str, str]:
+    """Flat '/'-joined weight key -> ``str(PartitionSpec)`` over a
+    logical-axes pytree (models.registry.logical_axes_for). This is the
+    shard-view input of the mesh-qualified content digests
+    (engine/chunk_store.py:qualify_digest): derived from the MODEL
+    CONFIG, not from placed arrays, so the host-only prefetch staging
+    path and the placed runtime build qualify identically."""
+    out: Dict[str, str] = {}
+
+    def walk(node: Any, prefix: Tuple[str, ...]) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, prefix + (k,))
+        else:
+            spec = spec_for(node, rules) if node is not None else P()
+            out["/".join(prefix)] = str(spec)
+
+    walk(axes_tree, ())
+    return out
+
+
 def plan_for_devices(
     n: int, tp: Optional[int] = None, sp: int = 1, pp: int = 1, ep: int = 1
 ) -> MeshPlan:

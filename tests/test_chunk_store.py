@@ -12,8 +12,10 @@ import pytest
 from llm_d_fast_model_actuation_tpu.engine.chunk_store import (
     ChunkStore,
     aligned_digests,
+    digest_content_hash,
     digest_tree,
     leaf_digest,
+    qualify_digest,
 )
 from llm_d_fast_model_actuation_tpu.engine.model_pool import HostModelPool
 
@@ -31,6 +33,44 @@ def test_leaf_digest_content_shape_dtype_sensitive():
     # non-contiguous views hash by content, not memory layout
     m = np.arange(16, dtype=np.float32).reshape(4, 4)
     assert leaf_digest(m.T) == leaf_digest(np.ascontiguousarray(m.T))
+
+
+def test_mesh_qualified_digests_identity_and_spill_round_trip(tmp_path):
+    """Shard-qualified digests (sharded engines): same content under the
+    same qualifier matches, any qualifier difference (mesh shape or
+    per-leaf spec) does not, the plain content hash is recoverable for
+    reload verification, qualification is idempotent, and a qualified
+    chunk survives a verified disk round trip — the mesh-restart rebuild
+    path."""
+    a = np.arange(64, dtype=np.float32)
+    content = leaf_digest(a)
+    q1 = qualify_digest(content, "tp=2|PartitionSpec(None, 'tp')")
+    q2 = qualify_digest(content, "tp=2|PartitionSpec(None, 'tp')")
+    q3 = qualify_digest(content, "tp=4|PartitionSpec(None, 'tp')")
+    q4 = qualify_digest(content, "tp=2|PartitionSpec('tp', None)")
+    assert q1 == q2
+    assert len({q1, q3, q4, content}) == 4  # qualifier-sensitive
+    assert q1.startswith("m:")
+    assert digest_content_hash(q1) == content
+    assert digest_content_hash(content) == content
+    # idempotent: re-qualifying a qualified (or quant) digest is a no-op
+    assert qualify_digest(q1, "tp=8|whatever") == q1
+    assert qualify_digest("q:abc", "tp=2|x") == "q:abc"
+
+    # qualified chunks spill and reload content-verified
+    cs = ChunkStore(disk_dir=str(tmp_path), disk_budget_bytes=1 << 20)
+    cs.intern(q1, a)
+    cs.release(q1)  # last ref: spills
+    assert cs.disk_spills == 1
+    got = cs.fetch(q1)
+    assert got is not None and np.array_equal(got, a)
+    assert cs.disk_hits == 1 and cs.verify_failures == 0
+    # a corrupted blob is a verified miss, qualified or not
+    path = glob.glob(os.path.join(str(tmp_path), "*.chunk"))[0]
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-4] + b"\x00\x00\x00\x01")
+    assert cs.fetch(q1) is None
+    assert cs.verify_failures == 1
 
 
 def test_intern_refcount_and_dedup_accounting():

@@ -364,6 +364,119 @@ def test_chip_ledger_tracks_pool_summaries():
     assert led.pools() == {}
 
 
+# -- sharded meshes: mesh-qualified digests + delta swap ----------------------
+
+
+def test_service_sibling_delta_swap_tp2_mesh(variant_ckpts):
+    """The mesh parity bar (ROADMAP item 4): a sibling pool-hit swap on
+    a single-process tp=2 CPU mesh content-matches the shared tensors
+    away — < 50% of full-swap bytes move, generations stay bit-exact on
+    both sides — and every digest is mesh-qualified (content + mesh
+    shape + per-leaf sharding spec), so sharded identity can never
+    collide with a single-device entry of the same bytes."""
+    da, db, shared = variant_ckpts
+    svc = _service(da, extra="--tensor-parallel-size 2")
+    try:
+        assert svc._content_hash, "content hashing must be ON for tp=2"
+        gold_a = _gen(svc)
+
+        dg = svc._current_runtime().digests
+        assert dg and all(v.startswith("m:") for v in dg.values())
+        # qualified digests still carry the verifiable content suffix
+        from llm_d_fast_model_actuation_tpu.engine.chunk_store import (
+            digest_content_hash,
+        )
+
+        assert all(
+            len(digest_content_hash(v)) == 64 and ":" not in
+            digest_content_hash(v)
+            for v in dg.values()
+        )
+
+        out = svc.swap("tiny", checkpoint_dir=db)  # cold: parks A
+        assert out["swapped"] and out["tier"] == "cold"
+        gold_b = _gen(svc)
+        assert gold_b != gold_a
+
+        out = svc.swap("tiny", checkpoint_dir=da)  # sibling pool hit
+        assert out["pool_hit"] and out["tier"] == "pool"
+        assert out["bytes_deduped"] >= 2 * shared > 0
+        full = out["bytes_out"] + out["bytes_in"]
+        assert out["bytes_moved"] < 0.5 * full, (
+            f"tp=2 delta swap moved {out['bytes_moved']} of {full}"
+        )
+        assert _gen(svc) == gold_a, "tp=2 delta swap changed the numerics"
+
+        out = svc.swap("tiny", checkpoint_dir=db)  # and back
+        assert out["pool_hit"] and out["bytes_moved"] < 0.5 * (
+            out["bytes_out"] + out["bytes_in"]
+        )
+        assert _gen(svc) == gold_b
+
+        # both siblings pooled: the shared base dedupes on the mesh too
+        svc.swap("tiny-gemma")
+        pool = svc.model_pool.describe()
+        assert pool["chunks"]["dedup_saved_bytes"] >= shared
+    finally:
+        svc.shutdown()
+
+
+def test_service_delta_swap_rollback_tp2_mesh(variant_ckpts):
+    """A mid-transfer fault during a tp=2 sibling delta swap rolls back
+    with BOTH models bit-exact: the outgoing model keeps serving its
+    exact weights, the incoming pool entry is re-pooled intact, and the
+    retried swap completes bit-exact."""
+    from llm_d_fast_model_actuation_tpu.engine.sleep import SwapRolledBack
+
+    da, db, _ = variant_ckpts
+    svc = _service(da, extra="--tensor-parallel-size 2")
+    try:
+        gold_a = _gen(svc)
+        svc.swap("tiny", checkpoint_dir=db)  # parks A
+        gold_b = _gen(svc)
+
+        faults.arm("swap.h2d", mode="fail", count=1)
+        with pytest.raises(SwapRolledBack):
+            svc.swap("tiny", checkpoint_dir=da)
+        assert svc.degraded  # visible, but still serving
+        assert _gen(svc) == gold_b, "outgoing mesh model corrupted"
+
+        out = svc.swap("tiny", checkpoint_dir=da)  # retry: pool intact
+        assert out["pool_hit"]
+        assert _gen(svc) == gold_a, "re-pooled mesh entry corrupted"
+        assert svc.degraded is None  # committed swap clears the marker
+    finally:
+        svc.shutdown()
+
+
+def test_service_disk_tier_rebuild_tp2_mesh(variant_ckpts, tmp_path):
+    """Mesh restart-shape: an evicted tp=2 model rebuilds bit-exact from
+    the disk tier under its shard-qualified digests, checkpoint deleted
+    (content re-verification covers the qualified digest's content
+    suffix)."""
+    da, db, _ = variant_ckpts
+    ckpt_copy = str(tmp_path / "ckpt-a-tp2")
+    shutil.copytree(da, ckpt_copy)
+    disk = str(tmp_path / "pool-tier-tp2")
+    svc = _service(
+        ckpt_copy,
+        extra=f"--tensor-parallel-size 2 --pool-disk-dir {disk} "
+        "--pool-disk-mib 64",
+    )
+    try:
+        gold = _gen(svc)
+        svc.swap("tiny", checkpoint_dir=db)
+        svc._free_pooled(svc.model_pool.drain(), "test eviction")
+        assert os.listdir(disk), "mesh eviction must spill chunks"
+        shutil.rmtree(ckpt_copy)
+
+        out = svc.swap("tiny", checkpoint_dir=ckpt_copy)
+        assert out["swapped"] and out["tier"] == "disk"
+        assert _gen(svc) == gold, "tp=2 disk-tier rebuild not bit-exact"
+    finally:
+        svc.shutdown()
+
+
 def test_service_content_hash_off_disables_delta(variant_ckpts):
     da, db, _ = variant_ckpts
     svc = _service(da, extra="--content-hash off")

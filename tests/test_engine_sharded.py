@@ -8,6 +8,14 @@ from llm_d_fast_model_actuation_tpu.engine import EngineConfig, InferenceEngine
 from llm_d_fast_model_actuation_tpu.engine.sleep import attach_sleep
 from llm_d_fast_model_actuation_tpu.models import llama
 from llm_d_fast_model_actuation_tpu.parallel.mesh import MeshPlan, make_mesh
+from llm_d_fast_model_actuation_tpu.utils.compat import (
+    pallas_interpret_supported,
+)
+
+needs_pallas = pytest.mark.skipif(
+    not pallas_interpret_supported(),
+    reason="this jaxlib cannot run Pallas interpret mode on CPU",
+)
 
 
 @pytest.fixture(scope="module")
@@ -73,13 +81,14 @@ def test_pipeline_decode_matches_on_tp_mesh(tp2_mesh):
 
 # -- token-packed (mixed-batch) serving on a sharded mesh ---------------------
 #
-# --packed-serving composes with --tensor-parallel-size now: the mixed
-# program's ragged attention routes through the XLA twin (GSPMD-
-# partitioned gather/scatter; ops/attention.py:resolve_ragged_impl) and
-# the device-resident scheduler state — counts/bias maintained by the
-# program, page table sliced in-program — works unchanged on sharded
-# params. These ride the `ragged` CI gate with the single-device
-# equivalence suite (tests/test_ragged.py).
+# --packed-serving composes with --tensor-parallel-size: the mixed
+# program's ragged attention routes per the device-kind x mesh x impl
+# matrix (ops/attention.py:resolve_ragged_impl — the Pallas kernel's
+# shard_map port for pallas engines, the GSPMD-partitioned XLA twin
+# otherwise) and the device-resident scheduler state — counts/bias
+# maintained by the program, page table sliced in-program — works
+# unchanged on sharded params. These ride the `ragged` CI gate with the
+# single-device equivalence suite (tests/test_ragged.py).
 
 MIXED_PROMPTS = [
     [1, 2, 3, 4, 5],
@@ -99,6 +108,40 @@ def test_packed_matches_bucketed_on_tp_mesh(tp2_mesh):
     got = eng.generate(MIXED_PROMPTS, max_new_tokens=8)
     assert got == gold
     assert eng.packed_steps > 0  # the mixed program actually ran
+
+
+@pytest.mark.ragged
+@needs_pallas
+def test_packed_pallas_shard_map_matches_bucketed_on_tp_mesh(tp2_mesh):
+    """The shard_map ragged kernel through the full engine: a pallas
+    packed engine on a 2-device CPU mesh (interpret mode) must generate
+    bit-exact greedy outputs vs the bucketed mesh engine AND vs the
+    single-device pallas packed engine — the mesh acceptance bar for
+    the kernel port, mixed lengths and retire/re-admit edges included.
+    The packer must keep RAGGED_BLOCK alignment on meshes (each
+    shard_map shard replays the same block metadata).
+
+    Window is 6 tokens, matching the single-device cross-impl test
+    (test_ragged.py::test_packed_greedy_across_attention_impls): the
+    kernel's online softmax and the twin reduce in different orders,
+    so a long enough greedy run on the random-init tiny model can hit
+    an argmax near-tie (the documented caveat, docs/perf.md); the
+    kernel-identity tests pin the math to tolerance."""
+    from llm_d_fast_model_actuation_tpu.ops.attention import RAGGED_BLOCK
+
+    gold = make_engine(tp2_mesh).generate(MIXED_PROMPTS, max_new_tokens=6)
+    eng = make_engine(
+        tp2_mesh, packed_serving=True, attention_impl="pallas"
+    )
+    assert eng.programs.mixed_impl == "pallas"
+    assert eng._pack_align == RAGGED_BLOCK
+    got = eng.generate(MIXED_PROMPTS, max_new_tokens=6)
+    assert got == gold
+    assert eng.packed_steps > 0
+    single = make_engine(
+        None, packed_serving=True, attention_impl="pallas"
+    ).generate(MIXED_PROMPTS, max_new_tokens=6)
+    assert got == single
 
 
 @pytest.mark.ragged

@@ -155,6 +155,81 @@ def test_ragged_pallas_matches_reference(
 
 
 @needs_pallas
+@pytest.mark.parametrize(
+    "heads,kv_heads,head_dim,page_size,pages_per_seq",
+    [
+        (4, 2, 16, 8, 4),
+        (8, 8, 32, 16, 2),  # MHA (group=1)
+        (8, 2, 64, 8, 3),  # GQA 4x
+    ],
+)
+def test_ragged_pallas_sharded_matches_twin_tp2(
+    heads, kv_heads, head_dim, page_size, pages_per_seq
+):
+    """The shard_map port on a 2-device CPU mesh (interpret mode): each
+    shard runs the single-device kernel over its own head slice of the
+    page pool — outputs must match the XLA twin across the same
+    GQA/page geometries the single-device identity test covers, and the
+    output must come back sharded over the query heads."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from llm_d_fast_model_actuation_tpu.ops.pallas import (
+        ragged_paged_attention_pallas_sharded,
+    )
+    from llm_d_fast_model_actuation_tpu.parallel.mesh import (
+        MeshPlan,
+        make_mesh,
+    )
+
+    mesh = make_mesh(MeshPlan(dp=1, tp=2), jax.devices()[:2])
+    q, kp, vp, pt, row_slot, positions, B = _pack_scenario(
+        jax.random.key(3), heads, kv_heads, head_dim, page_size,
+        pages_per_seq,
+    )
+    want = attn.ragged_paged_attention(q, kp, vp, pt, row_slot, positions)
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, "tp", None)))
+    kps = jax.device_put(kp, NamedSharding(mesh, P(None, None, "tp", None)))
+    vps = jax.device_put(vp, NamedSharding(mesh, P(None, None, "tp", None)))
+    got = ragged_paged_attention_pallas_sharded(
+        mesh, qs, kps, vps, pt, row_slot, positions,
+        block_rows=B, interpret=True,
+    )
+    assert got.sharding.spec == P(None, "tp")  # heads stay sharded
+    valid = np.asarray(row_slot) >= 0
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid],
+        atol=2e-5, rtol=2e-5,
+    )
+    # the dispatcher routes mesh + pallas through the shard_map port
+    got2 = attn.ragged_paged_attention(
+        qs, kps, vps, pt, row_slot, positions, impl="pallas", mesh=mesh
+    )
+    np.testing.assert_allclose(
+        np.asarray(got2)[valid], np.asarray(want)[valid],
+        atol=2e-5, rtol=2e-5,
+    )
+
+
+def test_resolve_ragged_impl_routing_matrix():
+    """The one-place routing decision (device kind x mesh x impl flag):
+    non-pallas impls pass through everywhere; pallas keeps the kernel on
+    meshes where it can run (shard_map port; interpret mode on capable
+    CPU builds) and falls back to the XLA twin only where it can't."""
+    from llm_d_fast_model_actuation_tpu.parallel.mesh import (
+        MeshPlan,
+        make_mesh,
+    )
+
+    mesh = make_mesh(MeshPlan(dp=1, tp=2), jax.devices()[:2])
+    for impl in ("reference", "grouped"):
+        assert attn.resolve_ragged_impl(impl, None) == impl
+        assert attn.resolve_ragged_impl(impl, mesh) == impl
+    assert attn.resolve_ragged_impl("pallas", None) == "pallas"
+    want = "pallas" if pallas_interpret_supported() else "grouped"
+    assert attn.resolve_ragged_impl("pallas", mesh) == want
+
+
+@needs_pallas
 def test_ragged_pallas_bf16_io_fp32_math():
     q, kp, vp, pt, row_slot, positions, B = _pack_scenario(
         jax.random.key(2), 4, 2, 32, 8, 2
