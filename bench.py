@@ -913,6 +913,36 @@ def _ensure_synthetic_hf_ckpt(
     return ckpt_dir
 
 
+def _pred_vs_actual(pairs) -> dict:
+    """Score cost-oracle predictions (service.price_swap) against the
+    swaps they priced. ``pairs`` is [(prediction, swap result), ...] —
+    one leg aggregates both directions of a swap cycle, so sub-ms wall
+    noise on tiny transfers halves. Byte prediction is deterministic
+    from digests/shapes (bytes_exact must hold per swap for the delta
+    and quant legs — the CI gate); seconds are bandwidth-EWMA
+    estimates."""
+    pb = sum(p.get("predicted_bytes", 0) for p, _ in pairs)
+    ab = sum(o.get("bytes_moved", 0) for _, o in pairs)
+    ps = sum(p.get("predicted_s", 0.0) for p, _ in pairs)
+    as_ = sum(o.get("swap_total_s", 0.0) for _, o in pairs)
+    return {
+        "tier": pairs[0][0].get("tier"),
+        "swaps": len(pairs),
+        "predicted_bytes": pb,
+        "actual_bytes": ab,
+        "bytes_exact": all(
+            p.get("predicted_bytes") == o.get("bytes_moved")
+            for p, o in pairs
+        ),
+        "predicted_s": round(ps, 6),
+        "actual_s": round(as_, 6),
+        "seconds_error_ratio": round((ps - as_) / as_, 4)
+        if as_ > 0
+        else None,
+        "measured": all(bool(p.get("measured")) for p, _ in pairs),
+    }
+
+
 def _ensure_tiny_hf_ckpt() -> str:
     """A tiny sharded HF llama checkpoint for the swap warmup probe
     (the coldload sub-bench's synthetic checkpoint, smaller)."""
@@ -1110,8 +1140,12 @@ def _measure_swap_recovery() -> None:
 
     def _variant_cycle(extra_opts: str):
         """gold gen on base -> cold swap to the variant -> pool-hit swap
-        back to base (the measured sibling swap) -> park both. Returns
-        (sibling swap metrics, swap wall s, ttft s, bit_exact, pool)."""
+        back to base (the measured sibling swap) -> a SECOND sibling
+        swap priced by the cost oracle first (the EWMAs are primed by
+        the warm-up swap, so predicted bytes must match exactly and
+        predicted seconds closely) -> park both. Returns (sibling swap
+        metrics, swap wall s, ttft s, bit_exact, pool,
+        predicted_vs_actual)."""
         svc_n = EngineService(parse_engine_options(vopts + extra_opts))
         try:
             first_token_s(svc_n)
@@ -1127,14 +1161,22 @@ def _measure_swap_recovery() -> None:
             toks = svc_n.submit([1, 2, 3], 4, 0.0).result(
                 timeout=120
             ).out_tokens
+            # priced-before-bytes probe (GET /v1/costs semantics,
+            # docs/operations.md "Pricing an actuation"): both
+            # directions of a second sibling cycle, each priced first
+            pred = svc_n.price_swap("tiny", checkpoint_dir=ck_var)
+            out2 = svc_n.swap("tiny", checkpoint_dir=ck_var)
+            pred3 = svc_n.price_swap("tiny", checkpoint_dir=ck_base)
+            out3 = svc_n.swap("tiny", checkpoint_dir=ck_base)  # back
+            pva = _pred_vs_actual([(pred, out2), (pred3, out3)])
             svc_n.swap("tiny-gemma")  # park base too: both variants pooled
             pool = svc_n.model_pool.describe()
-            return out, sib_swap_s, sib_ttft_s, toks == gold, pool
+            return out, sib_swap_s, sib_ttft_s, toks == gold, pool, pva
         finally:
             svc_n.shutdown()
 
-    v_out, v_swap_s, v_ttft_s, v_exact, v_pool = _variant_cycle("")
-    f_out, f_swap_s, f_ttft_s, f_exact, _ = _variant_cycle(
+    v_out, v_swap_s, v_ttft_s, v_exact, v_pool, v_pva = _variant_cycle("")
+    f_out, f_swap_s, f_ttft_s, f_exact, _, f_pva = _variant_cycle(
         " --content-hash off"
     )
     v_full = v_out["bytes_out"] + v_out["bytes_in"]
@@ -1188,19 +1230,26 @@ def _measure_swap_recovery() -> None:
                 else 0.0
             )
             c1, _ = gen(svc_q, 8)
-            svc_q.swap("tiny-gemma")
-            svc_q.swap("tiny")
+            # second quantized cycle, both directions priced before the
+            # bytes move: the first cycle primed the EWMAs (and paid the
+            # one-time quantize-op compiles), so this is the oracle's
+            # steady state
+            predg = svc_q.price_swap("tiny-gemma")
+            outg = svc_q.swap("tiny-gemma")
+            predt = svc_q.price_swap("tiny")
+            outt = svc_q.swap("tiny")
+            pva = _pred_vs_actual([(predg, outg), (predt, outt)])
             c2, _ = gen(svc_q, 8)
-            return out, ttft, equal, diff, c1 == c2
+            return out, ttft, equal, diff, c1 == c2, pva
         finally:
             svc_q.shutdown()
 
-    q_fp_out, q_fp_ttft, _, _, _ = _quant_cycle("")
-    q8_out, q8_ttft, q8_equal, q8_diff, q8_stable = _quant_cycle(
+    q_fp_out, q_fp_ttft, _, _, _, _ = _quant_cycle("")
+    q8_out, q8_ttft, q8_equal, q8_diff, q8_stable, q8_pva = _quant_cycle(
         "--sleep-quant int8 --sleep-quant-hot-head off"
     )
-    q8h_out, _, q8h_equal, _, _ = _quant_cycle("--sleep-quant int8")
-    qf8_out, _, qf8_equal, qf8_diff, qf8_stable = _quant_cycle(
+    q8h_out, _, q8h_equal, _, _, _ = _quant_cycle("--sleep-quant int8")
+    qf8_out, _, qf8_equal, qf8_diff, qf8_stable, _ = _quant_cycle(
         "--sleep-quant fp8 --sleep-quant-hot-head off"
     )
     fp_moved = q_fp_out["bytes_moved"]
@@ -1319,6 +1368,17 @@ def _measure_swap_recovery() -> None:
             "fp8_greedy_equal": qf8_equal,
             "fp8_logit_max_abs_diff": round(qf8_diff, 6),
             "fp8_cycle_stable": qf8_stable,
+            # cost-oracle probe (utils/costs.py; docs/operations.md
+            # "Pricing an actuation"): each leg's swap priced BEFORE the
+            # bytes moved — byte prediction must be exact for the delta
+            # and int8 legs (deterministic from digests/shapes; the CI
+            # gate), seconds are bandwidth-EWMA estimates scored by
+            # seconds_error_ratio
+            "predicted_vs_actual": {
+                "full": f_pva,
+                "delta": v_pva,
+                "int8": q8_pva,
+            },
         },
     }
     if _trace_out_path():
@@ -1764,6 +1824,15 @@ def _measure_fleet() -> None:
             "engine_stats": engine_stats
             if isinstance(engine_stats, dict)
             else {},
+            # cost-oracle accuracy over the fleet run (the /v1/stats
+            # costs block): per-kind bandwidth EWMAs + last-N prediction
+            # error — how well the scheduler brain could have priced the
+            # actuations this harness forced
+            "oracle_costs": (
+                engine_stats.get("costs")
+                if isinstance(engine_stats, dict)
+                else None
+            ),
             "fleet": fleet_block,
             "launcher_fleet_metrics_present": (
                 isinstance(launcher_metrics, str)

@@ -322,6 +322,20 @@ class ChunkStore:
         :meth:`release_deferred`)."""
         return self._spill(digest, data)
 
+    def peek_tier(self, digest: str) -> Optional[str]:
+        """Which tier could serve `digest` right now — ``"host"`` (a live
+        refcounted chunk), ``"disk"`` (a spilled blob is registered; its
+        content verify still happens at fetch time), or None (miss) —
+        WITHOUT reading, verifying, or touching LRU order. The cost
+        oracle's tier probe: pricing an actuation must never consume the
+        state it prices (``GET /v1/costs``)."""
+        with self._mu:
+            if digest in self._chunks:
+                return "host"
+            if self._disk_enabled() and digest in self._disk:
+                return "disk"
+        return None
+
     def fetch(self, digest: str) -> Optional[np.ndarray]:
         """Resolve a digest: host tier first (zero-copy — the array a
         sibling variant still references), then a verified disk-tier

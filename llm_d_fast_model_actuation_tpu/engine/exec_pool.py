@@ -436,6 +436,11 @@ class ExecutablePool:
         self.evictions = 0
         self.spill_hits = 0
         self.spill_errors = 0
+        # running compile-cost figures (survive evictions): what the cost
+        # oracle uses as the per-program compile estimate for a swap whose
+        # warmup cannot hide everything (utils/costs.py; GET /v1/costs)
+        self.compiles_total = 0
+        self.compile_s_total = 0.0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -500,6 +505,12 @@ class ExecutablePool:
         nb = int(nbytes if nbytes is not None else executable_nbytes(compiled))
         entry = ExecEntry(key=key, compiled=compiled, nbytes=nb,
                           compile_s=compile_s)
+        if compile_s > 0:
+            # a genuinely-compiled entry (pool/spill hits pass 0): feed
+            # the running mean the cost oracle estimates compiles from
+            with self._mu:
+                self.compiles_total += 1
+                self.compile_s_total += float(compile_s)
         if self.budget_bytes <= 0:
             # pooling disabled: drop outright — no write-through spill (a
             # spilled blob would come back as a disk hit on the next get,
@@ -560,6 +571,13 @@ class ExecutablePool:
             "spill_hits": self.spill_hits,
             "spill_errors": self.spill_errors,
             "spill_dir": self.spill_dir if self._spill_enabled() else "",
+            "compiles_total": self.compiles_total,
+            "compile_s_total": round(self.compile_s_total, 6),
+            "mean_compile_s": round(
+                self.compile_s_total / self.compiles_total, 6
+            )
+            if self.compiles_total
+            else 0.0,
         }
 
     # -- spill ----------------------------------------------------------------

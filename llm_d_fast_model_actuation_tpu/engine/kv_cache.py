@@ -25,6 +25,44 @@ class PagePool:
     k_pages: jnp.ndarray
     v_pages: jnp.ndarray
 
+    @staticmethod
+    def pool_shape(
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+    ) -> Tuple[int, int, int, int, int]:
+        """The per-direction (k or v) pool array shape — the ONE
+        definition shared by :meth:`create` and :meth:`estimate_nbytes`
+        (the cost oracle sizes a not-yet-built pool from it)."""
+        return (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+
+    @classmethod
+    def estimate_nbytes(
+        cls,
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+    ) -> int:
+        """Device bytes a :meth:`create` with these arguments allocates
+        (k + v), without allocating — what the actuation cost oracle
+        counts into cold-tier predictions (engine/server.py
+        _kv_pool_nbytes), kept here so a pool-layout change can never
+        silently drift the prediction from the build's bytes_in."""
+        import numpy as np
+
+        shape = cls.pool_shape(
+            num_layers, num_pages, page_size, num_kv_heads, head_dim
+        )
+        elems = 1
+        for d in shape:
+            elems *= int(d)
+        return 2 * elems * int(np.dtype(dtype).itemsize)
+
     @classmethod
     def create(
         cls,
@@ -36,7 +74,9 @@ class PagePool:
         dtype: Any = jnp.bfloat16,
         mesh: Optional[Mesh] = None,
     ) -> "PagePool":
-        shape = (num_layers, num_pages, page_size, num_kv_heads, head_dim)
+        shape = cls.pool_shape(
+            num_layers, num_pages, page_size, num_kv_heads, head_dim
+        )
         if mesh is not None:
             sharding = NamedSharding(mesh, P(None, None, None, "tp", None))
             zeros = jax.jit(

@@ -182,6 +182,64 @@ class HostModelPool:
 
     # -- take / put -----------------------------------------------------------
 
+    def peek(self, model_id: str) -> Optional[PoolEntry]:
+        """Non-consuming :meth:`take`: the entry stays pooled, LRU order
+        and hit/miss counters untouched. The cost oracle prices pooled
+        candidates through this — pricing must never change pool state.
+        The returned entry is live and may be taken by a concurrent
+        swap; callers treat it as an advisory snapshot."""
+        with self._mu:
+            return self._entries.get(model_id)
+
+    def peek_match(self, model_id: str) -> Optional[PoolEntry]:
+        """Non-consuming :meth:`take_match` (same key-or-qualified rule,
+        most recently parked first)."""
+        with self._mu:
+            for key in reversed(self._entries):
+                if key == model_id or key.startswith(model_id + "@"):
+                    return self._entries[key]
+        return None
+
+    def peek_staged(self, key: str) -> Optional[Tuple[int, str, int]]:
+        """Non-consuming tier probe of an evicted model's manifest:
+        ``(nbytes, tier, chunks)`` where tier is ``"host"`` (every chunk
+        still DRAM-resident via a sibling's references) or ``"disk"`` (at
+        least one chunk would need a verified disk reload), or None when
+        there is no manifest or any chunk is a miss on both tiers (a
+        rebuild would fall through to a cold load). Unlike
+        :meth:`take_staged` this never pops the manifest, reads no file,
+        and rebuilds nothing — the cost oracle's pre-transfer pricing."""
+        with self._mu:
+            manifest = self._manifests.get(key)
+        if manifest is None or self.chunks is None:
+            return None
+        digests, nbytes = manifest
+        tier = "host"
+        for d in digests.values():
+            t = self.chunks.peek_tier(d)
+            if t is None:
+                return None
+            if t == "disk":
+                tier = "disk"
+        return int(nbytes), tier, len(digests)
+
+    def peek_staged_match(
+        self, model_id: str
+    ) -> Optional[Tuple[str, int, str, int]]:
+        """:meth:`peek_staged` under any checkpoint qualifier (most
+        recently evicted first); returns (key, nbytes, tier, chunks)."""
+        with self._mu:
+            keys = [
+                k
+                for k in reversed(self._manifests)
+                if k == model_id or k.startswith(model_id + "@")
+            ]
+        for k in keys:
+            got = self.peek_staged(k)
+            if got is not None:
+                return k, got[0], got[1], got[2]
+        return None
+
     def take(self, model_id: str) -> Optional[PoolEntry]:
         """Remove and return the entry for ``model_id`` (a pool hit — the
         caller wakes it, so it leaves the pool), or None (miss)."""

@@ -226,6 +226,34 @@ ENGINE_ACTUATION_BYTES = Gauge(
     ["mode", "dir"],  # mode: off | int8 | fp8; dir: d2h | h2d
 )
 
+# Actuation cost oracle + decision flight recorder (docs/operations.md
+# "Pricing an actuation"; utils/costs.py): durations next to the byte
+# counter above — bytes without seconds can't validate the oracle from
+# Prometheus alone — plus the last prediction per kind and how wrong it
+# was. The scheduler-brain's cost telemetry (ROADMAP item 1).
+ENGINE_ACTUATION_SECONDS = Histogram(
+    "fma_engine_actuation_seconds",
+    "Actuation wall seconds by kind and phase (phase=d2h/h2d are the "
+    "transfer windows; total is the whole verb incl. overlap/commit)",
+    ["kind", "phase"],  # kind: swap | sleep | wake; phase: d2h | h2d | total
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60, 120),
+)
+ENGINE_PREDICTED_BYTES = Gauge(
+    "fma_engine_actuation_predicted_bytes",
+    "Last actuation's oracle-predicted wire bytes, by kind (compare "
+    "against fma_engine_actuation_bytes increments: byte prediction is "
+    "deterministic from digests/shapes, so any drift is a bug signal)",
+    ["kind"],
+)
+ENGINE_COST_ERROR = Gauge(
+    "fma_engine_cost_prediction_error_ratio",
+    "Signed relative error (predicted-actual)/actual of the last "
+    "actuation's predicted seconds, by kind — the oracle's live "
+    "accuracy score (only set when the prediction used measured "
+    "bandwidth)",
+    ["kind"],
+)
+
 # Self-healing observability (docs/operations.md "Self-healing and fault
 # drills"): every recovery edge — a swap failure rolled back in-process, or
 # a rollback that itself failed and flipped /health — is counted, so an
@@ -922,6 +950,20 @@ class EngineService:
         self._arrival = _RateEWMA(
             getattr(args, "arrival_ewma_tau_s", 30.0) or 30.0
         )
+        # Actuation cost oracle + decision flight recorder
+        # (utils/costs.py; docs/operations.md "Pricing an actuation"):
+        # per-kind bandwidth EWMAs fed by every transfer path
+        # (sleep/wake/swap windows via the SleepManager's on_transfer
+        # hook, cold loads via LoadStats.transfer_figures) — surviving
+        # across actuations here — plus the bounded ring of
+        # predicted-vs-actual records GET /v1/actuations serves.
+        from ..utils.costs import CostBook
+
+        self.costs = CostBook(
+            capacity=int(
+                os.environ.get("FMA_FLIGHT_RECORDER_CAP", "512") or 512
+            )
+        )
         # Fault-injection arming (utils/faults.py): env first, then the
         # flag — both before the first build so coldload points can fire
         # on the initial model too.
@@ -1108,6 +1150,22 @@ class EngineService:
                     args.model, getattr(args, "checkpoint_dir", "") or ""
                 )
             )
+        # first flight-recorder row: the initial cold build — trigger
+        # "restart" when a supervising launcher re-spawned this child
+        # (launcher/instance.py stamps FMA_RESTARTED around the fork), so
+        # the recorder distinguishes crash-loop churn from client-driven
+        # actuation
+        self._record_actuation(
+            "coldload",
+            args.model,
+            trigger=(
+                "restart" if os.environ.get("FMA_RESTARTED") else "startup"
+            ),
+            tier="cold",
+            pred=None,
+            actual_bytes=self._last_build_stats.get("bytes_in", 0),
+            actual_s=self._last_build_stats.get("h2d_s", 0.0),
+        )
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
         mode = getattr(args, "sleep_release_devices", "auto")
@@ -1597,6 +1655,13 @@ class EngineService:
             build_stats["h2d_s"] = ckpt_stats.get(
                 "restore_s", time.monotonic() - t_load0
             )
+            import jax as _jax
+
+            self.costs.observe_transfer(
+                "coldload.h2d",
+                sum(x.nbytes for x in _jax.tree.leaves(params)),
+                build_stats["h2d_s"],
+            )
         elif hf_dir or staged_params is not None:
             from ..models import hf as hf_models
 
@@ -1666,6 +1731,8 @@ class EngineService:
                 overlap_s=lstats.overlap_s,
                 overlap_frac=lstats.overlap_frac,
             )
+            for kind, b, s in lstats.transfer_figures():
+                self.costs.observe_transfer(kind, b, s)
         import jax  # deliberately not module-level: parse-time must not touch a backend
 
         engine = InferenceEngine(
@@ -1714,7 +1781,19 @@ class EngineService:
             bucket_bytes=self._swap_bucket_bytes,
             quant_mode=self._sleep_quant,
             quant_hot_head=self._sleep_quant_hot_head,
+            on_transfer=self.costs.observe_transfer,
         )
+        if self._sleep_quant != "off" and not self.is_gang:
+            # move the quantize/dequantize op compiles off the first
+            # actuation's transfer window (and out of the cost oracle's
+            # first bandwidth measurements) — the build already pays
+            # compile time, this rides with it
+            try:
+                sleeper.warm_quant_ops()
+            except Exception:  # noqa: BLE001 — warmup is best-effort
+                logger.warning(
+                    "transfer-quant op warmup failed", exc_info=True
+                )
         self.builds_total += 1
         return _ModelRuntime(
             model_id=model_id,
@@ -1774,24 +1853,515 @@ class EngineService:
         with self._slo_mu:
             self._arrival = _RateEWMA(self._arrival.tau_s)
 
+    # -- actuation cost oracle (GET /v1/costs; docs/operations.md
+    # "Pricing an actuation") ------------------------------------------------
+
+    def _model_cfg_cheap(self, model_id: str):
+        """Model config for `model_id` WITHOUT the tokenizer load
+        ``_resolve_model`` pays: pricing every candidate in one
+        /v1/costs call must stay cheap (config.json read for hf:,
+        factory call for named configs)."""
+        if model_id.startswith("hf:"):
+            from ..models import hf as hf_models
+
+            return hf_models.config_from_hf(
+                model_id[3:], quantization=self.args.quantization or ""
+            )
+        if model_id not in MODEL_CONFIGS:
+            raise ValueError(f"unknown model {model_id!r}")
+        model_cfg = MODEL_CONFIGS[model_id]()
+        if (
+            self.args.quantization
+            and model_cfg.quantization != self.args.quantization
+        ):
+            import dataclasses
+
+            model_cfg = dataclasses.replace(
+                model_cfg, quantization=self.args.quantization
+            )
+        return model_cfg
+
+    def _kv_pool_nbytes(self, model_cfg) -> int:
+        """Device bytes of the KV page pool a runtime for `model_cfg`
+        creates — counted in a cold build's ``bytes_in``, so the
+        oracle's cold predictions must count it identically (the layout
+        lives in ONE place: PagePool.estimate_nbytes)."""
+        from .kv_cache import PagePool
+
+        return PagePool.estimate_nbytes(
+            model_cfg.num_layers,
+            self.args.num_pages,
+            self.args.page_size,
+            model_cfg.num_kv_heads,
+            model_cfg.head_dim,
+            dtype=model_cfg.dtype,
+        )
+
+    def _offload_wire_bytes(self) -> int:
+        """Wire bytes a level-1 offload of the CURRENT runtime would
+        move d2h — payload bytes for --sleep-quant-eligible leaves,
+        priced from shapes alone (models/quant.payload_nbytes)."""
+        import jax
+
+        from ..models import quant as transfer_quant
+
+        state = self.sleeper._peek_state()
+        leaves = jax.tree.leaves(state)
+        plan = self.sleeper._quant_plan(state)
+        if not plan:
+            return sum(x.nbytes for x in leaves)
+        mode = self.sleeper.quant_mode
+        return sum(
+            transfer_quant.payload_nbytes(x.shape, mode) if f else x.nbytes
+            for x, f in zip(leaves, plan)
+        )
+
+    def price_swap(
+        self,
+        model: str,
+        checkpoint_dir: str = "",
+        _offload_wire: Optional[int] = None,
+        _exec_desc: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Pre-transfer pricing of a hot-swap to `model`: predicted wire
+        bytes (exact-by-construction for pool-hit delta/quant swaps —
+        the dry-run shares ``swap_states``'s planner; shape/manifest
+        estimates for the cold tiers) and predicted seconds (bytes ÷
+        the measured per-kind bandwidth EWMAs). Read-only and
+        lock-free: nothing is consumed, nothing moves — concurrent
+        actuations make the answer advisory, never wrong-state."""
+        if model.startswith("hf:"):
+            if not model[3:]:
+                raise ValueError("swap model hf: needs a directory path")
+        elif model not in MODEL_CONFIGS:
+            raise ValueError(
+                f"unknown model {model!r}; known: "
+                f"{sorted(MODEL_CONFIGS)} or hf:<model-dir>"
+            )
+        book = self.costs.bandwidths
+        out: Dict[str, Any] = {
+            "kind": "swap",
+            "model": model,
+            "checkpoint_dir": checkpoint_dir,
+        }
+        if model == self.args.model and (
+            not checkpoint_dir or checkpoint_dir == self.checkpoint_dir
+        ):
+            return {
+                **out,
+                "tier": "resident",
+                "predicted_bytes": 0,
+                "predicted_bytes_out": 0,
+                "predicted_bytes_in": 0,
+                "predicted_s": 0.0,
+                "measured": True,
+            }
+        entry = (
+            self.model_pool.peek(_pool_key(model, checkpoint_dir))
+            if checkpoint_dir
+            else self.model_pool.peek_match(model)
+        )
+        prefetched = entry is not None and isinstance(
+            entry.runtime, _PrefetchedWeights
+        )
+        # costs_view prices many candidates in one call; the outgoing
+        # runtime and exec pool are the same for all of them, so it
+        # precomputes these once and passes them down
+        exec_desc = (
+            _exec_desc
+            if _exec_desc is not None
+            else self.exec_pool.describe()
+        )
+        compile_est = exec_desc.get("mean_compile_s", 0.0)
+        if entry is not None and not prefetched:
+            # pool-hit slept runtime: the EXACT planner swap_states will
+            # run — byte prediction is deterministic from digests/shapes
+            from .sleep import plan_swap
+
+            p = plan_swap(
+                self.sleeper,
+                entry.runtime.sleeper,
+                bucket_bytes=self._swap_bucket_bytes,
+                out_digests=(
+                    self._runtime.digests if self._content_hash else None
+                ),
+                in_digests=(
+                    entry.runtime.digests if self._content_hash else None
+                ),
+                quant=self._sleep_quant,
+            )
+            out_s, m1 = book.seconds_for("swap.d2h", p["wire_out"])
+            in_s, m2 = book.seconds_for("swap.h2d", p["wire_in"])
+            if book.has("swap.total"):
+                # effective whole-verb bandwidth from prior pool-hit
+                # swaps: predicts the wall directly (fixed per-swap
+                # overhead included), which the per-window components
+                # can't see
+                predicted_s, m_tot = book.seconds_for(
+                    "swap.total", p["bytes_moved"]
+                )
+                m1 = m2 = m_tot
+            else:
+                # one-bucket swaps run the two directions sequentially;
+                # the double-buffered overlap needs >= 2 outgoing buckets
+                predicted_s = (
+                    max(out_s, in_s)
+                    if p["buckets_out"] > 1
+                    else out_s + in_s
+                )
+            return {
+                **out,
+                "tier": "pool",
+                "predicted_bytes": p["bytes_moved"],
+                "predicted_bytes_out": p["wire_out"],
+                "predicted_bytes_in": p["wire_in"],
+                "predicted_bytes_deduped": p["bytes_deduped"],
+                "predicted_deduped_leaves": p["deduped_leaves"],
+                "predicted_bytes_full": p["bytes_full"],
+                "quant": p["quant"],
+                "predicted_s": round(predicted_s, 6),
+                "predicted_d2h_s": round(out_s, 6),
+                "predicted_h2d_s": round(in_s, 6),
+                "measured": bool(m1 and m2),
+                # a slept runtime keeps its compiled programs: no compile
+                "compile_estimate_s": 0.0,
+            }
+        # Cold tiers: the outgoing leg is a level-1 offload of the
+        # current runtime; the incoming leg streams a host tree (staged /
+        # tier-rebuilt / checkpoint-read) and creates the KV pool — the
+        # same figures a cold build's bytes_in reports.
+        offload_wire = (
+            _offload_wire
+            if _offload_wire is not None
+            else self._offload_wire_bytes()
+        )
+        d2h_s, m_out = book.seconds_for("sleep.d2h", offload_wire)
+        model_cfg = self._model_cfg_cheap(model)
+        kv_bytes = self._kv_pool_nbytes(model_cfg)
+        read_bytes = 0
+        if prefetched:
+            tier = "prefetched"
+            stream_bytes = int(entry.nbytes)
+            params_full = stream_bytes
+            if entry.runtime.quant_metas is not None:
+                # staged payloads stream compressed; the built engine
+                # holds (and bytes_in reports) full-precision arrays
+                from ..models import hf as hf_models
+
+                params_full = hf_models.estimate_param_bytes(model_cfg)
+        else:
+            staged = None
+            if self._content_hash:
+                if checkpoint_dir:
+                    got = self.model_pool.peek_staged(
+                        _pool_key(model, checkpoint_dir)
+                    )
+                    staged = (
+                        None if got is None
+                        else (got[0], got[1])
+                    )
+                else:
+                    got = self.model_pool.peek_staged_match(model)
+                    staged = None if got is None else (got[1], got[2])
+            from ..models import hf as hf_models
+
+            params_full = hf_models.estimate_param_bytes(model_cfg)
+            if staged is not None:
+                nbytes, tier = staged
+                stream_bytes = int(nbytes)
+                if tier == "disk":
+                    read_bytes = stream_bytes
+            else:
+                tier = "cold"
+                stream_bytes = params_full
+                read_bytes = params_full
+        h2d_s, m_in = book.seconds_for("coldload.h2d", stream_bytes)
+        read_s, m_read = (0.0, True)
+        if read_bytes:
+            read_s, m_read = book.seconds_for("coldload.read", read_bytes)
+        # the streaming loaders overlap read with H2D; the offload runs
+        # first (sleep, then build)
+        predicted_s = d2h_s + max(h2d_s, read_s)
+        return {
+            **out,
+            "tier": tier,
+            # what the swap metrics will report as bytes_moved: the
+            # offload's wire bytes plus the build's bytes_in (streamed
+            # params at full precision once placed, plus the KV pool)
+            "predicted_bytes": offload_wire + params_full + kv_bytes,
+            "predicted_bytes_out": offload_wire,
+            "predicted_bytes_in": params_full + kv_bytes,
+            "predicted_stream_bytes": stream_bytes,
+            "predicted_s": round(predicted_s, 6),
+            "predicted_d2h_s": round(d2h_s, 6),
+            "predicted_h2d_s": round(h2d_s, 6),
+            "predicted_read_s": round(read_s, 6),
+            "measured": bool(m_out and m_in and m_read),
+            # first-touch compile rides under the transfer when AOT
+            # warmup is on (docs/perf.md "Warmup and the executable
+            # pool"); reported as its own estimate, not added to
+            # predicted_s
+            "compile_estimate_s": round(compile_est, 6),
+        }
+
+    def price_sleep(self) -> Dict[str, Any]:
+        """Predicted cost of a level-1 sleep of the current runtime."""
+        if self.sleeper.is_sleeping:
+            return {
+                "kind": "sleep",
+                "model": self.args.model,
+                "predicted_bytes": 0,
+                "predicted_s": 0.0,
+                "measured": True,
+            }
+        wire = self._offload_wire_bytes()
+        s, measured = self.costs.bandwidths.seconds_for("sleep.d2h", wire)
+        return {
+            "kind": "sleep",
+            "model": self.args.model,
+            "predicted_bytes": wire,
+            "predicted_s": round(s, 6),
+            "measured": measured,
+        }
+
+    def price_wake(self) -> Dict[str, Any]:
+        """Predicted cost of waking the current runtime: the slept host
+        payload's H2D for level 1, a checkpoint reload estimate for
+        level 2."""
+        sl = self.sleeper
+        if not sl.is_sleeping:
+            return {
+                "kind": "wake",
+                "model": self.args.model,
+                "predicted_bytes": 0,
+                "predicted_s": 0.0,
+                "measured": True,
+            }
+        if int(sl.level) == 1:
+            wire = sl.stats.bytes_offloaded
+            s, measured = self.costs.bandwidths.seconds_for(
+                "wake.h2d", wire
+            )
+            return {
+                "kind": "wake",
+                "model": self.args.model,
+                "predicted_bytes": wire,
+                "predicted_s": round(s, 6),
+                "measured": measured,
+            }
+        # level 2: the wake re-reads weights (reinit) — a cold load
+        model_cfg = self.engine.cfg.model
+        from ..models import hf as hf_models
+
+        est = hf_models.estimate_param_bytes(model_cfg)
+        h2d_s, m1 = self.costs.bandwidths.seconds_for("coldload.h2d", est)
+        read_s, m2 = self.costs.bandwidths.seconds_for(
+            "coldload.read", est
+        )
+        return {
+            "kind": "wake",
+            "model": self.args.model,
+            "predicted_bytes": est,
+            "predicted_s": round(max(h2d_s, read_s), 6),
+            "measured": bool(m1 and m2),
+        }
+
+    def costs_view(
+        self, extra: "tuple | list" = ()
+    ) -> Dict[str, Any]:
+        """GET /v1/costs: every candidate actuation priced in ONE call —
+        the resident model, every pooled/prefetched entry, every
+        tier-resolvable evicted manifest, plus caller-named extras —
+        with the bandwidth book behind the predictions. The scheduler's
+        cost input, next to /v1/stats (demand) and the launcher ledger
+        (state)."""
+        candidates: List[Dict[str, Any]] = []
+        seen = set()
+        # shared across every candidate: the outgoing leg is the same
+        # current runtime, so flatten/plan it once per view, not per row
+        exec_desc = self.exec_pool.describe()
+        try:
+            offload_wire: Optional[int] = self._offload_wire_bytes()
+        except Exception:  # noqa: BLE001 — e.g. sleeping: rows degrade per-candidate
+            offload_wire = None
+
+        def add(model: str, ckpt: str) -> None:
+            key = (model, ckpt)
+            if key in seen:
+                return
+            seen.add(key)
+            try:
+                candidates.append(
+                    self.price_swap(
+                        model, ckpt,
+                        _offload_wire=offload_wire,
+                        _exec_desc=exec_desc,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 — one bad row never 500s the view
+                candidates.append(
+                    {
+                        "model": model,
+                        "checkpoint_dir": ckpt,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                )
+
+        add(self.args.model, self.checkpoint_dir)
+        for key in self.model_pool.models():
+            name, _, ck = key.partition("@")
+            add(name, ck)
+        for key in self.model_pool.staged_keys():
+            name, _, ck = key.partition("@")
+            add(name, ck)
+        for model, ckpt in extra:
+            add(model, ckpt or "")
+        return {
+            "model": self.args.model,
+            "is_sleeping": self.sleeper.is_sleeping,
+            "quant": self._sleep_quant,
+            "content_hash": self._content_hash,
+            "bandwidth_gibps": self.costs.bandwidths.describe(),
+            "sleep": self.price_sleep(),
+            "wake": self.price_wake(),
+            "compile": {
+                "mean_compile_s": exec_desc.get("mean_compile_s", 0.0),
+                "compiles_total": exec_desc.get("compiles_total", 0),
+            },
+            "candidates": candidates,
+        }
+
+    def actuations_view(
+        self, n: int = 0, kind: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """GET /v1/actuations: the decision flight recorder — one
+        structured record per actuation this process performed, oldest
+        first, plus the oracle-accuracy summary /v1/stats mirrors."""
+        return {
+            "records": self.costs.recorder.records(n=n, kind=kind),
+            "summary": self.costs.recorder.summary(),
+        }
+
+    def _record_actuation(
+        self,
+        kind: str,
+        model: str,
+        trigger: str,
+        tier: str,
+        pred: Optional[Dict[str, Any]],
+        actual_bytes: int,
+        actual_s: float,
+        outcome: str = "committed",
+    ):
+        """Flight-recorder + metrics choke point: every actuation edge
+        lands one record (prediction attached when the oracle priced it
+        pre-transfer) and refreshes the per-kind prediction gauges."""
+        rec = self.costs.record(
+            kind=kind,
+            model=model,
+            trigger=trigger,
+            tier=tier,
+            outcome=outcome,
+            actual_bytes=actual_bytes,
+            actual_s=actual_s,
+            predicted_bytes=(
+                None if pred is None else pred.get("predicted_bytes")
+            ),
+            predicted_s=(
+                None if pred is None else pred.get("predicted_s")
+            ),
+            measured=bool(pred and pred.get("measured")),
+        )
+        if rec.predicted_bytes is not None:
+            ENGINE_PREDICTED_BYTES.labels(kind=kind).set(
+                rec.predicted_bytes
+            )
+        if rec.seconds_error_ratio is not None and rec.measured:
+            ENGINE_COST_ERROR.labels(kind=kind).set(
+                rec.seconds_error_ratio
+            )
+        return rec
+
     def swap(
         self, model: str, checkpoint_dir: str = "", request_id: str = ""
     ) -> Dict[str, Any]:
         """Traced entry for the hot-swap verb: the span adopts whatever
         context the caller established (the HTTP handler's remote
         ``traceparent``), so the engine-side swap tree hangs off the
-        launcher's RPC span in one coherent trace."""
+        launcher's RPC span in one coherent trace. The span carries the
+        oracle's pre-transfer prediction (``predicted_bytes`` /
+        ``predicted_s``), so every actuation trace records prediction
+        vs actual."""
+        pred: Optional[Dict[str, Any]] = None
+        try:
+            pred = self.price_swap(model, checkpoint_dir)
+        except Exception:  # noqa: BLE001 — pricing must never block the verb
+            pred = None
         with tracing.span(
             "engine.swap",
             model=model,
             previous=self.args.model,
             request_id=request_id,
         ) as sp:
-            out = self._swap_impl(model, checkpoint_dir, request_id)
+            if pred is not None:
+                sp.set(
+                    predicted_bytes=pred.get("predicted_bytes"),
+                    predicted_s=pred.get("predicted_s"),
+                    predicted_tier=pred.get("tier"),
+                )
+            def record_failure(outcome: str) -> None:
+                # the flight recorder must show every failed edge —
+                # crash-loop churn is exactly what it exists to audit
+                self._record_actuation(
+                    "swap", model, trigger="client",
+                    tier=pred.get("tier", "") if pred else "",
+                    pred=pred, actual_bytes=0, actual_s=0.0,
+                    outcome=outcome,
+                )
+
+            try:
+                out = self._swap_impl(model, checkpoint_dir, request_id)
+            except SwapRolledBack:
+                record_failure("rolled_back")
+                raise
+            except ValueError as e:
+                # usually a request rejection (unknown model, sleeping
+                # engine) — nothing actuated, nothing to record. But a
+                # cold BUILD can also raise ValueError subclasses after
+                # the outgoing model already slept and rolled back:
+                # _swap_impl marks those exceptions (the marker stays
+                # true across identical retries, where the degraded
+                # message alone would compare equal and hide the churn).
+                if getattr(e, "fma_swap_actuated", False):
+                    record_failure("failed")
+                raise
+            except Exception:
+                record_failure("failed")
+                raise
             sp.set(
                 pool_hit=bool(out.get("pool_hit")),
                 swapped=bool(out.get("swapped")),
             )
+            if out.get("swapped") and not out.get("replayed"):
+                for phase, key in (
+                    # the *_transfer_s keys carry the pure windows on
+                    # every tier (cold swaps' d2h_s is the whole
+                    # outgoing sleep verb)
+                    ("d2h", "d2h_transfer_s"),
+                    ("h2d", "h2d_transfer_s"),
+                    ("total", "swap_total_s"),
+                ):
+                    ENGINE_ACTUATION_SECONDS.labels(
+                        kind="swap", phase=phase
+                    ).observe(max(0.0, out.get(key, 0.0)))
+                rec = self._record_actuation(
+                    "swap", model, trigger="client",
+                    tier=out.get("tier", ""),
+                    pred=pred,
+                    actual_bytes=out.get("bytes_moved", 0),
+                    actual_s=out.get("swap_total_s", 0.0),
+                )
+                out["costs"] = rec.as_dict()
             return out
 
     def _swap_impl(
@@ -1919,6 +2489,10 @@ class EngineService:
                         ),
                         quant=self._sleep_quant,
                     )
+                    # swap_states's windows ARE the pure transfer
+                    # windows — the phase=d2h/h2d histogram figures
+                    metrics["d2h_transfer_s"] = metrics["d2h_s"]
+                    metrics["h2d_transfer_s"] = metrics["h2d_s"]
                 except ValueError:
                     # precondition rejections fire before any transfer:
                     # the pooled entry is still intact — put it back under
@@ -2015,13 +2589,17 @@ class EngineService:
                     warm.window_start = time.monotonic()
                 try:
                     self.sleeper.sleep(1)
-                except Exception:
+                except Exception as off_exc:
                     # the outgoing offload failed before the build even
                     # started: don't leave the warmup thread compiling for
                     # a swap that is already dead (each retry would kick
                     # another, stacking orphan compile threads)
                     if warm is not None:
                         warm.abort()
+                    # real actuation happened (a partial offload): the
+                    # flight recorder must see it even for ValueError-
+                    # class failures (see swap()'s handler)
+                    off_exc.fma_swap_actuated = True
                     raise
                 try:
                     if prefetched:
@@ -2051,6 +2629,12 @@ class EngineService:
                             resolved=resolved,
                         )
                 except Exception as build_exc:
+                    # the outgoing model already slept for this build:
+                    # whatever happens below (rollback ok or not), the
+                    # exception leaving this frame describes a FAILED
+                    # ACTUATION, never a request rejection — the flight
+                    # recorder keys off this marker (swap()'s handler)
+                    build_exc.fma_swap_actuated = True
                     if warm is not None:
                         # swap cancelled: stop compiling between programs
                         # (what already compiled stays pooled for a retry)
@@ -2131,6 +2715,12 @@ class EngineService:
                     "swap_total_s": 0.0,  # finalized below
                     "d2h_s": out_stats.last_sleep_seconds,
                     "h2d_s": b.get("h2d_s", 0.0),
+                    # the pure transfer windows for the phase histogram:
+                    # d2h_s above is the whole outgoing sleep verb
+                    # (quiesce included), which must not pollute the
+                    # "transfer window" percentiles
+                    "d2h_transfer_s": out_stats.last_sleep_transfer_s,
+                    "h2d_transfer_s": b.get("h2d_s", 0.0),
                     "overlap_s": b.get("overlap_s", 0.0),
                     "overlap_frac": b.get("overlap_frac", 0.0),
                     "bytes_out": out_stats.bytes_offloaded,
@@ -2877,6 +3467,11 @@ class EngineService:
                 "uptime_s": round(now - self.started_at, 3),
                 "is_sleeping": self.sleeper.is_sleeping,
             }
+        # cost-oracle summary (utils/costs.py): per-kind bandwidth EWMAs
+        # + last-N prediction accuracy — the fleet harness scores oracle
+        # accuracy from this row without a second endpoint, and the
+        # launcher's fleet rollup carries it into ledger.costs
+        out["costs"] = self.costs.summary()
         return out
 
     def submit(
@@ -2932,12 +3527,26 @@ class EngineService:
         self._new_work.set()
 
     def sleep(self, level: int) -> Dict[str, Any]:
+        pred: Optional[Dict[str, Any]] = None
+        try:
+            # price_sleep models the level-1 offload; a level-2 sleep
+            # discards state (bytes_offloaded = 0), so it stays unpriced
+            pred = self.price_sleep() if level == 1 else None
+        except Exception:  # noqa: BLE001 — pricing must never block the verb
+            pred = None
         with tracing.span(
             "engine.sleep", level=level, model=self.args.model
-        ):
-            return self._sleep_impl(level)
+        ) as sp:
+            if pred is not None:
+                sp.set(
+                    predicted_bytes=pred.get("predicted_bytes"),
+                    predicted_s=pred.get("predicted_s"),
+                )
+            return self._sleep_impl(level, pred=pred)
 
-    def _sleep_impl(self, level: int) -> Dict[str, Any]:
+    def _sleep_impl(
+        self, level: int, pred: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         if self.is_follower:
             # a follower can't unilaterally leave the collective loop; the
             # leader's broadcast sleeps the whole gang
@@ -3001,14 +3610,68 @@ class EngineService:
             # never an idempotent re-sent sleep, which moved nothing and
             # must not inflate the fleet rollup's actuations/hour
             self._bump_actuation("sleep")
+            sleep_s = out.get("last_sleep_seconds", 0.0)
+            if not was_sleeping:
+                # phase=d2h is the pure transfer window — observed only
+                # when a transfer actually ran (a level-2 sleep discards
+                # state; a 0.0 sample would drag the window percentiles
+                # toward zero); total is the whole verb
+                if int(self.sleeper.level) == 1:
+                    ENGINE_ACTUATION_SECONDS.labels(
+                        kind="sleep", phase="d2h"
+                    ).observe(
+                        max(0.0, self.sleeper.stats.last_sleep_transfer_s)
+                    )
+                ENGINE_ACTUATION_SECONDS.labels(
+                    kind="sleep", phase="total"
+                ).observe(max(0.0, sleep_s))
+            sleep_priced = (
+                not was_sleeping
+                and not self.is_gang
+                and int(self.sleeper.level) == 1
+            )
+            self._record_actuation(
+                "sleep",
+                self.args.model,
+                # an L1->L2 transition while already asleep is the
+                # escalation edge (host copy dropped), not a client-
+                # driven offload
+                trigger="escalation" if was_sleeping else "client",
+                tier="host" if int(self.sleeper.level) == 1 else "discard",
+                # escalations moved no new bytes, gang offloads stage
+                # per-shard, and L2 sleeps discard instead of offload:
+                # all outside the pricing model, recorded unpriced
+                pred=pred if sleep_priced else None,
+                actual_bytes=out.get("bytes_offloaded", 0),
+                # priced records score like-for-like against the pure
+                # offload window price_sleep models (the quiesce and a
+                # device release are outside it)
+                actual_s=(
+                    self.sleeper.stats.last_sleep_transfer_s
+                    if sleep_priced
+                    else (0.0 if was_sleeping else sleep_s)
+                ),
+            )
         self._publish_usage()
         return out
 
     def wake_up(self) -> Dict[str, Any]:
-        with tracing.span("engine.wake", model=self.args.model):
-            return self._wake_up_impl()
+        pred: Optional[Dict[str, Any]] = None
+        try:
+            pred = self.price_wake()
+        except Exception:  # noqa: BLE001 — pricing must never block the verb
+            pred = None
+        with tracing.span("engine.wake", model=self.args.model) as sp:
+            if pred is not None:
+                sp.set(
+                    predicted_bytes=pred.get("predicted_bytes"),
+                    predicted_s=pred.get("predicted_s"),
+                )
+            return self._wake_up_impl(pred=pred)
 
-    def _wake_up_impl(self) -> Dict[str, Any]:
+    def _wake_up_impl(
+        self, pred: Optional[Dict[str, Any]] = None
+    ) -> Dict[str, Any]:
         if self.is_follower:
             return {
                 "deferred": True,
@@ -3107,6 +3770,43 @@ class EngineService:
             # a wake on an already-awake engine is a no-op, not an
             # actuation the fleet rollup should charge for
             self._bump_actuation("wake")
+            wake_s = self.sleeper.stats.last_wake_seconds
+            # phase=h2d is the transfer window (client reacquisition
+            # excluded), observed only when a host payload actually
+            # moved — a level-2 wake reinitializes instead; total is
+            # the whole verb
+            wake_transfer_s = self.sleeper.stats.last_wake_transfer_s
+            if (was_l1 or self.is_gang) and wake_transfer_s > 0:
+                # only when a host payload actually moved: an L2 wake
+                # (incl. the gang case) reinitializes, and a 0.0 sample
+                # would drag the transfer-window percentiles toward zero
+                ENGINE_ACTUATION_SECONDS.labels(
+                    kind="wake", phase="h2d"
+                ).observe(wake_transfer_s)
+            ENGINE_ACTUATION_SECONDS.labels(
+                kind="wake", phase="total"
+            ).observe(max(0.0, wake_s))
+            priced = not self.is_gang and was_l1
+            self._record_actuation(
+                "wake",
+                self.args.model,
+                trigger="client",
+                tier="host" if was_l1 else "cold",
+                # gang wakes restore per-process staged shards and L2
+                # wakes reinitialize (actual h2d payload = 0): neither
+                # matches the single-process L1 pricing, so both record
+                # unpriced — a mismatched prediction would read as a
+                # false byte-exactness miss
+                pred=pred if priced else None,
+                actual_bytes=self.sleeper.stats.last_wake_bytes
+                if was_l1 or self.is_gang
+                else 0,
+                # a priced record scores the prediction like-for-like:
+                # the transfer window (what price_wake models — client
+                # reacquisition is deliberately outside it); unpriced
+                # records keep the whole-verb wall
+                actual_s=wake_transfer_s if priced else wake_s,
+            )
         self._publish_usage()
         self._new_work.set()
         return out
@@ -3350,6 +4050,37 @@ def build_app(service: EngineService) -> web.Application:
         """JSON lifecycle stats (GET /v1/stats): the launcher's fleet
         rollup polls this instead of scraping+parsing /metrics."""
         return web.json_response(service.stats())
+
+    async def costs_get(request: web.Request) -> web.Response:
+        """GET /v1/costs: every candidate actuation priced before any
+        byte moves (docs/operations.md "Pricing an actuation").
+        ``?model=X[&checkpoint_dir=D]`` adds an arbitrary target to the
+        candidate list. Pricing flattens weight trees, so it runs on the
+        executor, never the event loop."""
+        extras = []
+        model = request.query.get("model", "")
+        if model:
+            extras.append(
+                (model, request.query.get("checkpoint_dir", "") or "")
+            )
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: service.costs_view(extras)
+            )
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(out)
+
+    async def actuations_get(request: web.Request) -> web.Response:
+        """GET /v1/actuations: the decision flight recorder's ring —
+        ``?n=`` bounds the returned records (newest kept), ``?kind=``
+        filters by actuation kind."""
+        try:
+            n = int(request.query.get("n", "0") or 0)
+        except ValueError:
+            raise web.HTTPBadRequest(text="n must be an integer")
+        kind = request.query.get("kind") or None
+        return web.json_response(service.actuations_view(n=n, kind=kind))
 
     async def metrics(request: web.Request) -> web.Response:
         from prometheus_client import generate_latest
@@ -4074,6 +4805,8 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_get("/v1/profile", profile_status)
     app.router.add_get("/v1/models", models)
     app.router.add_get("/v1/stats", engine_stats)
+    app.router.add_get("/v1/costs", costs_get)
+    app.router.add_get("/v1/actuations", actuations_get)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/chat/completions", chat_completions)

@@ -147,6 +147,14 @@ class _Stats:
     last_quant: str = "off"
     #: wire bytes the last wake moved host->device
     last_wake_bytes: int = 0
+    #: the pure d2h transfer window of the last level-1 offload (the
+    #: engine quiesce and device release that last_sleep_seconds also
+    #: covers are excluded) — what the cost oracle's bandwidth EWMA and
+    #: the phase=d2h histogram observe
+    last_sleep_transfer_s: float = 0.0
+    #: the pure h2d window of the last wake (client reacquisition
+    #: excluded) — the phase=h2d / wake.h2d figure
+    last_wake_transfer_s: float = 0.0
     sleeps_total: int = 0
     wakes_total: int = 0
     releases_total: int = 0
@@ -175,11 +183,24 @@ class SleepManager:
         bucket_bytes: Optional[int] = None,
         quant_mode: str = "off",
         quant_hot_head: bool = True,
+        on_transfer: Optional[Callable[[str, int, float], None]] = None,
+        peek_state: Optional[Callable[[], Any]] = None,
     ) -> None:
         self._get_state = get_state
         self._set_state = set_state
         self._on_reacquire = on_reacquire
         self.bucket_bytes = bucket_bytes
+        #: cost-oracle feed (utils/costs.py): ``on_transfer(kind, bytes,
+        #: seconds)`` fires after each completed transfer window
+        #: (sleep.d2h / wake.h2d / swap.d2h / swap.h2d) with the WIRE
+        #: bytes and wall seconds that window actually took — the
+        #: measured GiB/s the pre-transfer pricing divides by. Best
+        #: effort: a raising callback never fails an actuation.
+        self.on_transfer = on_transfer
+        #: side-effect-free state reader for pricing (``plan_swap``):
+        #: the default ``get_state`` may quiesce the engine (drain an
+        #: in-flight decode chunk), which a dry-run must never do
+        self._peek_state = peek_state or get_state
         #: compressed actuation (docs/perf.md "Compressed actuation"):
         #: level-1 offloads quantize eligible weight leaves to int8/fp8 on
         #: device, only the payload crosses the boundary, and wake
@@ -219,6 +240,19 @@ class SleepManager:
     @property
     def devices_released(self) -> bool:
         return self._released
+
+    def _notify_transfer(
+        self, kind: str, nbytes: int, seconds: float
+    ) -> None:
+        """Feed one completed transfer window to the cost oracle's
+        bandwidth EWMAs; zero-byte / zero-time windows and callback
+        failures are dropped (telemetry must never fail an edge)."""
+        if self.on_transfer is None or nbytes <= 0 or seconds <= 0:
+            return
+        try:
+            self.on_transfer(kind, nbytes, seconds)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
 
     # -- chunked transfer primitives -----------------------------------------
 
@@ -420,9 +454,16 @@ class SleepManager:
             if sp is not None:
                 sp.end()
         if deq_payloads:
+            t_dq = time.monotonic()
             jax.block_until_ready([o for o in out if o is not None])
+            dq_bytes = sum(p.nbytes for p in deq_payloads)
             for p in deq_payloads:
                 p.delete()
+            # the non-hidden dequant tail (most expansion rode under the
+            # bucket transfers): the cost oracle's quant-overhead signal
+            self._notify_transfer(
+                "quant.dequant", dq_bytes, time.monotonic() - t_dq
+            )
         return out
 
     # -- edges ---------------------------------------------------------------
@@ -463,6 +504,9 @@ class SleepManager:
         state = self._get_state()
         nbytes = sum(x.nbytes for x in jax.tree.leaves(state))
         plan = self._quant_plan(state) if level == SleepLevel.L1_HOST_OFFLOAD else None
+        #: the pure offload window (quiesce/release excluded): the
+        #: bandwidth figure the cost oracle divides by
+        off_window = 0.0
         if release:
             # Plain numpy staging: pinned_host buffers belong to the client
             # we are about to destroy. Save device-free sharding specs as a
@@ -476,9 +520,11 @@ class SleepManager:
                 # round trip per array); returns plain numpy, which
                 # survives the client destruction below
                 leaves, treedef = jax.tree.flatten(state)
+                off_t0 = time.monotonic()
                 host_leaves, metas = self._offload_leaves(
                     leaves, to_numpy=True, plan=plan
                 )
+                off_window = time.monotonic() - off_t0
                 self._host_state = jax.tree.unflatten(treedef, host_leaves)
                 self._quant_meta = metas
             else:
@@ -514,9 +560,11 @@ class SleepManager:
                 # array on high-latency links); device HBM is freed
                 # bucket-by-bucket inside _offload_leaves
                 leaves, treedef = jax.tree.flatten(state)
+                off_t0 = time.monotonic()
                 host_leaves, metas = self._offload_leaves(
                     leaves, to_numpy=not self._use_memory_kind, plan=plan
                 )
+                off_window = time.monotonic() - off_t0
                 self._host_state = jax.tree.unflatten(treedef, host_leaves)
                 self._quant_meta = metas
             else:
@@ -554,6 +602,16 @@ class SleepManager:
             self.stats.bytes_offloaded_full = 0
             self.stats.last_quant = "off"
         self.stats.sleeps_total += 1
+        self.stats.last_sleep_transfer_s = off_window
+        if level == SleepLevel.L1_HOST_OFFLOAD and self._staged is None:
+            # gang-staged offloads excluded: per-shard staging is not the
+            # single-link d2h the oracle prices. The EWMA sees the pure
+            # offload window — the engine quiesce (drain_inflight) and a
+            # device release also inside last_sleep_seconds would
+            # otherwise anchor the d2h bandwidth arbitrarily low.
+            self._notify_transfer(
+                "sleep.d2h", self.stats.bytes_offloaded, off_window
+            )
         return self.describe()
 
     def wake_up(self, reinit=None) -> Dict[str, Any]:
@@ -561,6 +619,10 @@ class SleepManager:
         the state (e.g. re-read the checkpoint)."""
         if self._level == SleepLevel.AWAKE:
             return self.describe()
+        restored_from_staged = (
+            self._level == SleepLevel.L1_HOST_OFFLOAD
+            and self._staged is not None
+        )
         t0 = time.monotonic()
         if self._released:
             reacquire_devices()
@@ -572,6 +634,13 @@ class SleepManager:
             # process's staged shards (every gang process does the same)
             from jax import make_array_from_single_device_arrays
 
+            # this process's restore figures (the _host_state branch sets
+            # its own below): without them a gang wake's flight record
+            # would carry stale/zero bytes
+            self.stats.last_wake_bytes = sum(
+                buf.nbytes for shards in self._staged for _, buf in shards
+            )
+            t_restore0 = time.monotonic()
             # one batched upload of every leaf's local shards
             all_arrs = jax.device_put(
                 [[buf for _, buf in shards] for shards in self._staged],
@@ -584,6 +653,7 @@ class SleepManager:
                 )
             state = jax.tree.unflatten(self._treedef, restored)
             state = jax.block_until_ready(state)
+            self.stats.last_wake_transfer_s = time.monotonic() - t_restore0
             self._staged = None
             self._staged_meta = None
             self._treedef = None
@@ -627,6 +697,11 @@ class SleepManager:
             self._quant_scales = None
             self._quant_meta = None
             state = reinit()
+        restored_from_host = (
+            self._level == SleepLevel.L1_HOST_OFFLOAD
+            and self._host_state is not None
+        )
+        was_released = self._released
         self._host_state = None
         self._sharding_specs = None
         self._shardings = None
@@ -635,7 +710,70 @@ class SleepManager:
         self._level = SleepLevel.AWAKE
         self.stats.last_wake_seconds = time.monotonic() - t0
         self.stats.wakes_total += 1
+        if restored_from_host:
+            # the h2d window excludes client reacquisition (release
+            # path): the oracle prices bytes-over-the-link, and a wake
+            # after device release pays reacquire separately
+            self.stats.last_wake_transfer_s = max(
+                0.0,
+                self.stats.last_wake_seconds
+                - (
+                    self.stats.last_reacquire_seconds
+                    if was_released
+                    else 0.0
+                ),
+            )
+            self._notify_transfer(
+                "wake.h2d",
+                self.stats.last_wake_bytes,
+                self.stats.last_wake_transfer_s,
+            )
+        elif not restored_from_staged:
+            # reinit (level-2) wake: no host payload moved; the staged
+            # (gang) branch set its own figures and stays out of the
+            # single-link EWMA by design
+            self.stats.last_wake_transfer_s = 0.0
+            self.stats.last_wake_bytes = 0
         return self.describe()
+
+    def warm_quant_ops(self) -> int:
+        """Run the transfer quantize/dequantize graphs once per distinct
+        eligible (shape, dtype) over the engine's REAL leaves (the op
+        cache distinguishes the live committed arrays from synthetic
+        stand-ins), so the FIRST real quantized actuation doesn't pay
+        their one-time op compiles inside its transfer window — and the
+        cost oracle's first measured bandwidth windows describe
+        steady-state transfer, not compile stalls (utils/costs.py). All
+        three graphs warm: fresh-scale quantize, cached-scale
+        re-quantize (what every cycle after the first runs), and the
+        on-device dequant. quantize_leaf is pure — the weights are read,
+        never changed; peak extra HBM is one payload per shape, freed
+        leaf-by-leaf. No-op when quant is off or in a gang. Returns the
+        number of distinct shapes warmed."""
+        if not self.quant_mode or jax.process_count() > 1:
+            return 0
+        state = self._peek_state()
+        plan = self._quant_plan(state)
+        if not plan:
+            return 0
+        leaves = jax.tree.leaves(state)
+        seen = set()
+        for leaf, flagged in zip(leaves, plan):
+            if not flagged:
+                continue
+            key = (tuple(leaf.shape), str(leaf.dtype))
+            if key in seen:
+                continue
+            seen.add(key)
+            p, meta = transfer_quant.quantize_leaf(leaf, self.quant_mode)
+            p2, _ = transfer_quant.quantize_leaf(
+                leaf, self.quant_mode, scale=meta.scale
+            )
+            d = transfer_quant.dequantize_leaf(p, meta)
+            jax.block_until_ready(d)
+            for a in (p, p2, d):
+                a.delete()
+        return len(seen)
 
     def quant_state(self) -> str:
         """Transfer mode of the currently-slept payload ("off" when the
@@ -663,6 +801,280 @@ class SleepManager:
             "last_wake_seconds": self.stats.last_wake_seconds,
             "last_reacquire_seconds": self.stats.last_reacquire_seconds,
         }
+
+
+@dataclass
+class _TransferPlan:
+    """Byte-exact schedule of one hot-swap transfer, computed from
+    shapes / dtypes / shardings / digests alone — no data read, no byte
+    moved. Shared by the executing :func:`swap_states` and the dry-run
+    :func:`plan_swap` (the cost oracle's pre-transfer pricing), so a
+    priced swap and the swap it prices can never disagree on bytes."""
+
+    qmode: str  #: "" or the transfer-quant mode in effect
+    out_plan: Optional[list]  #: per-leaf on-device quantize flags (out)
+    #: per-leaf host-staging quantize flags for a full-precision
+    #: incoming entry under quant mode (None when not applicable); only
+    #: the moving leaves are actually staged
+    in_stage_plan: Optional[list]
+    in_metas: list  #: pre-existing TransferQuant-or-None (quantized-slept)
+    reuse_pairs: List[tuple]  #: (incoming idx, outgoing idx) digest matches
+    move_out: List[int]
+    move_in: List[int]
+    nb_out: List[int]
+    nb_in: List[int]
+    wnb_out: List[int]  #: wire bytes per outgoing leaf
+    wnb_in: List[int]  #: wire bytes per incoming leaf
+    buckets_out: List[List[int]]
+    buckets_in: List[List[int]]
+    bytes_out: int
+    bytes_in: int
+    bytes_full: int
+    deduped_bytes: int
+    moved_bytes: int
+    quant_leaves: int
+    quant_active: bool
+    quant_mode_used: str
+
+
+def _plan_transfer(
+    out_mgr: SleepManager,
+    in_mgr: SleepManager,
+    state_out: Any,
+    leaves_out: list,
+    shard_out: list,
+    nb_out: List[int],
+    in_host_state: Any,
+    leaves_in: list,
+    shard_in: list,
+    nb_in: List[int],
+    bucket_bytes: int,
+    out_digests: Optional[Dict[str, str]],
+    in_digests: Optional[Dict[str, str]],
+    quant: Optional[str],
+) -> _TransferPlan:
+    """The planning phase of a hot-swap (see :func:`swap_states` for the
+    semantics of delta matching and quantized staging): which leaves
+    move, which are digest-matched away, and exactly how many wire bytes
+    each direction carries. Pure — reads shapes/digests only."""
+    qmode = quant if quant is not None else (out_mgr.quant_mode or "off")
+    qmode = "" if qmode in ("", "off") else qmode
+    out_plan = out_mgr._quant_plan(state_out) if qmode else None
+    in_metas: list = (
+        list(in_mgr._quant_meta)
+        if in_mgr._quant_meta is not None
+        else [None] * len(leaves_in)
+    )
+
+    # Delta matching (swap_states docstring): pair incoming leaves with
+    # content-identical live outgoing leaves by digest; matched pairs are
+    # excluded from BOTH transfer directions. A quantized-slept incoming
+    # leaf's digest names its ORIGINAL full-precision content, so the
+    # dtype check compares against the payload's origin dtype.
+    reuse_pairs: List[tuple] = []
+    if out_digests and in_digests:
+        dl_out = _aligned(state_out, out_digests)
+        dl_in = _aligned(in_host_state, in_digests)
+        by_digest: Dict[str, List[int]] = {}
+        for j, d in enumerate(dl_out):
+            if d is not None:
+                by_digest.setdefault(d, []).append(j)
+        for i, d in enumerate(dl_in):
+            cands = by_digest.get(d) if d is not None else None
+            if not cands:
+                continue
+            j = cands[0]
+            lo, li = leaves_out[j], leaves_in[i]
+            li_dtype = (
+                np.dtype(in_metas[i].orig_dtype)
+                if in_metas[i] is not None
+                else li.dtype
+            )
+            if (
+                tuple(lo.shape) == tuple(li.shape)
+                and lo.dtype == li_dtype
+                and shard_out[j] == shard_in[i]
+            ):
+                reuse_pairs.append((i, j))
+                cands.pop(0)
+    reused_in = {i for i, _ in reuse_pairs}
+    reused_out = {j for _, j in reuse_pairs}
+    move_out = [i for i in range(len(leaves_out)) if i not in reused_out]
+    move_in = [i for i in range(len(leaves_in)) if i not in reused_in]
+    move_in_set = set(move_in)
+
+    # Host-side staging quantization applies to a full-precision incoming
+    # entry under quant mode — but only its MOVING leaves are staged; the
+    # wire bytes of a to-be-staged leaf are exactly payload_nbytes (the
+    # int8/fp8 payload plus its scale), predictable from the shape alone.
+    in_stage_plan: Optional[list] = None
+    if qmode and in_mgr._quant_meta is None:
+        in_stage_plan = transfer_quant.transfer_quant_plan(
+            in_host_state, hot_head=in_mgr.quant_hot_head
+        )
+
+    wnb_out = [
+        transfer_quant.payload_nbytes(leaves_out[i].shape, qmode)
+        if out_plan and out_plan[i]
+        else nb_out[i]
+        for i in range(len(leaves_out))
+    ]
+
+    def _wire_in(i: int) -> int:
+        if in_metas[i] is not None:
+            # already a payload (quantized-slept): leaf bytes + scale
+            return nb_in[i] + in_metas[i].scale_nbytes
+        if in_stage_plan and in_stage_plan[i] and i in move_in_set:
+            return transfer_quant.payload_nbytes(leaves_in[i].shape, qmode)
+        return nb_in[i]
+
+    wnb_in = [_wire_in(i) for i in range(len(leaves_in))]
+    buckets_out = [
+        [move_out[k] for k in b]
+        for b in partition_buckets(
+            [wnb_out[i] for i in move_out], bucket_bytes
+        )
+    ]
+    buckets_in = [
+        [move_in[k] for k in b]
+        for b in partition_buckets(
+            [wnb_in[i] for i in move_in], bucket_bytes
+        )
+    ]
+    bytes_out = sum(wnb_out)
+    bytes_in = sum(wnb_in)
+    bytes_full = sum(nb_out) + sum(
+        nb_in[i]
+        if in_metas[i] is None
+        else int(
+            np.prod(leaves_in[i].shape)
+            * np.dtype(in_metas[i].orig_dtype).itemsize
+        )
+        for i in range(len(leaves_in))
+    )
+    deduped_bytes = sum(wnb_out[j] for j in reused_out) + sum(
+        wnb_in[i] for i in reused_in
+    )
+    quant_leaves = sum(
+        1 for i in move_out if out_plan and out_plan[i]
+    ) + sum(
+        1
+        for i in move_in
+        if in_metas[i] is not None
+        or (in_stage_plan and in_stage_plan[i])
+    )
+    quant_active = (
+        bool(out_plan)
+        or any(m is not None for m in in_metas)
+        or bool(
+            in_stage_plan
+            and any(in_stage_plan[i] for i in move_in)
+        )
+    )
+    quant_mode_used = (
+        qmode or next((m.mode for m in in_metas if m is not None), "off")
+        if quant_active
+        else "off"
+    )
+    return _TransferPlan(
+        qmode=qmode,
+        out_plan=out_plan,
+        in_stage_plan=in_stage_plan,
+        in_metas=in_metas,
+        reuse_pairs=reuse_pairs,
+        move_out=move_out,
+        move_in=move_in,
+        nb_out=nb_out,
+        nb_in=nb_in,
+        wnb_out=wnb_out,
+        wnb_in=wnb_in,
+        buckets_out=buckets_out,
+        buckets_in=buckets_in,
+        bytes_out=bytes_out,
+        bytes_in=bytes_in,
+        bytes_full=bytes_full,
+        deduped_bytes=deduped_bytes,
+        moved_bytes=bytes_out + bytes_in - deduped_bytes,
+        quant_leaves=quant_leaves,
+        quant_active=quant_active,
+        quant_mode_used=quant_mode_used,
+    )
+
+
+def _check_swap_preconditions(
+    out_mgr: SleepManager, in_mgr: SleepManager
+) -> None:
+    if out_mgr.is_sleeping:
+        raise ValueError("swap-out model must be awake")
+    if (
+        in_mgr.level != SleepLevel.L1_HOST_OFFLOAD
+        or in_mgr._host_state is None
+    ):
+        raise ValueError(
+            "swap-in model must be asleep at level 1 with host-resident "
+            "state (level-2 / multi-host-staged states cannot hot-swap)"
+        )
+    if in_mgr._released:
+        raise ValueError(
+            "swap-in model was released; hot-swap keeps one live client"
+        )
+    if jax.process_count() > 1:
+        raise ValueError("hot-swap is not supported for multi-host gangs")
+
+
+def plan_swap(
+    out_mgr: SleepManager,
+    in_mgr: SleepManager,
+    bucket_bytes: Optional[int] = None,
+    out_digests: Optional[Dict[str, str]] = None,
+    in_digests: Optional[Dict[str, str]] = None,
+    quant: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Price a hot-swap WITHOUT moving a byte: the identical planning
+    code :func:`swap_states` executes (same preconditions, same delta
+    matching, same quantized-payload sizing), run against a
+    side-effect-free peek of the outgoing state — so the predicted wire
+    bytes are **exact by construction** for any swap the planner can
+    see (the delta-sibling and quantized CI gates pin this). Returns the
+    byte keys of the swap metrics dict plus bucket counts (what the
+    seconds model divides by measured bandwidth)."""
+    _check_swap_preconditions(out_mgr, in_mgr)
+    bucket_bytes = bucket_bytes or DEFAULT_SWAP_BUCKET_BYTES
+    state_out = out_mgr._peek_state()
+    leaves_out, _ = jax.tree.flatten(state_out)
+    shard_out = [x.sharding for x in leaves_out]
+    nb_out = [x.nbytes for x in leaves_out]
+    leaves_in, _ = jax.tree.flatten(in_mgr._host_state)
+    shard_in, _ = jax.tree.flatten(in_mgr._shardings)
+    nb_in = [x.nbytes for x in leaves_in]
+    plan = _plan_transfer(
+        out_mgr, in_mgr, state_out, leaves_out, shard_out, nb_out,
+        in_mgr._host_state, leaves_in, shard_in, nb_in,
+        bucket_bytes, out_digests, in_digests, quant,
+    )
+    return {
+        "bytes_out": plan.bytes_out,
+        "bytes_in": plan.bytes_in,
+        "bytes_moved": plan.moved_bytes,
+        "bytes_deduped": plan.deduped_bytes,
+        # per-direction bytes that actually cross the device boundary
+        # (totals minus the digest-matched leaves): what the seconds
+        # model divides by measured per-direction bandwidth
+        "wire_out": sum(plan.wnb_out[i] for i in plan.move_out),
+        "wire_in": sum(plan.wnb_in[i] for i in plan.move_in),
+        "deduped_leaves": len(plan.reuse_pairs),
+        "quant": plan.quant_mode_used,
+        "quant_leaves": plan.quant_leaves,
+        "bytes_full": plan.bytes_full,
+        "bytes_saved_quant": max(
+            0, plan.bytes_full - (plan.bytes_out + plan.bytes_in)
+        ),
+        "buckets_out": len(plan.buckets_out),
+        "buckets_in": len(plan.buckets_in),
+        "bucket_bytes": bucket_bytes,
+        "leaves_out": len(leaves_out),
+        "leaves_in": len(leaves_in),
+    }
 
 
 def swap_states(
@@ -746,19 +1158,7 @@ def swap_states(
     ``bytes_saved_quant`` the difference (the ``swap.quant`` span mirrors
     them).
     """
-    if out_mgr.is_sleeping:
-        raise ValueError("swap-out model must be awake")
-    if in_mgr.level != SleepLevel.L1_HOST_OFFLOAD or in_mgr._host_state is None:
-        raise ValueError(
-            "swap-in model must be asleep at level 1 with host-resident "
-            "state (level-2 / multi-host-staged states cannot hot-swap)"
-        )
-    if in_mgr._released:
-        raise ValueError(
-            "swap-in model was released; hot-swap keeps one live client"
-        )
-    if jax.process_count() > 1:
-        raise ValueError("hot-swap is not supported for multi-host gangs")
+    _check_swap_preconditions(out_mgr, in_mgr)
     bucket_bytes = bucket_bytes or DEFAULT_SWAP_BUCKET_BYTES
     use_mk = out_mgr._use_memory_kind
     # Root span for the transfer phase; per-bucket child spans are created
@@ -782,129 +1182,48 @@ def swap_states(
     shard_in, _ = jax.tree.flatten(in_mgr._shardings)
     nb_in = [x.nbytes for x in leaves_in]
 
-    # Quantized-transfer planning (docstring): which outgoing leaves
-    # compress on device, which incoming leaves are already payloads
-    # (quantized-slept), and the per-leaf metadata the commit hands over.
-    qmode = quant if quant is not None else (out_mgr.quant_mode or "off")
-    qmode = "" if qmode in ("", "off") else qmode
-    out_plan = out_mgr._quant_plan(state_out) if qmode else None
-    meta_out: list = [None] * len(leaves_out)
-    in_metas: list = (
-        list(in_mgr._quant_meta)
-        if in_mgr._quant_meta is not None
-        else [None] * len(leaves_in)
+    # Planning — quantized-transfer flags, delta matching, and wire-byte
+    # sizing — is shared with the cost oracle's dry-run (plan_swap): the
+    # exact code that prices a swap is the code that executes it, so
+    # predicted and actual wire bytes can never disagree.
+    plan = _plan_transfer(
+        out_mgr, in_mgr, state_out, leaves_out, shard_out, nb_out,
+        in_mgr._host_state, leaves_in, shard_in, nb_in,
+        bucket_bytes, out_digests, in_digests, quant,
     )
-    in_meta_nb = [
-        (m.scale_nbytes if m is not None else 0) for m in in_metas
-    ]
-
-    # Delta matching (module docstring): pair incoming leaves with
-    # content-identical live outgoing leaves by digest. Matched pairs are
-    # excluded from BOTH transfer directions; the handover itself happens
-    # only at commit, so every pre-commit code path (including rollback)
-    # sees them untouched. A quantized-slept incoming leaf's digest names
-    # its ORIGINAL full-precision content, so the dtype check compares
-    # against the payload's origin dtype, not the int8/fp8 carrier.
-    # Under --sleep-quant, digest matching on the fp ORIGIN stays value-
-    # consistent: quantization is deterministic over identical origin
-    # bits (and scale-cached thereafter), so a handed-over live array is
-    # either the shared fp content itself or the identical
-    # post-quantization bits the incoming payload would dequantize to.
-    reuse_pairs: List[tuple] = []  # (incoming idx, outgoing idx)
-    if out_digests and in_digests:
-        dl_out = _aligned(state_out, out_digests)
-        dl_in = _aligned(in_mgr._host_state, in_digests)
-        by_digest: Dict[str, List[int]] = {}
-        for j, d in enumerate(dl_out):
-            if d is not None:
-                by_digest.setdefault(d, []).append(j)
-        for i, d in enumerate(dl_in):
-            cands = by_digest.get(d) if d is not None else None
-            if not cands:
-                continue
-            j = cands[0]
-            lo, li = leaves_out[j], leaves_in[i]
-            li_dtype = (
-                np.dtype(in_metas[i].orig_dtype)
-                if in_metas[i] is not None
-                else li.dtype
-            )
-            if (
-                tuple(lo.shape) == tuple(li.shape)
-                and lo.dtype == li_dtype
-                and shard_out[j] == shard_in[i]
-            ):
-                reuse_pairs.append((i, j))
-                cands.pop(0)
+    qmode = plan.qmode
+    out_plan = plan.out_plan
+    meta_out: list = [None] * len(leaves_out)
+    in_metas = plan.in_metas
+    reuse_pairs = plan.reuse_pairs
     reused_in = {i for i, _ in reuse_pairs}
     reused_out = {j for _, j in reuse_pairs}
-    move_out = [i for i in range(len(leaves_out)) if i not in reused_out]
-    move_in = [i for i in range(len(leaves_in)) if i not in reused_in]
+    move_in = plan.move_in
+    wnb_out, wnb_in = plan.wnb_out, plan.wnb_in
+    buckets_out, buckets_in = plan.buckets_out, plan.buckets_in
 
     # Host-side staging quantization for a full-precision incoming entry
     # under quant mode: the payload staging copies move instead of the fp
     # host state, which stays untouched until commit (rollback re-pools it
-    # bit-exact). Only leaves that actually move are staged.
+    # bit-exact). Only leaves that actually move are staged; their wire
+    # bytes were already sized by the planner (payload_nbytes — payload
+    # plus scale — equals the staged array plus its metadata exactly).
     stage_in: list = [None] * len(leaves_in)
-    if qmode and in_mgr._quant_meta is None:
-        in_plan = transfer_quant.transfer_quant_plan(
-            in_mgr._host_state, hot_head=in_mgr.quant_hot_head
-        )
+    if plan.in_stage_plan is not None:
         for i in move_in:
-            if in_plan[i]:
+            if plan.in_stage_plan[i]:
                 stage_in[i], in_metas[i] = transfer_quant.quantize_leaf_np(
                     np.asarray(leaves_in[i]), qmode
                 )
-                in_meta_nb[i] = in_metas[i].scale_nbytes
-
-    # Wire bytes per leaf: what actually crosses the device boundary —
-    # payload + scale for quantized leaves, the full leaf otherwise. All
-    # bucket partitioning and byte metrics below run on wire bytes.
-    wnb_out = [
-        transfer_quant.payload_nbytes(leaves_out[i].shape, qmode)
-        if out_plan and out_plan[i]
-        else nb_out[i]
-        for i in range(len(leaves_out))
-    ]
-    wnb_in = [
-        (stage_in[i].nbytes if stage_in[i] is not None else nb_in[i])
-        + in_meta_nb[i]
-        for i in range(len(leaves_in))
-    ]
-    buckets_out = [
-        [move_out[k] for k in b]
-        for b in partition_buckets(
-            [wnb_out[i] for i in move_out], bucket_bytes
-        )
-    ]
-    buckets_in = [
-        [move_in[k] for k in b]
-        for b in partition_buckets(
-            [wnb_in[i] for i in move_in], bucket_bytes
-        )
-    ]
 
     host_out: list = [None] * len(leaves_out)
     dev_in: list = [None] * len(leaves_in)
-    bytes_out = sum(wnb_out)
-    bytes_in = sum(wnb_in)
-    bytes_full = sum(nb_out) + sum(
-        nb_in[i]
-        if in_metas[i] is None
-        else int(
-            np.prod(leaves_in[i].shape)
-            * np.dtype(in_metas[i].orig_dtype).itemsize
-        )
-        for i in range(len(leaves_in))
-    )
-    deduped_bytes = sum(wnb_out[j] for j in reused_out) + sum(
-        wnb_in[i] for i in reused_in
-    )
-    moved_bytes = bytes_out + bytes_in - deduped_bytes
-    quant_leaves = (
-        sum(1 for i in move_out if out_plan and out_plan[i])
-        + sum(1 for i in move_in if in_metas[i] is not None)
-    )
+    bytes_out = plan.bytes_out
+    bytes_in = plan.bytes_in
+    bytes_full = plan.bytes_full
+    deduped_bytes = plan.deduped_bytes
+    moved_bytes = plan.moved_bytes
+    quant_leaves = plan.quant_leaves
     if reuse_pairs and traced:
         dsp = tracing.begin(
             "swap.delta",
@@ -915,14 +1234,8 @@ def swap_states(
             bytes_moved=moved_bytes,
         )
         dsp.end()
-    quant_active = bool(out_plan) or any(
-        m is not None for m in in_metas
-    )
-    quant_mode_used = (
-        qmode or next((m.mode for m in in_metas if m is not None), "off")
-        if quant_active
-        else "off"
-    )
+    quant_active = plan.quant_active
+    quant_mode_used = plan.quant_mode_used
     if quant_active and traced:
         qsp = tracing.begin(
             "swap.quant",
@@ -1286,9 +1599,15 @@ def swap_states(
     if in_payload_devs:
         # the last buckets' async dequants are part of the wake window:
         # land them, then free the device payload staging
+        t_dq = time.monotonic()
         jax.block_until_ready([a for a in dev_in if a is not None])
+        dq_bytes = sum(p.nbytes for p in in_payload_devs)
         for p in in_payload_devs:
             p.delete()
+        # the non-hidden dequant tail: the quant-overhead EWMA kind
+        out_mgr._notify_transfer(
+            "quant.dequant", dq_bytes, time.monotonic() - t_dq
+        )
     h2d_t1 = time.monotonic()
     if h2d_t0 is None:  # empty incoming tree (degenerate)
         h2d_t0 = h2d_t1
@@ -1327,6 +1646,7 @@ def swap_states(
     out_mgr._set_state(None)
     out_mgr._level = SleepLevel.L1_HOST_OFFLOAD
     out_mgr.stats.last_sleep_seconds = d2h_t1 - d2h_t0
+    out_mgr.stats.last_sleep_transfer_s = d2h_t1 - d2h_t0
     out_mgr.stats.bytes_offloaded = sum(
         x.nbytes for x in host_out if x is not None
     ) + sum(m.scale_nbytes for m in meta_out if m is not None)
@@ -1345,6 +1665,7 @@ def swap_states(
     # re-quantization); payload metadata is consumed by this wake
     in_mgr._note_wake_quant(in_metas)
     in_mgr.stats.last_wake_seconds = h2d_t1 - h2d_t0
+    in_mgr.stats.last_wake_transfer_s = h2d_t1 - h2d_t0
     in_mgr.stats.last_wake_bytes = bytes_in
     in_mgr.stats.bytes_offloaded = 0
     in_mgr.stats.bytes_offloaded_full = 0
@@ -1366,6 +1687,24 @@ def swap_states(
         peak_bytes_in_flight=peak_in_flight,
     )
     root.end()
+    # bandwidth EWMA feed (utils/costs.py): the two directions' measured
+    # windows, over the bytes that actually crossed the boundary (totals
+    # minus digest-matched leaves) — what pre-transfer pricing divides by
+    out_mgr._notify_transfer(
+        "swap.d2h",
+        sum(wnb_out[i] for i in plan.move_out),
+        d2h_t1 - d2h_t0,
+    )
+    out_mgr._notify_transfer(
+        "swap.h2d",
+        sum(wnb_in[i] for i in move_in),
+        h2d_t1 - h2d_t0,
+    )
+    # effective whole-verb bandwidth (moved bytes over the full wall,
+    # planning/staging/commit included): what pool-hit pricing prefers —
+    # for repeated same-shape swaps it predicts the wall directly,
+    # absorbing the fixed per-swap overhead the window EWMAs can't see
+    out_mgr._notify_transfer("swap.total", moved_bytes, total)
     return {
         "swap_total_s": total,
         "d2h_s": d2h_t1 - d2h_t0,
@@ -1394,6 +1733,7 @@ def attach_sleep(
     bucket_bytes: Optional[int] = None,
     quant_mode: str = "off",
     quant_hot_head: bool = True,
+    on_transfer: Optional[Callable[[str, int, float], None]] = None,
 ) -> SleepManager:
     """Wire a SleepManager to an InferenceEngine: the offloadable state is
     (params, kv page pool). Page tables / host bookkeeping stay put, so the
@@ -1402,12 +1742,18 @@ def attach_sleep(
     ``quant_mode`` opts the level-1 offload path into compressed transfers
     (int8/fp8 payloads + on-device dequant; docs/perf.md "Compressed
     actuation"); ``quant_hot_head`` keeps embeddings / final norm /
-    lm_head at full precision (the default)."""
+    lm_head at full precision (the default). ``on_transfer`` feeds each
+    completed transfer window's (kind, bytes, seconds) to the cost
+    oracle's bandwidth EWMAs (utils/costs.py)."""
 
     def get_state():
         # a dispatched-but-unread decode chunk would be lost with the
         # device state: complete it (emitting its tokens) before offload
         engine.drain_inflight()
+        return {"params": engine.params, "kv": engine.pool.as_tuple()}
+
+    def peek_state():
+        # pricing reads shapes only: same tree, no quiesce
         return {"params": engine.params, "kv": engine.pool.as_tuple()}
 
     def set_state(state):
@@ -1430,4 +1776,6 @@ def attach_sleep(
         bucket_bytes=bucket_bytes,
         quant_mode=quant_mode,
         quant_hot_head=quant_hot_head,
+        on_transfer=on_transfer,
+        peek_state=peek_state,
     )

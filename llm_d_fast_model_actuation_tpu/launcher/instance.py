@@ -237,7 +237,9 @@ class EngineInstance:
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self, fresh_log: bool = True) -> Dict[str, Any]:
+    def start(
+        self, fresh_log: bool = True, restart: bool = False
+    ) -> Dict[str, Any]:
         if self.process and self.process.is_alive():
             return self._make_state("already_running")
         if fresh_log or not os.path.exists(self._log_file_path):
@@ -254,14 +256,20 @@ class EngineInstance:
         # (the launcher's create/restart span) into the env the fork
         # inherits, so the child's engine.start span joins the trace
         # (utils/tracing.py; restored right after the fork — the env of a
-        # long-lived launcher must not carry a stale parent).
+        # long-lived launcher must not carry a stale parent). A
+        # supervised restart additionally stamps FMA_RESTARTED so the
+        # child's flight recorder (utils/costs.py) attributes its initial
+        # cold build to restart churn, not client-driven actuation.
         from ..utils import tracing
 
         tp = tracing.current_traceparent()
         with _FORK_ENV_LOCK:
             prev_tp = os.environ.get(tracing.TRACEPARENT_ENV)
+            prev_rs = os.environ.get("FMA_RESTARTED")
             if tp:
                 os.environ[tracing.TRACEPARENT_ENV] = tp
+            if restart:
+                os.environ["FMA_RESTARTED"] = "1"
             try:
                 self.process.start()
             finally:
@@ -270,6 +278,11 @@ class EngineInstance:
                         os.environ.pop(tracing.TRACEPARENT_ENV, None)
                     else:
                         os.environ[tracing.TRACEPARENT_ENV] = prev_tp
+                if restart:
+                    if prev_rs is None:
+                        os.environ.pop("FMA_RESTARTED", None)
+                    else:
+                        os.environ["FMA_RESTARTED"] = prev_rs
         return self._make_state("started")
 
     def stop(self, timeout: float = 10) -> Dict[str, Any]:

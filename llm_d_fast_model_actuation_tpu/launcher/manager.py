@@ -601,7 +601,7 @@ class EngineProcessManager:
                     faults.fire("instance.spawn")
                     # append to the existing log: the crash forensics above
                     # the restart marker are exactly what the operator needs
-                    instance.start(fresh_log=False)
+                    instance.start(fresh_log=False, restart=True)
             except Exception as e:  # noqa: BLE001 — spawn failed: retry
                 logger.warning(
                     "instance %s restart attempt %d failed to spawn: %s",
@@ -1143,6 +1143,18 @@ class EngineProcessManager:
             except Exception as e:  # noqa: BLE001 — rollup never fails the read
                 logger.warning("fleet rollup failed: %s", e)
                 out["fleet"] = {"error": str(e)[:200]}
+            # cost-oracle rollup (docs/launcher.md "The costs block"):
+            # each reporting child's /v1/stats already carries its
+            # bandwidth EWMAs + prediction accuracy — lift them into the
+            # ledger so ONE detailed read serves the scheduler's whole
+            # input: demand (fleet), state (ledger), cost (this block),
+            # all from the same poll cycle
+            per = (out["fleet"] or {}).get("per_instance") or {}
+            out["ledger"]["costs"] = {
+                iid: row.get("costs")
+                for iid, row in per.items()
+                if row.get("reporting") and row.get("costs") is not None
+            }
         return out
 
     def list_instances(self) -> List[str]:

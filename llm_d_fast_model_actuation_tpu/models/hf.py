@@ -206,6 +206,18 @@ class LoadStats:
     #: with ``load_params(..., want_digests=True)``.
     digests: Dict[str, str] = dataclasses.field(default_factory=dict)
 
+    def transfer_figures(self):
+        """``(kind, bytes, seconds)`` rows for the cost oracle's
+        bandwidth EWMAs (utils/costs.py): the disk-read and H2D windows
+        this load already measured, in the kind vocabulary the oracle
+        prices with. Zero-byte / zero-time windows are omitted."""
+        out = []
+        if self.bytes_read > 0 and self.read_s > 0:
+            out.append(("coldload.read", self.bytes_read, self.read_s))
+        if self.bytes_h2d > 0 and self.h2d_s > 0:
+            out.append(("coldload.h2d", self.bytes_h2d, self.h2d_s))
+        return out
+
 
 def _shard_files(path: str) -> Tuple[str, List[str]]:
     """Resolve the checkpoint's shard layout WITHOUT reading tensor data:

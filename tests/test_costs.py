@@ -1,0 +1,579 @@
+"""Actuation cost oracle + decision flight recorder (utils/costs.py,
+engine/sleep.py plan_swap, GET /v1/costs, GET /v1/actuations, the
+launcher ledger.costs rollup): bytes are priced exactly before any
+transfer, seconds come from measured per-kind bandwidth EWMAs, and every
+actuation leaves one structured predicted-vs-actual record."""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+from prometheus_client import REGISTRY
+
+from llm_d_fast_model_actuation_tpu.utils.costs import (
+    ActuationRecord,
+    BandwidthBook,
+    BandwidthEWMA,
+    CostBook,
+    FlightRecorder,
+)
+
+pytestmark = pytest.mark.costs
+
+
+def _sample(name, **labels):
+    return REGISTRY.get_sample_value(name, labels) or 0.0
+
+
+# -- bandwidth EWMAs ----------------------------------------------------------
+
+
+def test_bandwidth_ewma_converges_on_constant_rate():
+    ew = BandwidthEWMA(tau_s=600.0)
+    for i in range(20):
+        # 1 GiB in 2 s = 0.5 GiB/s, observed at 1 Hz
+        ew.observe(2**30, 2.0, now=100.0 + i)
+    assert ew.samples == 20
+    assert ew.gibps() == pytest.approx(0.5, rel=1e-6)
+
+
+def test_bandwidth_ewma_recent_dominates():
+    """The double decay (time + per-observation) makes a compile-stalled
+    first transfer fade: after a few steady observations the estimate
+    sits near the recent rate, not the mean."""
+    ew = BandwidthEWMA(tau_s=600.0)
+    ew.observe(2**30, 100.0, now=0.0)  # 0.01 GiB/s outlier (cold)
+    for i in range(4):
+        ew.observe(2**30, 1.0, now=1.0 + i)  # 1 GiB/s steady state
+    assert ew.gibps() > 0.9  # the outlier contributes < 10%
+
+
+def test_bandwidth_ewma_time_decay():
+    """A long-stale observation loses virtually all weight against a
+    fresh one (backend change re-convergence)."""
+    ew = BandwidthEWMA(tau_s=10.0)
+    ew.observe(2**30, 1.0, now=0.0)  # 1 GiB/s
+    ew.observe(2**30, 100.0, now=1000.0)  # much later: 0.01 GiB/s
+    assert ew.gibps() == pytest.approx(0.01, rel=1e-3)
+
+
+def test_bandwidth_ewma_rejects_degenerate_windows():
+    ew = BandwidthEWMA()
+    ew.observe(0, 1.0)
+    ew.observe(100, 0.0)
+    ew.observe(-5, 1.0)
+    assert ew.samples == 0 and ew.gibps() is None
+
+
+def test_bandwidth_book_fallback_and_cold_start():
+    book = BandwidthBook()
+    # cold start: conservative constant, flagged unmeasured
+    g, measured, src = book.estimate("swap.d2h")
+    assert not measured and src == "default" and g > 0
+    s, m = book.seconds_for("swap.d2h", 2**30)
+    assert not m and s == pytest.approx(1.0 / g)
+    # same-direction family fallback counts as measured
+    book.observe("sleep.d2h", 2**30, 2.0)
+    g2, measured2, src2 = book.estimate("swap.d2h")
+    assert measured2 and src2 == "sleep.d2h"
+    assert g2 == pytest.approx(0.5)
+    # exact kind wins once it has history
+    book.observe("swap.d2h", 2**30, 1.0)
+    g3, _, src3 = book.estimate("swap.d2h")
+    assert src3 == "swap.d2h" and g3 == pytest.approx(1.0)
+    assert book.has("swap.d2h") and not book.has("wake.h2d")
+    d = book.describe()
+    assert d["swap.d2h"]["samples"] == 1
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_and_schema():
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record(
+            kind="swap",
+            model=f"m{i}",
+            trigger="client",
+            tier="pool",
+            actual_bytes=100,
+            actual_s=0.5,
+            predicted_bytes=100,
+            predicted_s=1.0,
+            measured=True,
+        )
+    assert len(rec) == 8  # bounded: oldest dropped
+    rows = rec.records()
+    assert len(rows) == 8
+    assert rows[0]["model"] == "m12" and rows[-1]["model"] == "m19"
+    seqs = [r["seq"] for r in rows]
+    assert seqs == sorted(seqs)
+    r = rows[-1]
+    for field in (
+        "seq", "t_wall", "kind", "model", "trigger", "tier", "outcome",
+        "actual_bytes", "actual_s", "predicted_bytes", "predicted_s",
+        "measured", "bytes_error_ratio", "seconds_error_ratio",
+    ):
+        assert field in r, f"record schema missing {field}"
+    assert r["bytes_error_ratio"] == 0.0
+    assert r["seconds_error_ratio"] == pytest.approx(1.0)  # 2x over
+    assert rec.records(n=3)[0]["model"] == "m17"
+    assert rec.records(kind="wake") == []
+
+
+def test_flight_recorder_summary_scores_the_oracle():
+    rec = FlightRecorder(capacity=32)
+    # two priced records: one byte-exact, one off; one unpriced
+    rec.record(kind="swap", model="a", actual_bytes=100, actual_s=1.0,
+               predicted_bytes=100, predicted_s=1.1, measured=True)
+    rec.record(kind="swap", model="b", actual_bytes=100, actual_s=1.0,
+               predicted_bytes=90, predicted_s=0.5, measured=True)
+    rec.record(kind="coldload", model="c", actual_bytes=5, actual_s=0.1)
+    s = rec.summary()
+    assert s["recorded_total"] == 3 and s["window"] == 3
+    assert s["by_kind"] == {"swap": 2, "coldload": 1}
+    assert s["priced"] == 2 and s["byte_exact"] == 1
+    assert s["byte_exact_frac"] == pytest.approx(0.5)
+    assert s["seconds_error_judged"] == 2
+    assert s["mean_abs_seconds_error_ratio"] == pytest.approx(0.3)
+    assert s["max_abs_seconds_error_ratio"] == pytest.approx(0.5)
+    assert s["last"]["model"] == "c"
+
+
+def test_cost_book_observe_never_raises():
+    cb = CostBook(capacity=4)
+    cb.observe_transfer("swap.d2h", 2**20, 0.001)
+    cb.observe_transfer("swap.d2h", -1, 0.0)  # degenerate: dropped
+    out = cb.summary()
+    assert "bandwidth_gibps" in out and "prediction" in out
+    assert out["bandwidth_gibps"]["swap.d2h"]["samples"] == 1
+
+
+# -- plan_swap: the dry-run planner vs the executing swap ---------------------
+
+
+def _variant_params(perturb: bool):
+    rng = np.random.default_rng(0)
+    base = {
+        "embed": rng.standard_normal((64, 32)).astype(np.float32),
+        "layers": {
+            "wq": rng.standard_normal((2, 32, 32)).astype(np.float32),
+            "wk": rng.standard_normal((2, 32, 16)).astype(np.float32),
+        },
+        "head": rng.standard_normal((32, 64)).astype(np.float32),
+    }
+    if perturb:
+        base["head"] = base["head"] * 1.5 + 0.25
+    return base
+
+
+def _mgr(params, kv_seed, **kw):
+    from llm_d_fast_model_actuation_tpu.engine.sleep import SleepManager
+
+    rng = np.random.default_rng(kv_seed)
+    kv = (
+        rng.standard_normal((2, 8, 16)).astype(np.float32),
+        rng.standard_normal((2, 8, 16)).astype(np.float32),
+    )
+    box = {
+        "state": jax.device_put(
+            {"params": params, "kv": kv}, jax.devices()[0]
+        )
+    }
+    mgr = SleepManager(
+        lambda: box["state"],
+        lambda s: box.__setitem__("state", s),
+        **kw,
+    )
+    return mgr, box
+
+
+def test_plan_swap_bytes_match_swap_states_exactly():
+    from llm_d_fast_model_actuation_tpu.engine.chunk_store import digest_tree
+    from llm_d_fast_model_actuation_tpu.engine.sleep import (
+        plan_swap,
+        swap_states,
+    )
+
+    pa, pb = _variant_params(False), _variant_params(True)
+    dga, dgb = digest_tree(pa), digest_tree(pb)
+    out_mgr, _ = _mgr(pa, kv_seed=1)
+    in_mgr, _ = _mgr(pb, kv_seed=2)
+    in_mgr.sleep(1)
+    plan = plan_swap(
+        out_mgr, in_mgr, bucket_bytes=4096,
+        out_digests=dga, in_digests=dgb,
+    )
+    # the dry run consumed nothing: both managers still swappable
+    assert not out_mgr.is_sleeping and in_mgr.is_sleeping
+    m = swap_states(
+        out_mgr, in_mgr, bucket_bytes=4096,
+        out_digests=dga, in_digests=dgb,
+    )
+    for key in (
+        "bytes_out", "bytes_in", "bytes_moved", "bytes_deduped",
+        "deduped_leaves", "bytes_full", "bytes_saved_quant",
+        "buckets_out", "buckets_in", "quant", "quant_leaves",
+    ):
+        assert plan[key] == m[key], f"plan vs actual mismatch on {key}"
+    assert plan["wire_out"] + plan["wire_in"] == m["bytes_moved"]
+    assert plan["bytes_deduped"] > 0  # the delta actually deduped
+
+
+def test_plan_swap_quant_bytes_exact():
+    from llm_d_fast_model_actuation_tpu.engine.sleep import (
+        plan_swap,
+        swap_states,
+    )
+
+    pa, pb = _variant_params(False), _variant_params(True)
+    out_mgr, _ = _mgr(pa, kv_seed=1, quant_mode="int8",
+                      quant_hot_head=False)
+    in_mgr, _ = _mgr(pb, kv_seed=2, quant_mode="int8",
+                     quant_hot_head=False)
+    in_mgr.sleep(1)  # slept quantized: host state is payloads
+    plan = plan_swap(out_mgr, in_mgr, quant="int8")
+    m = swap_states(out_mgr, in_mgr, quant="int8")
+    assert plan["quant"] == "int8" and plan["quant_leaves"] > 0
+    for key in ("bytes_out", "bytes_in", "bytes_moved", "bytes_full",
+                "bytes_saved_quant", "quant_leaves"):
+        assert plan[key] == m[key], f"quant plan mismatch on {key}"
+    assert m["bytes_saved_quant"] > 0
+
+
+def test_plan_swap_rejects_unswappable_states():
+    from llm_d_fast_model_actuation_tpu.engine.sleep import plan_swap
+
+    out_mgr, _ = _mgr(_variant_params(False), kv_seed=1)
+    in_mgr, _ = _mgr(_variant_params(True), kv_seed=2)
+    with pytest.raises(ValueError):
+        plan_swap(out_mgr, in_mgr)  # incoming not slept
+
+
+def test_on_transfer_hook_feeds_kinds():
+    seen = []
+    out_mgr, _ = _mgr(
+        _variant_params(False), kv_seed=1,
+        on_transfer=lambda k, b, s: seen.append((k, b)),
+    )
+    in_mgr, _ = _mgr(_variant_params(True), kv_seed=2)
+    out_mgr.sleep(1)
+    out_mgr.wake_up()
+    kinds = [k for k, _ in seen]
+    assert kinds == ["sleep.d2h", "wake.h2d"]
+    assert all(b > 0 for _, b in seen)
+    from llm_d_fast_model_actuation_tpu.engine.sleep import swap_states
+
+    in_mgr.sleep(1)
+    seen.clear()
+    m = swap_states(out_mgr, in_mgr)
+    kinds = [k for k, _ in seen]
+    assert kinds == ["swap.d2h", "swap.h2d", "swap.total"]
+    assert seen[2][1] == m["bytes_moved"]
+
+    # a raising hook never fails the edge
+    bad_mgr, _ = _mgr(
+        _variant_params(False), kv_seed=3,
+        on_transfer=lambda *a: (_ for _ in ()).throw(RuntimeError("x")),
+    )
+    bad_mgr.sleep(1)
+    assert bad_mgr.is_sleeping
+
+
+# -- service level: pricing, endpoints, records -------------------------------
+
+
+@pytest.fixture(scope="module")
+def sibling_ckpts(tmp_path_factory):
+    from llm_d_fast_model_actuation_tpu.models import checkpoint, llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(jax.random.key(11), cfg)
+    da = str(tmp_path_factory.mktemp("cost-ckpt-a"))
+    checkpoint.save_params(da, cfg, params)
+    params_b = dict(params)
+    rng = np.random.default_rng(3)
+    params_b["lm_head"] = np.asarray(params["lm_head"]) + (
+        rng.standard_normal(np.asarray(params["lm_head"]).shape)
+        .astype(np.float32)
+    )
+    db = str(tmp_path_factory.mktemp("cost-ckpt-b"))
+    checkpoint.save_params(db, cfg, params_b)
+    return da, db
+
+
+def _service(extra=""):
+    from llm_d_fast_model_actuation_tpu.engine.server import (
+        EngineService,
+        parse_engine_options,
+    )
+
+    return EngineService(
+        parse_engine_options(
+            "--model tiny --num-pages 8 --page-size 8 --max-batch 2 "
+            "--max-model-len 64 --swap-bucket-mib 1 "
+            "--model-pool-mib 512 " + extra
+        )
+    )
+
+
+@pytest.fixture(scope="module")
+def cost_service(sibling_ckpts):
+    da, _ = sibling_ckpts
+    svc = _service(f"--checkpoint-dir {da}")
+    yield svc
+    svc.shutdown()
+
+
+def test_price_swap_delta_byte_exact_and_recorded(
+    cost_service, sibling_ckpts
+):
+    svc = cost_service
+    da, db = sibling_ckpts
+    svc.swap("tiny", checkpoint_dir=db)  # cold: parks the A variant
+    svc.swap("tiny", checkpoint_dir=da)  # warm-up sibling hit
+    pred = svc.price_swap("tiny", checkpoint_dir=db)
+    assert pred["tier"] == "pool"
+    assert pred["measured"] is True  # swap EWMAs primed by the warm-up
+    assert pred["predicted_bytes_deduped"] > 0  # siblings share content
+    out = svc.swap("tiny", checkpoint_dir=db)
+    # byte prediction is deterministic from digests: EXACT
+    assert pred["predicted_bytes"] == out["bytes_moved"]
+    assert pred["predicted_s"] > 0
+    # the swap response carries the flight record with the prediction
+    rec = out["costs"]
+    assert rec["kind"] == "swap" and rec["outcome"] == "committed"
+    assert rec["predicted_bytes"] == rec["actual_bytes"]
+    assert rec["bytes_error_ratio"] == 0.0
+    assert rec["tier"] == "pool" and rec["trigger"] == "client"
+    # ... and the recorder served it
+    rows = svc.actuations_view(kind="swap")["records"]
+    assert rows and rows[-1]["seq"] == rec["seq"]
+    # prediction gauges refreshed
+    assert _sample(
+        "fma_engine_actuation_predicted_bytes", kind="swap"
+    ) == rec["predicted_bytes"]
+
+
+def test_price_swap_tiers_resident_and_cold(cost_service):
+    svc = cost_service
+    res = svc.price_swap(svc.args.model, svc.checkpoint_dir)
+    assert res["tier"] == "resident" and res["predicted_bytes"] == 0
+    cold = svc.price_swap("tiny-gemma")
+    assert cold["tier"] == "cold"
+    assert cold["predicted_bytes_out"] > 0  # the outgoing offload
+    assert cold["predicted_bytes_in"] > 0  # params + KV pool estimate
+    assert cold["predicted_s"] > 0
+    with pytest.raises(ValueError):
+        svc.price_swap("no-such-model")
+
+
+def test_failed_swap_recorded_rejection_not():
+    """A cold-build failure leaves an outcome="failed" flight record
+    (crash-loop churn is what the recorder audits); a request REJECTION
+    (unknown model) actuated nothing and records nothing."""
+    svc = _service()
+    try:
+        with pytest.raises(Exception):
+            svc.swap("hf:/nonexistent/model-dir")
+        rows = svc.actuations_view(kind="swap")["records"]
+        assert rows and rows[-1]["outcome"] == "failed"
+        assert rows[-1]["actual_bytes"] == 0
+        n = len(svc.actuations_view()["records"])
+        with pytest.raises(ValueError):
+            svc.swap("not-a-model")
+        assert len(svc.actuations_view()["records"]) == n
+    finally:
+        svc.shutdown()
+
+
+def test_cold_start_prediction_flagged_unmeasured():
+    """A fresh engine with no actuation history prices from the
+    conservative constants and says so (measured: false) — the 'when to
+    distrust the oracle' contract."""
+    svc = _service()  # random init: no coldload observation either
+    try:
+        pred = svc.price_swap("tiny-gemma")
+        assert pred["tier"] == "cold"
+        assert pred["measured"] is False
+        assert pred["predicted_s"] > 0  # fallback estimate, not zero
+        sleep_pred = svc.price_sleep()
+        assert sleep_pred["measured"] is False
+        assert sleep_pred["predicted_bytes"] > 0
+    finally:
+        svc.shutdown()
+
+
+def test_sleep_wake_priced_and_recorded():
+    svc = _service()
+    try:
+        before = _sample(
+            "fma_engine_actuation_seconds_count", kind="sleep",
+            phase="total",
+        )
+        svc.sleep(1)
+        pred_wake = svc.price_wake()
+        assert (
+            pred_wake["predicted_bytes"]
+            == svc.sleeper.stats.bytes_offloaded
+        )
+        svc.wake_up()
+        after = _sample(
+            "fma_engine_actuation_seconds_count", kind="sleep",
+            phase="total",
+        )
+        assert after == before + 1
+        assert _sample(
+            "fma_engine_actuation_seconds_count", kind="wake",
+            phase="h2d",
+        ) >= 1
+        rows = svc.actuations_view()["records"]
+        kinds = [r["kind"] for r in rows]
+        # the initial build logged a coldload row, then the two edges
+        assert kinds[0] == "coldload" and rows[0]["trigger"] == "startup"
+        assert "sleep" in kinds and "wake" in kinds
+        wake_row = [r for r in rows if r["kind"] == "wake"][-1]
+        assert wake_row["actual_bytes"] > 0
+        assert wake_row["predicted_bytes"] == wake_row["actual_bytes"]
+        # escalation trigger: L1 -> L2 while already asleep
+        svc.sleep(1)
+        svc.sleep(2)
+        esc = [r for r in svc.actuations_view()["records"]
+               if r["trigger"] == "escalation"]
+        assert esc and esc[-1]["kind"] == "sleep"
+        assert esc[-1]["tier"] == "discard"
+        svc.wake_up()
+    finally:
+        svc.shutdown()
+
+
+def _run_async(coro):
+    return asyncio.run(coro)
+
+
+async def _engine_client(service, fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from llm_d_fast_model_actuation_tpu.engine.server import build_app
+
+    client = TestClient(TestServer(build_app(service)))
+    await client.start_server()
+    try:
+        return await fn(client)
+    finally:
+        await client.close()
+
+
+def test_costs_and_actuations_endpoints(cost_service):
+    svc = cost_service
+
+    async def scenario(client):
+        r = await client.get("/v1/costs?model=tiny-gemma")
+        assert r.status == 200
+        costs = await r.json()
+        r = await client.get("/v1/actuations?n=5")
+        assert r.status == 200
+        acts = await r.json()
+        r = await client.get("/v1/stats")
+        stats = await r.json()
+        r = await client.get("/metrics")
+        text = await r.text()
+        r = await client.get("/v1/costs?model=")
+        assert r.status == 200  # empty model param = no extra candidate
+        return costs, acts, stats, text
+
+    costs, acts, stats, text = _run_async(_engine_client(svc, scenario))
+    # /v1/costs: all candidates in one call — resident + pooled + extras
+    tiers = {
+        (c.get("model"), c.get("checkpoint_dir", "")): c.get("tier")
+        for c in costs["candidates"]
+    }
+    assert any(t == "resident" for t in tiers.values())
+    assert any(t == "pool" for t in tiers.values())  # the parked sibling
+    assert tiers.get(("tiny-gemma", "")) == "cold"  # the ?model= extra
+    assert costs["bandwidth_gibps"]  # EWMAs measured by earlier swaps
+    assert "sleep" in costs and "wake" in costs
+    # /v1/actuations: bounded read, schema rows
+    assert len(acts["records"]) <= 5
+    assert acts["summary"]["recorded_total"] >= 1
+    # /v1/stats carries the same summary (one-poll-cycle contract: the
+    # launcher's ledger.costs lifts exactly this block)
+    assert stats["costs"]["prediction"]["recorded_total"] == (
+        acts["summary"]["recorded_total"]
+    )
+    assert stats["costs"]["bandwidth_gibps"].keys() == (
+        costs["bandwidth_gibps"].keys()
+    )
+    # exposition: the new families are present
+    assert "fma_engine_actuation_seconds_bucket" in text
+    assert "fma_engine_actuation_predicted_bytes" in text
+    assert "fma_engine_cost_prediction_error_ratio" in text
+
+
+# -- launcher rollup ----------------------------------------------------------
+
+
+def _fake_engine_kickoff(config, log_path):
+    with open(log_path, "ab", buffering=0) as f:
+        f.write(b"fake engine\n")
+    time.sleep(300)
+
+
+def test_launcher_ledger_costs_block(monkeypatch, tmp_path, request):
+    from llm_d_fast_model_actuation_tpu.launcher.chiptranslator import (
+        ChipTranslator,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.instance import (
+        InstanceConfig,
+    )
+    from llm_d_fast_model_actuation_tpu.launcher.manager import (
+        EngineProcessManager,
+        StatsFailed,
+    )
+
+    manager = EngineProcessManager(
+        ChipTranslator.create(
+            mock_chips=True, mock_chip_count=4, mock_topology="2x2"
+        ),
+        log_dir=str(tmp_path),
+        kickoff=_fake_engine_kickoff,
+        enforce_chip_exclusivity=False,
+    )
+    request.addfinalizer(lambda: manager.stop_all_instances(timeout=2))
+    for iid in ("c-a", "c-down"):
+        manager.create_instance(
+            InstanceConfig(options="--model tiny", chip_ids=None),
+            instance_id=iid,
+        )
+    costs_row = {
+        "bandwidth_gibps": {"swap.d2h": {"gibps": 1.5, "samples": 3}},
+        "prediction": {
+            "recorded_total": 4,
+            "byte_exact_frac": 1.0,
+            "mean_abs_seconds_error_ratio": 0.1,
+        },
+    }
+
+    def fake_poll(iid, timeout):
+        if iid == "c-down":
+            raise StatsFailed(iid, 502, "engine unreachable")
+        return {
+            "model": "tiny",
+            "queue_depth": 0,
+            "slo": {},
+            "costs": costs_row,
+            "uptime_s": 10.0,
+        }
+
+    monkeypatch.setattr(manager, "_poll_instance_stats", fake_poll)
+    out = manager.get_all_instances_status(include_fleet=True)
+    # the ledger's costs block carries each reporting child's oracle
+    # summary — same poll cycle as the fleet block (one detailed read =
+    # demand + state + cost)
+    assert out["ledger"]["costs"] == {"c-a": costs_row}
+    assert out["fleet"]["per_instance"]["c-a"]["costs"] == costs_row
+    # default (fleet-free) reads carry no costs block either
+    assert "costs" not in manager.get_all_instances_status()["ledger"]
