@@ -1477,6 +1477,7 @@ def _measure_fleet() -> None:
     from llm_d_fast_model_actuation_tpu.models import llama
 
     seed = int(_argv_value("--seed", "0"))
+    zero_drain = "--zero-drain" in sys.argv
     n_models = max(2, int(os.environ.get("FMA_FLEETBENCH_MODELS", "3")))
     duration = float(os.environ.get("FMA_FLEETBENCH_DURATION", "12"))
     base_rate = float(os.environ.get("FMA_FLEETBENCH_RATE", "6"))
@@ -1538,6 +1539,7 @@ def _measure_fleet() -> None:
             f"--model-pool-mib 512 --content-hash on "
             f"--slo-ttft-ms {slo_ttft_ms} --slo-tpot-ms {slo_tpot_ms} "
             f"--arrival-ewma-tau-s 10"
+            + (" --zero-drain on" if zero_drain else "")
         )
         env_vars = {}
         if jax.devices()[0].platform != "tpu":
@@ -1619,6 +1621,15 @@ def _measure_fleet() -> None:
                         ttft_s=u.get("time_to_first_token_s") or 0.0,
                         queue_wait_s=u.get("queue_wait_s") or 0.0,
                         tpot_s=u.get("decode_tpot_s"),
+                        # zero-drain bit-exactness replay: what this
+                        # (possibly preempted-and-resumed) stream
+                        # produced, re-checked against an uninterrupted
+                        # run after the trace
+                        prompt=list(arr.prompt),
+                        max_tokens=arr.max_tokens,
+                        token_ids=(body.get("choices") or [{}])[0].get(
+                            "token_ids"
+                        ),
                     )
                 else:
                     # a 5xx here is (virtually always) the router's own
@@ -1696,12 +1707,26 @@ def _measure_fleet() -> None:
             with mu:
                 pending = any(queues.values())
                 busy = inflight_by_model[resident[0]] > 0
-            if not pending:
+                stuck = [
+                    i
+                    for i, c in inflight_by_model.items()
+                    if c > 0 and i != resident[0]
+                ]
+            if not pending and not (zero_drain and stuck):
                 break
             if busy:
                 time.sleep(0.05)
                 continue
-            router_step(force=True)
+            if pending:
+                router_step(force=True)
+            else:
+                # zero-drain: requests preempted by a swap stay parked
+                # (HTTP connection open) until their model returns —
+                # walk the stuck set so every parked stream resumes
+                swap_to(stuck[0])
+                with mu:
+                    resident[0] = stuck[0]
+                    last_swap[0] = time.monotonic()
         # no silent caps: arrivals still queued when the drain deadline
         # expired were offered load that never got served — they must
         # count against attainment, loudly, not vanish from the result
@@ -1718,6 +1743,47 @@ def _measure_fleet() -> None:
         for t in threads:
             t.join(timeout=180)
         wall_s = time.monotonic() - t0
+
+        # --- zero-drain bit-exactness: every served (possibly
+        # preempted-and-resumed) greedy stream must equal an
+        # UNINTERRUPTED run of the same prompt — replay each request
+        # with its model pinned resident and compare token ids. Replay
+        # swaps hit an idle engine (nothing in flight), so they park
+        # nothing and abort nothing.
+        zd_checked = zd_mismatches = 0
+        if zero_drain:
+            with mu:
+                replay = [
+                    (
+                        r["model"], r["prompt"], r["max_tokens"],
+                        r["token_ids"],
+                    )
+                    for r in results
+                    if r.get("ok") and r.get("token_ids") is not None
+                ]
+            for i in range(n_models):
+                todo = [r for r in replay if r[0] == i]
+                if not todo:
+                    continue
+                swap_to(i)
+                for _, prompt, mt, got in todo:
+                    status, body = _http_json(
+                        "POST", ebase + "/v1/completions",
+                        {
+                            "prompt": prompt,
+                            "max_tokens": mt,
+                            "ignore_eos": True,
+                        },
+                        timeout=120,
+                    )
+                    zd_checked += 1
+                    ref = (
+                        (body.get("choices") or [{}])[0].get("token_ids")
+                        if status == 200 and isinstance(body, dict)
+                        else None
+                    )
+                    if ref != got:
+                        zd_mismatches += 1
 
         # --- score ------------------------------------------------------
         met = 0
@@ -1838,6 +1904,31 @@ def _measure_fleet() -> None:
                 isinstance(launcher_metrics, str)
                 and "fma_launcher_fleet_slo_attainment" in launcher_metrics
             ),
+            # zero-drain scorecard (docs/perf.md "Zero-drain actuation"):
+            # swap-caused aborts (must be 0 with the flag on), how many
+            # preempted requests resumed, and the bit-exactness replay —
+            # the CI gate compares this run against the abort-mode run
+            # on the same seeded trace
+            "zero_drain": {
+                "enabled": zero_drain,
+                "swap_aborts": (
+                    int(
+                        (engine_stats.get("aborted") or {}).get("swap", 0)
+                    )
+                    if isinstance(engine_stats, dict)
+                    else None
+                ),
+                **(
+                    {
+                        k: (engine_stats.get("zero_drain") or {}).get(k)
+                        for k in ("preempted", "resumed", "aborted")
+                    }
+                    if isinstance(engine_stats, dict)
+                    else {}
+                ),
+                "bit_exact_checked": zd_checked,
+                "bit_exact_mismatches": zd_mismatches,
+            },
         },
     }
     if _trace_out_path():
@@ -1885,6 +1976,10 @@ def _run_child(
     tp = _bench_tp()
     if tp > 1:
         argv += ["--tensor-parallel-size", str(tp)]
+    if "--zero-drain" in sys.argv:
+        # fleet sub-bench: actuate under live load WITHOUT aborting
+        # streams (docs/perf.md "Zero-drain actuation")
+        argv.append("--zero-drain")
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )

@@ -841,6 +841,16 @@ class InferenceEngine:
         #: finished outside a step() call (drain_inflight before sleep):
         #: handed back by the next step() so the service resolves futures
         self._orphan_finished: List[Request] = []
+        #: zero-drain actuation (engine/parked.py): True while the KV
+        #: pool's device arrays were dropped by park_requests — the
+        #: sleeper's state then excludes the pool, and set_state rebuilds
+        #: a fresh one (rebuild_kv_pool) on restore
+        self.kv_detached = False
+        #: set by the service when --zero-drain applies to this engine:
+        #: pricing peeks (plan_swap, _offload_wire_bytes) then size the
+        #: offload WITHOUT the KV pool — matching what the actual
+        #: park-then-offload will move
+        self.zero_drain_park = False
         # -- token-packed mixed-batch serving (cfg.packed_serving) ----------
         self._packed = bool(cfg.packed_serving)
         if self._packed and cfg.pipeline_decode:
@@ -2251,6 +2261,286 @@ class InferenceEngine:
             or self._inflight is not None
             or bool(self._orphan_finished)
         )
+
+    # -- zero-drain park/resume (engine/parked.py) ---------------------------
+
+    def parked_page_ids(self) -> List[int]:
+        """Unique pool page ids a park would page out right now — the
+        first ``ceil(pos / page_size)`` pages of every live mid-decode
+        request, in order of first use. Shared prefix pages appear once.
+        Also the byte basis of the cost oracle's park pricing: the park
+        itself gathers exactly this list, so predicted and actual
+        page-out bytes agree by construction."""
+        out: List[int] = []
+        seen: set = set()
+        for req in self._slots:
+            if req is None or req.done or req.prefilling:
+                continue
+            used = (
+                PageAllocator.pages_needed(req.pos, self.cfg.page_size)
+                if req.pos > 0
+                else 0
+            )
+            for p in req.pages[:used]:
+                if p not in seen:
+                    seen.add(p)
+                    out.append(p)
+        return out
+
+    def park_requests(self, bucket_bytes: "int | None" = None):
+        """Preempt every live and queued request into a host-resident
+        :class:`~.parked.ParkedRequests` bundle and drop the KV pool's
+        device arrays (``kv_detached``): the engine is then empty — an
+        actuation can sleep/swap it without aborting anything, and
+        ``resume_parked`` re-seats the bundle bit-exact afterwards.
+
+        Ordering is failure-safe: the KV page-out (fault point
+        ``kvsave.d2h``) runs BEFORE any scheduler state is touched, so a
+        failed page-out raises with the engine still serving and the
+        caller falls back to today's abort path. Returns
+        ``(bundle, finished)`` — ``finished`` are requests a pipelined
+        drain completed during the quiesce (the caller resolves their
+        futures; they were never preempted).
+
+        Mid-prefill (packed) requests are demoted back to the waiting
+        queue instead of carrying KV: prefill is a pure function of the
+        prompt and no RNG split is consumed before its final segment, so
+        re-running it on resume reproduces identical output."""
+        from . import parked as parked_mod
+
+        self.drain_inflight()
+        live_reqs = [
+            r for r in self._slots
+            if r is not None and not r.done and not r.prefilling
+        ]
+        demote = [
+            r for r in self._slots
+            if r is not None and not r.done and r.prefilling
+        ]
+        page_ids = self.parked_page_ids()
+        k_host = v_host = None
+        kv_nbytes = 0
+        pageout_s = 0.0
+        if page_ids:
+            # the faultable transfer, first: nothing below runs unless
+            # every live page landed on host. Timed HERE, around the
+            # gather alone: the drain/bookkeeping outside it must not
+            # anchor the kvsave.d2h bandwidth EWMA low (the sleep.d2h
+            # pure-window discipline)
+            t0 = time.monotonic()
+            k_host, v_host = parked_mod.gather_pages_d2h(
+                self.pool, page_ids, bucket_bytes=bucket_bytes,
+                span_name="swap.kv_pageout",
+            )
+            pageout_s = time.monotonic() - t0
+            kv_nbytes = int(k_host.nbytes) + int(v_host.nbytes)
+        finished = list(self._orphan_finished)
+        self._orphan_finished = []
+        bundle = parked_mod.ParkedRequests(
+            page_ids=page_ids, k_host=k_host, v_host=v_host,
+            kv_nbytes=kv_nbytes, pageout_s=pageout_s,
+        )
+        meta_nbytes = 0
+        for r in live_reqs:
+            used = PageAllocator.pages_needed(r.pos, self.cfg.page_size)
+            pr = parked_mod.ParkedRequest(
+                req=r,
+                old_pages=list(r.pages[:used]),
+                counts_row=np.array(self._token_counts[r.slot], copy=True),
+                key_data=np.array(self._slot_keys[r.slot], copy=True),
+            )
+            meta_nbytes += pr.counts_row.nbytes + pr.key_data.nbytes
+            bundle.live.append(pr)
+        if self.prefix_cache is not None:
+            # refcounts and the hash index die with the pool; resumed
+            # pages re-acquire fresh references (the cache restarts cold)
+            for r in live_reqs + demote:
+                self.prefix_cache.release(r.pages)
+            self.prefix_cache.clear()
+        for r in demote:
+            r.prefilling = False
+            r.pos = 0
+            r.cached_tokens = 0
+            r.shared_pages = 0
+            r.pages = []
+            r.slot = -1
+            r._prefix_hashes = ()
+            if hasattr(r, "_blocked_state"):
+                del r._blocked_state
+            bundle.waiting.append(r)
+        for r in live_reqs:
+            r.slot = -1
+            r.pages = []
+        bundle.waiting.extend(self._waiting)
+        bundle.nbytes = kv_nbytes + meta_nbytes
+        # detach: wipe the scheduler wholesale (the pool and allocator
+        # are rebuilt fresh by set_state/rebuild_kv_pool on restore)
+        self._slots = [None] * self.cfg.max_batch
+        self._waiting = []
+        self._page_table[:] = 0
+        self._positions[:] = 0
+        self._last_tokens[:] = 0
+        self._temps[:] = 0.0
+        self._topps[:] = 1.0
+        self._pres[:] = 0.0
+        self._freqs[:] = 0.0
+        self._token_counts[:] = 0
+        self._budgets[:] = 0
+        self._slot_keys[:] = 0
+        self._eos_on[:] = 1
+        self._bias[:] = 0.0
+        self._fresh_slots.clear()
+        self._rows_stale = False
+        self._dirty = True
+        for leaf in self.pool.as_tuple():
+            if leaf is not None:
+                leaf.delete()
+        self.pool.k_pages = None
+        self.pool.v_pages = None
+        self.kv_detached = True
+        return bundle, finished
+
+    def rebuild_kv_pool(self) -> None:
+        """Fresh device KV pool + allocator after a zero-drain park
+        dropped them (called by the sleeper's set_state when the restored
+        state carries no "kv" subtree, and by rollback paths)."""
+        m = self._model_cfg
+        self.pool = PagePool.create(
+            m.num_layers,
+            self.cfg.num_pages,
+            self.cfg.page_size,
+            m.num_kv_heads,
+            m.head_dim,
+            dtype=m.dtype,
+            mesh=self.mesh,
+        )
+        if self.mesh is None:
+            self.pool.replace(
+                jax.device_put(self.pool.as_tuple(), jax.devices()[0])
+            )
+        self.allocator = PageAllocator(self.cfg.num_pages)
+        self.kv_detached = False
+
+    def resume_parked(
+        self, bundle, bucket_bytes: "int | None" = None
+    ) -> Tuple[int, int]:
+        """Re-seat a parked bundle into this (awake, empty-pool) engine:
+        allocate pages, page the saved KV back in (fault point
+        ``kvrestore.h2d``), rewrite page tables through the old->new page
+        map (preserving prefix-page sharing between live requests), and
+        restore every per-slot mirror — the next dispatch re-uploads the
+        whole scheduler state (_dirty), so the resumed decode continues
+        bit-exact mid-stream.
+
+        Returns ``(live_resumed, kv_pagein_bytes)``. On a page-in
+        failure everything is unwound — allocated pages freed, no slot
+        seated, ``bundle.waiting`` re-queued (they carried no KV and lost
+        nothing) — and :class:`~.parked.ParkedResumeFailed` is raised so
+        the caller aborts the live requests with cause ``state_loss``;
+        the engine stays healthy with an empty pool."""
+        from . import parked as parked_mod
+
+        if self.kv_detached:
+            raise parked_mod.ParkedResumeFailed(
+                "resume before the KV pool was rebuilt"
+            )
+        old2new: Dict[int, int] = {}
+        seated: List[tuple] = []
+        moved = 0
+        try:
+            for pr in bundle.live:
+                r = pr.req
+                need = PageAllocator.pages_needed(
+                    len(r.prompt) + r.max_new_tokens, self.cfg.page_size
+                )
+                new_pages: List[int] = []
+                fresh: List[int] = []  # allocated by THIS request
+                fresh_old: List[int] = []  # ...and mapped into old2new
+                try:
+                    for j in range(need):
+                        old = (
+                            pr.old_pages[j]
+                            if j < len(pr.old_pages)
+                            else None
+                        )
+                        if old is not None and old in old2new:
+                            new_pages.append(old2new[old])
+                            continue
+                        got = self._alloc_pages(1)[0]
+                        fresh.append(got)
+                        if old is not None:
+                            old2new[old] = got
+                            fresh_old.append(old)
+                        new_pages.append(got)
+                except BaseException:
+                    # free this request's own partial allocation (pages
+                    # reused from earlier requests stay theirs; fully
+                    # seated requests are unwound by the outer handler)
+                    self.allocator.free(fresh)
+                    for old in fresh_old:
+                        old2new.pop(old, None)
+                    raise
+                if self.prefix_cache is not None:
+                    # one reference per referencing sequence, like
+                    # _admit: retire's release then refcounts shared
+                    # prefix pages correctly
+                    self.prefix_cache.acquire(new_pages)
+                seated.append((pr, new_pages))
+            if bundle.page_ids:
+                pairs = [
+                    (i, old2new[p])
+                    for i, p in enumerate(bundle.page_ids)
+                    if p in old2new
+                ]
+                moved = parked_mod.scatter_pages_h2d(
+                    self.pool, pairs, bundle.k_host, bundle.v_host,
+                    bucket_bytes=bucket_bytes,
+                    span_name="wake.kv_pagein",
+                )
+        except BaseException as e:
+            for pr, new_pages in seated:
+                if self.prefix_cache is not None:
+                    self.allocator.free(
+                        self.prefix_cache.release(new_pages)
+                    )
+                else:
+                    self.allocator.free(new_pages)
+            self._waiting.extend(bundle.waiting)
+            self._dirty = True
+            raise parked_mod.ParkedResumeFailed(
+                f"{type(e).__name__}: {e}"
+            ) from e
+        # no failure past this point: seating is pure host bookkeeping
+        for pr, new_pages in seated:
+            r = pr.req
+            slot = self._free_slot()
+            assert slot is not None, "parked batch exceeded max_batch"
+            r.slot = slot
+            r.pages = new_pages
+            r.shared_pages = 0
+            r._prefix_hashes = ()
+            self._slots[slot] = r
+            row = np.zeros((self.cfg.pages_per_seq,), dtype=np.int32)
+            row[: len(new_pages)] = new_pages
+            self._page_table[slot] = row
+            self._positions[slot] = r.pos
+            self._last_tokens[slot] = (
+                r.out_tokens[-1] if r.out_tokens else 0
+            )
+            self._temps[slot] = r.temperature
+            self._topps[slot] = r.top_p
+            self._pres[slot] = r.presence_penalty
+            self._freqs[slot] = r.frequency_penalty
+            self._token_counts[slot] = pr.counts_row
+            self._budgets[slot] = r.max_new_tokens - len(r.out_tokens)
+            self._eos_on[slot] = 0 if r.ignore_eos else 1
+            self._bias[slot] = 0.0
+            for t, v in r.logit_bias.items():
+                self._bias[slot, t] = v
+            self._slot_keys[slot] = pr.key_data
+        self._waiting = list(bundle.waiting) + self._waiting
+        self._dirty = True
+        return len(seated), moved
 
     def abort(self, seq_id: int, reason: str = "aborted") -> bool:
         """Abort one request (client disconnect): waiting requests are

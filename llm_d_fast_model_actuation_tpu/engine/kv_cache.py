@@ -64,6 +64,23 @@ class PagePool:
         return 2 * elems * int(np.dtype(dtype).itemsize)
 
     @classmethod
+    def page_nbytes(
+        cls,
+        num_layers: int,
+        page_size: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+    ) -> int:
+        """Device bytes ONE page occupies across all layers, k and v —
+        what the zero-drain park (engine/parked.py) and its pre-transfer
+        pricing multiply by the live page count, kept next to
+        :meth:`estimate_nbytes` so both derive from the one pool layout."""
+        return cls.estimate_nbytes(
+            num_layers, 1, page_size, num_kv_heads, head_dim, dtype=dtype
+        )
+
+    @classmethod
     def create(
         cls,
         num_layers: int,

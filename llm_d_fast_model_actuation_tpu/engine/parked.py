@@ -1,0 +1,253 @@
+"""Zero-drain actuation: live request state, paged out like weights.
+
+Today an actuation and the requests it preempts are mutually exclusive:
+a swap aborts every queued and in-flight request of the outgoing model.
+The paged KV cache makes request state chunkable exactly the way weights
+are — a request's KV lives in whole pages, its scheduler state in small
+per-slot host rows — so the transactional sleep/swap discipline extends
+to requests: **park** them (page the live KV pages to host, capture the
+per-slot scheduler rows and RNG key state), store the bundle alongside
+the slept weights in the model pool, and **resume** them bit-exact after
+the wake/swap-back (page the KV back in, re-seat page tables and slots).
+
+This module holds the data shapes and the two transfer primitives; the
+park/resume *orchestration* lives on :class:`~.engine.InferenceEngine`
+(it owns the scheduler state being detached/re-seated) and the service
+wires it into the swap/sleep verbs behind ``--zero-drain``
+(engine/server.py).
+
+Transfer discipline matches engine/sleep.py: size-bounded chunks (whole
+pages, never split), each chunk landed before the next is issued, with
+named fault-injection points (``kvsave.d2h`` on page-out,
+``kvrestore.h2d`` on page-in — utils/faults.py) so the failure paths are
+deterministically drillable. A page-out failure leaves the engine
+untouched (the caller falls back to the abort path); a page-in failure
+is rolled back to a *clean* abort of the parked requests with the
+existing ``state_loss`` cause — never a wedged slot or a corrupted page
+table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import faults, tracing
+
+#: chunk bound fallback when the caller passes none: matches the swap
+#: bucket default (engine/sleep.py DEFAULT_SWAP_BUCKET_BYTES)
+DEFAULT_KV_CHUNK_BYTES = 256 << 20
+
+
+class ParkedResumeFailed(RuntimeError):
+    """A zero-drain resume failed mid page-in and was rolled back: no
+    slot was seated, every allocated page was returned, and the engine
+    is healthy with an empty (fresh) KV pool. The parked requests' KV is
+    unrecoverable — the caller aborts them with cause ``state_loss``."""
+
+
+@dataclass
+class ParkedRequest:
+    """One preempted mid-generation request: the pure-host Request
+    object plus the device-derived state a bit-exact resume needs."""
+
+    req: Any  #: engine.Request — prompt, emitted tokens, sampling knobs
+    #: pool page ids (old pool) holding this request's live KV, page-table
+    #: order — the first ``ceil(pos / page_size)`` of its allocation
+    old_pages: List[int] = field(default_factory=list)
+    #: [vocab] int32 token-count row (penalties input). NOT recomputable
+    #: from the Request: stop-stripped tokens stay counted.
+    counts_row: Optional[np.ndarray] = None
+    #: [2] uint32 RNG key data — the slot's key stream position
+    key_data: Optional[np.ndarray] = None
+
+
+@dataclass
+class ParkedRequests:
+    """Everything a preemption displaced, host-resident: what the model
+    pool byte-counts alongside the slept weights and what
+    ``resume_parked`` re-seats after the wake/swap-back."""
+
+    #: mid-decode requests with live KV (ParkedRequest each)
+    live: List[ParkedRequest] = field(default_factory=list)
+    #: queued requests with no device state yet (engine Request objects;
+    #: includes mid-prefill requests demoted back to the queue — prefill
+    #: is a pure function of the prompt and consumes no key split until
+    #: its final segment, so re-running it is bit-exact)
+    waiting: List[Any] = field(default_factory=list)
+    #: unique old-pool page ids in gather order (axis 1 of k/v_host)
+    page_ids: List[int] = field(default_factory=list)
+    #: gathered live pages [num_layers, len(page_ids), page_size, kvh, hd]
+    k_host: Optional[np.ndarray] = None
+    v_host: Optional[np.ndarray] = None
+    kv_nbytes: int = 0
+    #: pool-budget accounting: KV payload + scheduler-row metadata
+    nbytes: int = 0
+    #: service-owned: seq_id -> concurrent Future for live+waiting
+    futures: Dict[int, Any] = field(default_factory=dict)
+    #: service-owned: raw ``_pending`` submit tuples parked on swap
+    pending: List[Any] = field(default_factory=list)
+    #: the PURE d2h page-out window (gather_pages_d2h only — the engine
+    #: quiesce and host bookkeeping around it excluded): what the
+    #: kvsave.d2h bandwidth EWMA observes and priced sleep records score
+    #: against, same discipline as sleep.d2h's pure transfer window
+    pageout_s: float = 0.0
+
+    @property
+    def preempted(self) -> int:
+        return len(self.live) + len(self.waiting) + len(self.pending)
+
+
+def _pool_page_nbytes(k_pages: Any, v_pages: Any) -> int:
+    """Bytes one page occupies across k+v and all layers, derived from
+    the live pool arrays (shape [layers, num_pages, page_size, kvh, hd])."""
+    n = max(1, int(k_pages.shape[1]))
+    return (int(k_pages.nbytes) + int(v_pages.nbytes)) // n
+
+
+def _chunks(n: int, per_chunk: int) -> List[Tuple[int, int]]:
+    out = []
+    i = 0
+    while i < n:
+        j = min(n, i + per_chunk)
+        out.append((i, j))
+        i = j
+    return out
+
+
+#: ONE jitted donated scatter for every resume (lazy: module import must
+#: not touch a backend): jit's cache keys on function identity, so a
+#: per-call lambda would recompile the scatter inside every resume
+#: window — the compile-in-transfer-window cost warm_quant_ops exists to
+#: avoid — and pollute the kvrestore.h2d bandwidth EWMA with compile time
+_SCATTER = None
+
+
+def _scatter_fn():
+    global _SCATTER
+    if _SCATTER is None:
+        import jax
+
+        _SCATTER = jax.jit(
+            lambda pages, idx, vals: pages.at[:, idx].set(vals),
+            donate_argnums=(0,),
+        )
+    return _SCATTER
+
+
+def gather_pages_d2h(
+    pool: Any,
+    page_ids: Sequence[int],
+    bucket_bytes: Optional[int] = None,
+    span_name: str = "swap.kv_pageout",
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Page the listed pool pages to host, chunk by chunk: gather a
+    chunk's pages on device, move it D2H, free the device staging, then
+    issue the next chunk — peak extra HBM is one chunk. Fires the
+    ``kvsave.d2h`` fault point per chunk. Pure: the pool is read, never
+    written, so a mid-transfer failure leaves the engine untouched and
+    the caller falls back to the abort path."""
+    import jax
+    import jax.numpy as jnp
+
+    ids = list(page_ids)
+    per_page = _pool_page_nbytes(pool.k_pages, pool.v_pages)
+    bucket = bucket_bytes or DEFAULT_KV_CHUNK_BYTES
+    per_chunk = max(1, int(bucket) // max(1, per_page))
+    layers, _, ps, kvh, hd = pool.k_pages.shape
+    k_host = np.empty((layers, len(ids), ps, kvh, hd), pool.k_pages.dtype)
+    v_host = np.empty_like(k_host)
+    traced = tracing.enabled()
+    parent = tracing.current_context() if traced else None
+    for lo, hi in _chunks(len(ids), per_chunk):
+        sp = None
+        if traced:
+            sp = tracing.begin(
+                span_name, parent=parent, activate=False,
+                pages=hi - lo, bytes=(hi - lo) * per_page,
+            )
+        try:
+            faults.fire("kvsave.d2h")
+            idx = jnp.asarray(ids[lo:hi], jnp.int32)
+            k_sel = jnp.take(pool.k_pages, idx, axis=1)
+            v_sel = jnp.take(pool.v_pages, idx, axis=1)
+            kh, vh = jax.device_get((k_sel, v_sel))
+            # materialized copies: device_get can return views aliasing
+            # buffers on CPU-family backends (same rule as sleep staging)
+            k_host[:, lo:hi] = np.asarray(kh)
+            v_host[:, lo:hi] = np.asarray(vh)
+            k_sel.delete()
+            v_sel.delete()
+        except BaseException as e:
+            if sp is not None:
+                sp.set(error=f"{type(e).__name__}: {e}")
+                sp.end()
+            raise
+        if sp is not None:
+            sp.end()
+    return k_host, v_host
+
+
+def scatter_pages_h2d(
+    pool: Any,
+    pairs: Sequence[Tuple[int, int]],
+    k_host: np.ndarray,
+    v_host: np.ndarray,
+    bucket_bytes: Optional[int] = None,
+    span_name: str = "wake.kv_pagein",
+) -> int:
+    """Page parked KV back into the (fresh) pool: ``pairs`` maps source
+    index (axis 1 of k/v_host) -> destination page id. Chunked H2D with
+    the ``kvrestore.h2d`` fault point per chunk; the pool arrays are
+    updated in place via donated jit scatters (no whole-pool copy per
+    chunk). Returns the wire bytes moved. A failure propagates with the
+    pool left VALID (partially restored pages are only reachable once
+    the caller seats page tables, which it never does after a failure)."""
+    import jax
+    import jax.numpy as jnp
+
+    if not pairs:
+        return 0
+    per_page = _pool_page_nbytes(pool.k_pages, pool.v_pages)
+    bucket = bucket_bytes or DEFAULT_KV_CHUNK_BYTES
+    per_chunk = max(1, int(bucket) // max(1, per_page))
+    scat = _scatter_fn()
+    sharding = getattr(pool.k_pages, "sharding", None)
+    moved = 0
+    traced = tracing.enabled()
+    parent = tracing.current_context() if traced else None
+    for lo, hi in _chunks(len(pairs), per_chunk):
+        chunk = pairs[lo:hi]
+        sp = None
+        if traced:
+            sp = tracing.begin(
+                span_name, parent=parent, activate=False,
+                pages=len(chunk), bytes=len(chunk) * per_page,
+            )
+        try:
+            faults.fire("kvrestore.h2d")
+            src = [s for s, _ in chunk]
+            dst = jnp.asarray([d for _, d in chunk], jnp.int32)
+            kh = np.ascontiguousarray(k_host[:, src])
+            vh = np.ascontiguousarray(v_host[:, src])
+            if sharding is not None:
+                # land the chunk pre-sharded like the pool it joins (the
+                # kvh axis is 'tp'-sharded on meshes; NamedSharding is
+                # shape-agnostic, so the pool's own sharding applies)
+                kd, vd = jax.device_put((kh, vh), (sharding, sharding))
+            else:
+                kd, vd = jax.device_put((kh, vh))
+            pool.k_pages = scat(pool.k_pages, dst, kd)
+            pool.v_pages = scat(pool.v_pages, dst, vd)
+            jax.block_until_ready((pool.k_pages, pool.v_pages))
+            moved += kh.nbytes + vh.nbytes
+        except BaseException as e:
+            if sp is not None:
+                sp.set(error=f"{type(e).__name__}: {e}")
+                sp.end()
+            raise
+        if sp is not None:
+            sp.end()
+    return moved

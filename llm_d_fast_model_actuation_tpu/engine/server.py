@@ -92,6 +92,25 @@ ENGINE_ABORTS = Counter(
     "preempted queued/in-flight work), state_loss (level-2 wake)",
     ["model", "reason"],
 )
+# Zero-drain actuation (docs/perf.md "Zero-drain actuation"): instead of
+# aborting, --zero-drain parks the victim model's live requests (KV pages
+# paged out beside the slept weights) and resumes them bit-exact after the
+# wake/swap-back. Every preempted request eventually resolves to exactly
+# one outcome; the byte counter is the parked-KV transfer volume.
+ENGINE_PREEMPTED = Counter(
+    "fma_engine_preempted_requests_total",
+    "Requests preempted by a zero-drain actuation, by final outcome "
+    "(resumed = re-seated and continued; aborted = parked state lost — "
+    "KV restore failure, parked-model eviction, or client disconnect "
+    "while parked)",
+    ["model", "outcome"],  # outcome: resumed | aborted
+)
+ENGINE_KV_PAGEOUT = Counter(
+    "fma_engine_kv_pageout_bytes_total",
+    "Parked-KV bytes moved by zero-drain preempt/resume, by direction "
+    "(d2h = page-out at park, h2d = page-in at resume)",
+    ["dir"],
+)
 ENGINE_KV_USAGE = Gauge(
     "fma_engine_kv_cache_usage_ratio",
     "Fraction of KV pages in use",
@@ -530,6 +549,20 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "shorter reacts faster to bursts, longer smooths them",
     )
     p.add_argument(
+        "--zero-drain",
+        choices=["on", "off"],
+        default="off",
+        help="preempt, page out, and resume live requests across model "
+        "hot-swaps and level-1 sleeps instead of aborting them "
+        "(docs/perf.md 'Zero-drain actuation'): the victim model's live "
+        "KV pages are paged to host beside its slept weights "
+        "(byte-counted against --model-pool-mib) and the streams resume "
+        "mid-decode bit-exact on wake/swap-back. off (default) keeps "
+        "today's abort path byte-for-byte. Multi-host gangs are "
+        "rejected; level-2 and device-releasing sleeps keep their "
+        "existing semantics",
+    )
+    p.add_argument(
         "--sleep-release-devices",
         default="auto",
         choices=["auto", "always", "never"],
@@ -779,6 +812,16 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
                 "bit-for-bit); single-process --tensor-parallel-size "
                 "meshes compose fine"
             )
+    if getattr(args, "zero_drain", "off") == "on":
+        gang = getattr(args, "num_processes", 0) or int(
+            os.environ.get("FMA_NUM_PROCESSES", "0") or 0
+        )
+        if gang > 1:
+            raise ValueError(
+                "--zero-drain is not supported for multi-host gangs "
+                "(parked request bundles are process-local; gang "
+                "actuation keeps today's abort semantics)"
+            )
     if getattr(args, "pool_disk_mib", 0) < 0:
         raise ValueError("--pool-disk-mib must be >= 0")
     if getattr(args, "exec_pool_mib", 0) < 0:
@@ -894,6 +937,11 @@ class _ModelRuntime:
     #: random-init/sharded/quantized builds): drives the delta-swap's
     #: device-array reuse and the pool's cross-variant dedup
     digests: Optional[Dict[str, str]] = None
+    #: zero-drain actuation (engine/parked.py): the ParkedRequests bundle
+    #: this runtime's preempted live work was paged into — stored with
+    #: the slept weights, byte-counted against the pool budget, resumed
+    #: on wake/swap-back (None = nothing parked)
+    parked: Optional[Any] = None
 
 
 class EngineService:
@@ -947,6 +995,14 @@ class EngineService:
         #: actuation edges this process performed (swap | sleep | wake):
         #: with uptime, the fleet rollup's actuations/hour
         self._actuations: Dict[str, int] = {}
+        # Zero-drain actuation (docs/perf.md "Zero-drain actuation"):
+        # preempt/park/resume counters mirrored into /v1/stats. Guarded
+        # by _slo_mu like the rest of the lifecycle accounting.
+        self._zero_drain = getattr(args, "zero_drain", "off") == "on"
+        self._zd_preempted = 0
+        self._zd_resumed = 0
+        self._zd_aborted = 0
+        self._zd_parked_bytes = 0
         self._arrival = _RateEWMA(
             getattr(args, "arrival_ewma_tau_s", 30.0) or 30.0
         )
@@ -1136,6 +1192,27 @@ class EngineService:
         # concurrent jax.profiler capture per process.
         self._profile_mu = threading.Lock()
         self._profile_dir: Optional[str] = None
+        # Release-on-sleep is resolved BEFORE the first build: zero-drain
+        # parking is off for device-releasing sleeps (the park's host
+        # bundle survives, but the restore contract is the full-state
+        # numpy staging path), and the built engine's zero_drain_park
+        # flag — which pricing peeks read — depends on this answer.
+        import jax  # deliberately not module-level: parse-time must not touch a backend
+
+        mode = getattr(args, "sleep_release_devices", "auto")
+        self.release_on_sleep = (
+            mode == "always"
+            or (mode == "auto" and jax.default_backend() == "tpu")
+        )
+        if dist is not None:
+            # gang sleep is offload-only: device release would require
+            # every process to drop and re-join the distributed client in
+            # lockstep (engine/sleep.py raises on it)
+            self.release_on_sleep = False
+        if self._zero_drain and dist is not None:
+            raise ValueError(
+                "--zero-drain is not supported for multi-host gangs"
+            )
         # The startup span parents on FMA_TRACEPARENT when the spawning
         # launcher stamped one (utils/tracing.py): the child's initial
         # build joins the create-instance trace across the fork.
@@ -1166,18 +1243,6 @@ class EngineService:
             actual_bytes=self._last_build_stats.get("bytes_in", 0),
             actual_s=self._last_build_stats.get("h2d_s", 0.0),
         )
-        import jax  # deliberately not module-level: parse-time must not touch a backend
-
-        mode = getattr(args, "sleep_release_devices", "auto")
-        self.release_on_sleep = (
-            mode == "always"
-            or (mode == "auto" and jax.default_backend() == "tpu")
-        )
-        if dist is not None:
-            # gang sleep is offload-only: device release would require
-            # every process to drop and re-join the distributed client in
-            # lockstep (engine/sleep.py raises on it)
-            self.release_on_sleep = False
         if dist is not None and not self.is_follower:
             from .multihost import LockstepLeader
 
@@ -1228,6 +1293,18 @@ class EngineService:
         ENGINE_POOL_EVICTIONS.inc(len(victims))
         for victim in victims:
             rt = victim.runtime
+            bundle = getattr(rt, "parked", None)
+            if bundle is not None:
+                # the parked requests' KV dies with the evicted entry:
+                # resolve them to a clean state_loss abort, never a
+                # future that hangs forever
+                rt.parked = None
+                self._abort_parked_bundle(
+                    bundle,
+                    getattr(rt, "model_id", self.args.model),
+                    f"preempted requests lost: parked model evicted "
+                    f"({why})",
+                )
             if isinstance(rt, _PrefetchedWeights):
                 # staged host numpy: dropping the reference IS the free
                 rt.params_host = None
@@ -1794,6 +1871,10 @@ class EngineService:
                 logger.warning(
                     "transfer-quant op warmup failed", exc_info=True
                 )
+        # zero-drain pricing contract (engine/sleep.py peek_state): the
+        # oracle's offload peeks exclude the KV pool exactly when an
+        # actual offload of this engine will park first
+        engine.zero_drain_park = self._zero_drain_parks()
         self.builds_total += 1
         return _ModelRuntime(
             model_id=model_id,
@@ -1852,6 +1933,268 @@ class EngineService:
                 pass
         with self._slo_mu:
             self._arrival = _RateEWMA(self._arrival.tau_s)
+
+    # -- zero-drain actuation: preempt / park / resume (engine/parked.py;
+    # docs/perf.md "Zero-drain actuation") -----------------------------------
+
+    def _zero_drain_parks(self) -> bool:
+        """True when an actuation on the CURRENT engine preempts-and-
+        parks instead of aborting: --zero-drain on, single-process (gang
+        bundles would be per-process partial state), and no device
+        release (the release path's numpy staging restores full state —
+        today's stall-and-resume semantics already hold there)."""
+        return (
+            self._zero_drain
+            and not self.is_gang
+            and not getattr(self, "release_on_sleep", False)
+        )
+
+    def _park_pageout_bytes(self) -> int:
+        """Wire bytes a park of the current engine would page out d2h
+        right now — per-page bytes (one pool-layout definition:
+        PagePool.page_nbytes) times the live page count
+        (engine.parked_page_ids), the SAME arithmetic the park itself
+        performs, so predicted and actual park bytes agree exactly."""
+        if not self._zero_drain_parks():
+            return 0
+        from .kv_cache import PagePool
+
+        eng = self.engine
+        m = eng.cfg.model
+        per_page = PagePool.page_nbytes(
+            m.num_layers,
+            eng.cfg.page_size,
+            m.num_kv_heads,
+            m.head_dim,
+            dtype=m.dtype,
+        )
+        return per_page * len(eng.parked_page_ids())
+
+    def _park_current(self, park_pending: bool) -> Optional[Any]:
+        """Preempt the current engine's live work into a ParkedRequests
+        bundle: quiesce at the step boundary (caller holds the step
+        lock), page the live KV out (fault point ``kvsave.d2h``), detach
+        the scheduler, and move the displaced futures (and, on swap, the
+        pre-engine pending queue) into the bundle. Returns None — engine
+        untouched, caller falls back to the abort path — when the
+        page-out failed."""
+        eng = self.engine
+        try:
+            bundle, finished = eng.park_requests(
+                bucket_bytes=self._swap_bucket_bytes
+            )
+        except Exception:  # noqa: BLE001 — fall back to the abort path
+            logger.warning(
+                "zero-drain park failed; falling back to the abort path",
+                exc_info=True,
+            )
+            return None
+        # requests a pipelined drain completed during the quiesce: they
+        # finished on their own terms and were never preempted
+        for req in finished:
+            req.done_time = time.monotonic()
+            fut = self._futures.pop(req.seq_id, None)
+            if fut is not None:
+                self._fut_seq.pop(id(fut), None)
+                if not fut.done():
+                    fut.set_result(req)
+            self._observe_finished(req)
+        for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
+            fut = self._futures.pop(r.seq_id, None)
+            if fut is not None:
+                self._fut_seq.pop(id(fut), None)
+                bundle.futures[r.seq_id] = fut
+        if park_pending:
+            # still-queued HTTP submissions target the outgoing model
+            # (validated against its vocab): they park too and re-enter
+            # the pending queue on swap-back. pop-one-at-a-time, like
+            # the abort path: submit() appends lock-free
+            while self._pending:
+                bundle.pending.append(self._pending.pop(0))
+        if bundle.kv_nbytes:
+            ENGINE_KV_PAGEOUT.labels(dir="d2h").inc(bundle.kv_nbytes)
+            # the PURE gather window (engine.park_requests stamps it
+            # around the d2h alone): quiesce/bookkeeping must not
+            # anchor the bandwidth EWMA low
+            self.costs.observe_transfer(
+                "kvsave.d2h", bundle.kv_nbytes, bundle.pageout_s
+            )
+        with self._slo_mu:
+            self._zd_preempted += bundle.preempted
+            self._zd_parked_bytes += bundle.kv_nbytes
+        return bundle
+
+    def _abort_parked_bundle(
+        self, bundle: Any, model: str, why: str
+    ) -> int:
+        """A parked bundle can never resume (KV restore failed, parked
+        model evicted, L2 escalation dropped the host state): fail every
+        displaced future with the existing ``state_loss`` cause — a
+        clean abort, never a wedged slot."""
+        exc = RuntimeError(why)
+        n = 0
+        for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
+            fut = bundle.futures.get(r.seq_id)
+            if fut is not None and not fut.done():
+                fut.set_exception(exc)
+            n += 1
+        for entry in bundle.pending:
+            fut = entry[3]
+            if fut is not None and not fut.done():
+                fut.set_exception(exc)
+            n += 1
+        if n:
+            self._count_abort("state_loss", n)
+            ENGINE_PREEMPTED.labels(model=model, outcome="aborted").inc(n)
+        with self._slo_mu:
+            self._zd_aborted += n
+            self._zd_parked_bytes -= bundle.kv_nbytes
+        return n
+
+    def _resume_parked(self, rt: "_ModelRuntime") -> tuple:
+        """Re-seat a runtime's parked bundle into its (awake) engine:
+        page the KV back in (fault point ``kvrestore.h2d``), restore
+        futures and pending submissions, and let the serving loop
+        continue the streams mid-decode. Returns ``(resumed,
+        pagein_bytes, seconds, dropped, shortfall)`` — ``dropped``
+        counts parked requests whose clients vanished while parked;
+        ``shortfall`` is True whenever the page-in moved fewer bytes
+        than the bundle predicted (dropped clients, or a failed
+        restore), so callers record the actuation UNPRICED instead of
+        scoring a false byte-exactness miss. A restore failure is
+        rolled back to a clean abort (cause ``state_loss``) with the
+        engine healthy and serving — the transactional contract's abort
+        leg; re-queued waiting/pending requests (which carried no KV and
+        lost nothing) still count ``resumed``, so the documented
+        preempted = resumed + aborted balance always closes."""
+        from .parked import ParkedResumeFailed
+
+        bundle = rt.parked
+        if bundle is None:
+            return 0, 0, 0.0, 0, False
+        rt.parked = None
+        with self._slo_mu:
+            self._zd_parked_bytes -= bundle.kv_nbytes
+        eng = rt.engine
+
+        def _fut_dead(seq_id: int) -> bool:
+            fut = bundle.futures.get(seq_id)
+            return fut is not None and fut.done()
+
+        # clients that went away while parked (their futures were
+        # cancelled through the abort queue): drop before seating —
+        # decoding for a dead client is pure waste
+        dead = [pr for pr in bundle.live if _fut_dead(pr.req.seq_id)]
+        bundle.live = [
+            pr for pr in bundle.live if not _fut_dead(pr.req.seq_id)
+        ]
+        dead_wait = [r for r in bundle.waiting if _fut_dead(r.seq_id)]
+        bundle.waiting = [
+            r for r in bundle.waiting if not _fut_dead(r.seq_id)
+        ]
+        dropped = len(dead) + len(dead_wait)
+        if dropped:
+            self._count_abort("client", dropped)
+            ENGINE_PREEMPTED.labels(
+                model=rt.model_id, outcome="aborted"
+            ).inc(dropped)
+            with self._slo_mu:
+                self._zd_aborted += dropped
+        t0 = time.monotonic()
+        try:
+            n_live, moved = eng.resume_parked(
+                bundle, bucket_bytes=self._swap_bucket_bytes
+            )
+        except ParkedResumeFailed as e:
+            # rolled back inside the engine: no slot seated, pages
+            # freed, waiting re-queued (they carried no KV). The live
+            # requests' KV is gone — abort them cleanly, stay serving.
+            exc = RuntimeError(
+                f"preempted request aborted: zero-drain KV restore "
+                f"failed ({e})"
+            )
+            nlost = 0
+            for pr in bundle.live:
+                fut = bundle.futures.get(pr.req.seq_id)
+                if fut is not None and not fut.done():
+                    fut.set_exception(exc)
+                nlost += 1
+            for r in bundle.waiting:
+                fut = bundle.futures.get(r.seq_id)
+                if fut is not None and not fut.done():
+                    self._futures[r.seq_id] = fut
+                    self._fut_seq[id(fut)] = r.seq_id
+            self._pending.extend(bundle.pending)
+            if nlost:
+                self._count_abort("state_loss", nlost)
+                ENGINE_PREEMPTED.labels(
+                    model=rt.model_id, outcome="aborted"
+                ).inc(nlost)
+            # the re-queued waiting/pending requests carried no KV and
+            # continue serving: they RESUMED — without this the
+            # documented preempted = resumed + aborted balance
+            # (docs/operations.md) would never close after a drill
+            requeued = len(bundle.waiting) + len(bundle.pending)
+            if requeued:
+                ENGINE_PREEMPTED.labels(
+                    model=rt.model_id, outcome="resumed"
+                ).inc(requeued)
+            with self._slo_mu:
+                self._zd_aborted += nlost
+                self._zd_resumed += requeued
+            ENGINE_RECOVERIES.labels(
+                path="kvrestore", outcome="rolled_back"
+            ).inc()
+            self.degraded = (
+                f"zero-drain resume aborted {nlost} preempted "
+                f"request(s) with state_loss: {e}"
+            )
+            logger.warning(
+                "zero-drain resume failed for %s; %d preempted "
+                "request(s) aborted (state_loss)",
+                rt.model_id, nlost, exc_info=True,
+            )
+            self._new_work.set()
+            # shortfall=True: the prediction counted the bundle's pages,
+            # none moved — the caller must record unpriced
+            return 0, 0, time.monotonic() - t0, dropped, True
+        resume_s = time.monotonic() - t0
+        if moved:
+            ENGINE_KV_PAGEOUT.labels(dir="h2d").inc(moved)
+            self.costs.observe_transfer("kvrestore.h2d", moved, resume_s)
+        for seq_id, fut in bundle.futures.items():
+            if not fut.done():
+                self._futures[seq_id] = fut
+                self._fut_seq[id(fut)] = seq_id
+        self._pending.extend(bundle.pending)
+        resumed = n_live + len(bundle.waiting) + len(bundle.pending)
+        if resumed:
+            ENGINE_PREEMPTED.labels(
+                model=rt.model_id, outcome="resumed"
+            ).inc(resumed)
+        with self._slo_mu:
+            self._zd_resumed += resumed
+        self._new_work.set()
+        return resumed, moved, resume_s, dropped, dropped > 0
+
+    def _unpark_current(self, rt: "_ModelRuntime") -> None:
+        """Rollback leg of a failed actuation that had already parked:
+        put the preempted requests back into live serving (the
+        transactional contract's restore leg). The engine's pool is
+        rebuilt first when the park's detach is still in effect (a
+        pre-transfer rejection); a swap_states rollback already rebuilt
+        it through set_state."""
+        if rt.parked is None:
+            return
+        try:
+            if rt.engine.kv_detached:
+                rt.engine.rebuild_kv_pool()
+            self._resume_parked(rt)
+        except Exception:  # noqa: BLE001 — _resume_parked aborts cleanly itself
+            logger.warning(
+                "zero-drain unpark after a failed actuation could not "
+                "restore live serving", exc_info=True,
+            )
 
     # -- actuation cost oracle (GET /v1/costs; docs/operations.md
     # "Pricing an actuation") ------------------------------------------------
@@ -1990,15 +2333,27 @@ class EngineService:
                 ),
                 quant=self._sleep_quant,
             )
-            out_s, m1 = book.seconds_for("swap.d2h", p["wire_out"])
-            in_s, m2 = book.seconds_for("swap.h2d", p["wire_in"])
+            # zero-drain parked-KV payload rides both directions: the
+            # outgoing park's page-out and — when the candidate is a
+            # previously-parked runtime — its bundle's page-in. Without
+            # these the byte-exactness contract (byte_exact_frac)
+            # silently breaks on the first preempting swap.
+            park_out = self._park_pageout_bytes()
+            pb = getattr(entry.runtime, "parked", None)
+            park_in = pb.kv_nbytes if pb is not None else 0
+            out_s, m1 = book.seconds_for(
+                "swap.d2h", p["wire_out"] + park_out
+            )
+            in_s, m2 = book.seconds_for(
+                "swap.h2d", p["wire_in"] + park_in
+            )
             if book.has("swap.total"):
                 # effective whole-verb bandwidth from prior pool-hit
                 # swaps: predicts the wall directly (fixed per-swap
                 # overhead included), which the per-window components
                 # can't see
                 predicted_s, m_tot = book.seconds_for(
-                    "swap.total", p["bytes_moved"]
+                    "swap.total", p["bytes_moved"] + park_out + park_in
                 )
                 m1 = m2 = m_tot
             else:
@@ -2012,9 +2367,11 @@ class EngineService:
             return {
                 **out,
                 "tier": "pool",
-                "predicted_bytes": p["bytes_moved"],
-                "predicted_bytes_out": p["wire_out"],
-                "predicted_bytes_in": p["wire_in"],
+                "predicted_bytes": p["bytes_moved"] + park_out + park_in,
+                "predicted_bytes_out": p["wire_out"] + park_out,
+                "predicted_bytes_in": p["wire_in"] + park_in,
+                "predicted_kv_pageout_bytes": park_out,
+                "predicted_kv_pagein_bytes": park_in,
                 "predicted_bytes_deduped": p["bytes_deduped"],
                 "predicted_deduped_leaves": p["deduped_leaves"],
                 "predicted_bytes_full": p["bytes_full"],
@@ -2035,7 +2392,13 @@ class EngineService:
             if _offload_wire is not None
             else self._offload_wire_bytes()
         )
-        d2h_s, m_out = book.seconds_for("sleep.d2h", offload_wire)
+        # under zero-drain the offload peeks exclude the KV pool (the
+        # park moves the live pages compactly instead): price the park's
+        # page-out with the outgoing leg it rides
+        park_out = self._park_pageout_bytes()
+        d2h_s, m_out = book.seconds_for(
+            "sleep.d2h", offload_wire + park_out
+        )
         model_cfg = self._model_cfg_cheap(model)
         kv_bytes = self._kv_pool_nbytes(model_cfg)
         read_bytes = 0
@@ -2088,9 +2451,11 @@ class EngineService:
             # what the swap metrics will report as bytes_moved: the
             # offload's wire bytes plus the build's bytes_in (streamed
             # params at full precision once placed, plus the KV pool)
-            "predicted_bytes": offload_wire + params_full + kv_bytes,
-            "predicted_bytes_out": offload_wire,
+            "predicted_bytes": offload_wire + park_out + params_full
+            + kv_bytes,
+            "predicted_bytes_out": offload_wire + park_out,
             "predicted_bytes_in": params_full + kv_bytes,
+            "predicted_kv_pageout_bytes": park_out,
             "predicted_stream_bytes": stream_bytes,
             "predicted_s": round(predicted_s, 6),
             "predicted_d2h_s": round(d2h_s, 6),
@@ -2115,11 +2480,17 @@ class EngineService:
                 "measured": True,
             }
         wire = self._offload_wire_bytes()
-        s, measured = self.costs.bandwidths.seconds_for("sleep.d2h", wire)
+        # zero-drain: the offload excludes the KV pool (peek_state) and
+        # the park pages the live pages out instead — both legs priced
+        park = self._park_pageout_bytes()
+        s, measured = self.costs.bandwidths.seconds_for(
+            "sleep.d2h", wire + park
+        )
         return {
             "kind": "sleep",
             "model": self.args.model,
-            "predicted_bytes": wire,
+            "predicted_bytes": wire + park,
+            "predicted_kv_pageout_bytes": park,
             "predicted_s": round(s, 6),
             "measured": measured,
         }
@@ -2139,13 +2510,18 @@ class EngineService:
             }
         if int(sl.level) == 1:
             wire = sl.stats.bytes_offloaded
+            # a parked bundle's KV pages back in with the wake (bytes
+            # frozen while asleep, so this prediction is exact)
+            pb = getattr(self._runtime, "parked", None)
+            park_in = pb.kv_nbytes if pb is not None else 0
             s, measured = self.costs.bandwidths.seconds_for(
-                "wake.h2d", wire
+                "wake.h2d", wire + park_in
             )
             return {
                 "kind": "wake",
                 "model": self.args.model,
-                "predicted_bytes": wire,
+                "predicted_bytes": wire + park_in,
+                "predicted_kv_pagein_bytes": park_in,
                 "predicted_s": round(s, 6),
                 "measured": measured,
             }
@@ -2252,10 +2628,14 @@ class EngineService:
         actual_bytes: int,
         actual_s: float,
         outcome: str = "committed",
+        extra: Optional[Dict[str, Any]] = None,
     ):
         """Flight-recorder + metrics choke point: every actuation edge
         lands one record (prediction attached when the oracle priced it
-        pre-transfer) and refreshes the per-kind prediction gauges."""
+        pre-transfer) and refreshes the per-kind prediction gauges.
+        ``extra`` carries structured per-actuation context — zero-drain
+        records use it for ``preempted``/``resumed`` counts, so
+        /v1/actuations shows what each swap displaced."""
         rec = self.costs.record(
             kind=kind,
             model=model,
@@ -2264,6 +2644,7 @@ class EngineService:
             outcome=outcome,
             actual_bytes=actual_bytes,
             actual_s=actual_s,
+            extra=extra,
             predicted_bytes=(
                 None if pred is None else pred.get("predicted_bytes")
             ),
@@ -2354,12 +2735,32 @@ class EngineService:
                     ENGINE_ACTUATION_SECONDS.labels(
                         kind="swap", phase=phase
                     ).observe(max(0.0, out.get(key, 0.0)))
+                zd = out.get("zero_drain") or {}
+                if zd.get("restore_shortfall") or zd.get("fallback"):
+                    # the prediction modeled a park/resume that didn't
+                    # happen as priced: a fallback swap aborted instead
+                    # of parking (so the outgoing offload moved the full
+                    # pool the peek excluded), or the page-in fell short
+                    # (dropped clients / a rolled-back restore). Record
+                    # unpriced — the oracle is blameless and a scored
+                    # miss would read as digest drift.
+                    pred = None
                 rec = self._record_actuation(
                     "swap", model, trigger="client",
                     tier=out.get("tier", ""),
                     pred=pred,
                     actual_bytes=out.get("bytes_moved", 0),
                     actual_s=out.get("swap_total_s", 0.0),
+                    # what this swap displaced / brought back: the
+                    # flight recorder's preemption audit trail
+                    extra=(
+                        {
+                            "preempted": zd.get("parked", 0),
+                            "resumed": zd.get("resumed", 0),
+                        }
+                        if zd
+                        else None
+                    ),
                 )
                 out["costs"] = rec.as_dict()
             return out
@@ -2422,30 +2823,61 @@ class EngineService:
                     "engine is sleeping; wake_up before swapping models"
                 )
             t0 = time.monotonic()
-            # In-flight AND still-queued work targets the outgoing model
-            # (queued prompts were validated against its vocab): fail it
-            # now. An otherwise-idle engine keeps its prefix cache — pages
-            # move bit-exact, so a swap-back resumes with a warm cache.
-            exc = RuntimeError(
-                f"aborted by model swap ({previous} -> {model})"
-            )
-            # drain one entry at a time: submit() appends lock-free from
-            # other threads, and an iterate+clear would drop (and never
-            # resolve) an entry appended mid-loop; pop/append on a list
-            # are individually atomic
-            while self._pending:
-                fut = self._pending.pop(0)[3]
-                if not fut.done():
-                    fut.set_exception(exc)
-                    # still-queued requests the swap kills count too — an
-                    # entry here never reached the engine, so abort_all
-                    # below can't see it
-                    self._count_abort("swap")
-            if self.engine.has_work():
-                self._abort_engine_work(
-                    f"model swapped out for {model}", exc, cause="swap"
+            # Zero-drain (docs/perf.md "Zero-drain actuation"): preempt
+            # the outgoing model's live work into a parked bundle instead
+            # of aborting it — unless parking is off/ineligible, the
+            # bundle would blow the pool budget (it would be evicted—and
+            # aborted—immediately), or the page-out itself failed; those
+            # fall back to today's abort path below, byte-for-byte.
+            parked_bundle = None
+            zd_fallback = ""
+            if self._zero_drain_parks():
+                est = (
+                    self._park_pageout_bytes()
+                    + self._offload_wire_bytes()
                 )
+                if est > self.model_pool.budget_bytes:
+                    zd_fallback = (
+                        f"park rejected: ~{est >> 20} MiB parked state "
+                        f"exceeds --model-pool-mib "
+                        f"({self.model_pool.budget_bytes >> 20} MiB)"
+                    )
+                    logger.warning("zero-drain %s; aborting", zd_fallback)
+                else:
+                    parked_bundle = self._park_current(park_pending=True)
+                    if parked_bundle is None:
+                        zd_fallback = "park failed (kv page-out)"
+            if parked_bundle is None:
+                # In-flight AND still-queued work targets the outgoing
+                # model (queued prompts were validated against its
+                # vocab): fail it now. An otherwise-idle engine keeps
+                # its prefix cache — pages move bit-exact, so a
+                # swap-back resumes with a warm cache.
+                exc = RuntimeError(
+                    f"aborted by model swap ({previous} -> {model})"
+                )
+                # drain one entry at a time: submit() appends lock-free
+                # from other threads, and an iterate+clear would drop
+                # (and never resolve) an entry appended mid-loop;
+                # pop/append on a list are individually atomic
+                while self._pending:
+                    fut = self._pending.pop(0)[3]
+                    if not fut.done():
+                        fut.set_exception(exc)
+                        # still-queued requests the swap kills count too
+                        # — an entry here never reached the engine, so
+                        # abort_all below can't see it
+                        self._count_abort("swap")
+                if self.engine.has_work():
+                    self._abort_engine_work(
+                        f"model swapped out for {model}", exc, cause="swap"
+                    )
             outgoing = self._current_runtime()
+            if parked_bundle is not None:
+                # rides with the slept runtime into the pool; every
+                # failure path below either resumes it (rollback to live
+                # serving) or aborts it cleanly (state_loss)
+                outgoing.parked = parked_bundle
             # the pool key carries the checkpoint identity: the same model
             # name from a different checkpoint is a different model. A
             # request WITHOUT a checkpoint_dir means "this model, whatever
@@ -2497,16 +2929,22 @@ class EngineService:
                     # precondition rejections fire before any transfer:
                     # the pooled entry is still intact — put it back under
                     # ITS key (a checkpoint-less request may have matched
-                    # a checkpoint-qualified entry)
+                    # a checkpoint-qualified entry). A zero-drain park
+                    # already ran, though: put its requests back into
+                    # live serving (pool rebuilt, KV paged back in)
                     self._pool_park(entry.model_id, rt, entry.nbytes)
+                    self._unpark_current(outgoing)
                     raise
                 except SwapRolledBack as e:
                     # mid-transfer failure, rolled back by swap_states:
                     # the outgoing model is awake and serving again and
                     # the incoming entry's host state is untouched —
-                    # re-pool it, mark DEGRADED (visible, but /health
-                    # stays 200), and surface a retryable 503
+                    # re-pool it, resume any parked requests (the
+                    # rollback's set_state rebuilt the pool), mark
+                    # DEGRADED (visible, but /health stays 200), and
+                    # surface a retryable 503
                     self._pool_park(entry.model_id, rt, entry.nbytes)
+                    self._unpark_current(outgoing)
                     self.degraded = (
                         f"hot-swap {previous}->{model} rolled back: {e}"
                     )
@@ -2525,7 +2963,9 @@ class EngineService:
                     # partially moved and unrecoverable in-process — fail
                     # the service loudly so /health flips and the
                     # controller heals us, instead of serving from
-                    # half-deleted arrays
+                    # half-deleted arrays. Parked futures are not in
+                    # _futures, so _fail_all can't see them: abort the
+                    # bundle explicitly (state_loss).
                     ENGINE_RECOVERIES.labels(
                         path="swap", outcome="rollback_failed"
                     ).inc()
@@ -2533,6 +2973,11 @@ class EngineService:
                         f"hot-swap {previous}->{model} failed "
                         f"mid-transfer: {type(e).__name__}: {e}"
                     )
+                    if outgoing.parked is not None:
+                        b, outgoing.parked = outgoing.parked, None
+                        self._abort_parked_bundle(
+                            b, previous, self.failure
+                        )
                     self._fail_all(RuntimeError(self.failure))
                     raise
             else:
@@ -2596,6 +3041,17 @@ class EngineService:
                     # another, stacking orphan compile threads)
                     if warm is not None:
                         warm.abort()
+                    if outgoing.parked is not None:
+                        # a partial offload has no rollback (plain sleep
+                        # is not transactional): the parked requests
+                        # cannot reliably resume — abort them cleanly
+                        b, outgoing.parked = outgoing.parked, None
+                        self._abort_parked_bundle(
+                            b, previous,
+                            f"preempted requests lost: outgoing offload "
+                            f"failed mid-swap ({type(off_exc).__name__}: "
+                            f"{off_exc})",
+                        )
                     # real actuation happened (a partial offload): the
                     # flight recorder must see it even for ValueError-
                     # class failures (see swap()'s handler)
@@ -2656,6 +3112,11 @@ class EngineService:
                             f"and the rollback wake failed "
                             f"({type(wake_exc).__name__}: {wake_exc})"
                         )
+                        if outgoing.parked is not None:
+                            b, outgoing.parked = outgoing.parked, None
+                            self._abort_parked_bundle(
+                                b, previous, self.failure
+                            )
                         self._fail_all(RuntimeError(self.failure))
                         raise RuntimeError(self.failure) from build_exc
                     if prefetched:
@@ -2690,6 +3151,10 @@ class EngineService:
                     ENGINE_RECOVERIES.labels(
                         path="swap_cold", outcome="rolled_back"
                     ).inc()
+                    # the rollback wake rebuilt the outgoing engine's
+                    # state (fresh pool under zero-drain): put its
+                    # preempted requests back into live serving
+                    self._unpark_current(outgoing)
                     self.degraded = (
                         f"hot-swap {previous}->{model} build failed; "
                         f"rolled back to {previous}: "
@@ -2745,10 +3210,33 @@ class EngineService:
             evicted = self._pool_park(
                 _pool_key(previous, outgoing.checkpoint_dir),
                 outgoing,
-                nbytes=outgoing.sleeper.stats.bytes_offloaded,
+                # the parked-request bundle is host state the pool must
+                # byte-count like the slept weights it rides with
+                nbytes=outgoing.sleeper.stats.bytes_offloaded
+                + (parked_bundle.nbytes if parked_bundle else 0),
             )
             self._free_pooled(evicted, "evicted over pool budget")
             self._install_runtime(rt)
+            # swap-back to a previously-parked runtime: page its KV back
+            # in and resume the preempted streams mid-decode (a restore
+            # failure aborts them cleanly inside _resume_parked and the
+            # swap still commits — the engine serves either way)
+            zd_resumed, zd_pagein, _zd_resume_s, zd_dropped, zd_short = (
+                self._resume_parked(rt)
+            )
+            if self._zero_drain:
+                metrics["kv_pageout_bytes"] = (
+                    parked_bundle.kv_nbytes if parked_bundle else 0
+                )
+                metrics["kv_pagein_bytes"] = zd_pagein
+                # parked KV is actuation payload: it counts into the
+                # byte totals the oracle predicts and the record scores
+                extra_kv = metrics["kv_pageout_bytes"] + zd_pagein
+                if extra_kv:
+                    metrics["bytes_out"] += metrics["kv_pageout_bytes"]
+                    metrics["bytes_in"] += zd_pagein
+                    metrics["bytes_moved"] += extra_kv
+                    metrics["bytes_full"] += extra_kv
             if model != previous:
                 # same-name variant swaps (sibling checkpoints) keep the
                 # label series AND the arrival EWMA: the name — which is
@@ -2805,6 +3293,50 @@ class EngineService:
                 # which tier served the incoming weights (docs/perf.md
                 # "Tiered weight cache and delta swap")
                 "tier": swap_tier,
+                # zero-drain accounting (absent with the flag off, so
+                # off-mode responses are unchanged byte-for-byte):
+                # what this swap displaced and what it brought back
+                **(
+                    {
+                        "zero_drain": {
+                            "parked": (
+                                parked_bundle.preempted
+                                if parked_bundle
+                                else 0
+                            ),
+                            "resumed": zd_resumed,
+                            "kv_pageout_bytes": metrics.get(
+                                "kv_pageout_bytes", 0
+                            ),
+                            "kv_pagein_bytes": metrics.get(
+                                "kv_pagein_bytes", 0
+                            ),
+                            # parked requests whose clients vanished:
+                            # their pages never paged back in, so the
+                            # record is scored unpriced (swap())
+                            **(
+                                {"dropped": zd_dropped}
+                                if zd_dropped
+                                else {}
+                            ),
+                            # page-in moved fewer bytes than the bundle
+                            # predicted (dropped clients or a failed
+                            # restore): unpriced record (swap())
+                            **(
+                                {"restore_shortfall": True}
+                                if zd_short
+                                else {}
+                            ),
+                            **(
+                                {"fallback": zd_fallback}
+                                if zd_fallback
+                                else {}
+                            ),
+                        }
+                    }
+                    if self._zero_drain
+                    else {}
+                ),
                 **{
                     k: (round(v, 6) if isinstance(v, float) else v)
                     for k, v in metrics.items()
@@ -3466,6 +3998,18 @@ class EngineService:
                 "actuations": dict(self._actuations),
                 "uptime_s": round(now - self.started_at, 3),
                 "is_sleeping": self.sleeper.is_sleeping,
+                # zero-drain preemption accounting (docs/perf.md
+                # "Zero-drain actuation"): lifetime preempt/resume/abort
+                # counts plus the host bytes parked KV holds right now —
+                # what the fleet harness reads to prove "zero swap
+                # aborts" and what the launcher rollup aggregates
+                "zero_drain": {
+                    "enabled": self._zero_drain,
+                    "preempted": self._zd_preempted,
+                    "resumed": self._zd_resumed,
+                    "aborted": self._zd_aborted,
+                    "parked_kv_bytes": max(0, self._zd_parked_bytes),
+                },
             }
         # cost-oracle summary (utils/costs.py): per-kind bandwidth EWMAs
         # + last-N prediction accuracy — the fleet harness scores oracle
@@ -3562,6 +4106,30 @@ class EngineService:
         with self._admin_lock():
             was_sleeping = self.sleeper.is_sleeping
             prev_level = self.sleeper.level
+            parked_for_sleep = None
+            #: park attempted but fell back (page-out failure): the
+            #: offload then moves the full pool the prediction's peek
+            #: excluded — the record must go unpriced, not score a
+            #: false byte miss
+            zd_sleep_fallback = False
+            if (
+                level == 1
+                and not was_sleeping
+                and self._zero_drain_parks()
+                and self.engine.lockstep is None
+            ):
+                # zero-drain: page the live requests' KV out compactly
+                # BEFORE the offload — the slept state is then
+                # weights-only (the full, mostly-empty pool stops
+                # occupying host bytes) and wake re-seats the bundle.
+                # A park failure just keeps today's full-pool offload,
+                # which already preserves in-flight requests across a
+                # plain L1 sleep.
+                parked_for_sleep = self._park_current(park_pending=False)
+                if parked_for_sleep is not None:
+                    self._runtime.parked = parked_for_sleep
+                else:
+                    zd_sleep_fallback = True
             if self.engine.lockstep is not None:
                 if level >= 2:
                     raise ValueError(
@@ -3595,7 +4163,53 @@ class EngineService:
                 self.exec_pool.drop_live()
                 self.engine.clear_executables()
                 self._last_warmup = None
-            out = self.sleeper.sleep(level, release=self.release_on_sleep)
+            try:
+                out = self.sleeper.sleep(
+                    level, release=self.release_on_sleep
+                )
+            except Exception as sleep_exc:
+                if (
+                    parked_for_sleep is not None
+                    and self._runtime.parked is parked_for_sleep
+                ):
+                    # a failed offload has no rollback (plain sleep is
+                    # not transactional) and the engine's state is
+                    # indeterminate: resolve the parked futures to a
+                    # clean state_loss abort instead of stranding them
+                    # forever, and give the engine its pool back in
+                    # case it can still serve
+                    self._runtime.parked = None
+                    try:
+                        if self.engine.kv_detached:
+                            self.engine.rebuild_kv_pool()
+                    except Exception:  # noqa: BLE001 — best effort
+                        logger.warning(
+                            "KV pool rebuild after a failed sleep "
+                            "failed", exc_info=True,
+                        )
+                    self._abort_parked_bundle(
+                        parked_for_sleep,
+                        self.args.model,
+                        f"preempted requests lost: level-1 offload "
+                        f"failed ({type(sleep_exc).__name__}: "
+                        f"{sleep_exc})",
+                    )
+                raise
+            if (
+                int(self.sleeper.level) == 2
+                and getattr(self._runtime, "parked", None) is not None
+            ):
+                # a level-2 edge (direct or L1->L2 escalation) drops the
+                # host state a parked bundle would resume against: abort
+                # the preempted requests cleanly (state_loss), exactly
+                # like the state they rode with
+                b, self._runtime.parked = self._runtime.parked, None
+                self._abort_parked_bundle(
+                    b,
+                    self.args.model,
+                    "preempted requests lost: level-2 sleep discarded "
+                    "the parked state",
+                )
         if out.get("bytes_offloaded") and not was_sleeping:
             # per-mode wire bytes: payload bytes under --sleep-quant.
             # Guarded like the actuation count below — a re-sent sleep's
@@ -3629,6 +4243,7 @@ class EngineService:
                 not was_sleeping
                 and not self.is_gang
                 and int(self.sleeper.level) == 1
+                and not zd_sleep_fallback
             )
             self._record_actuation(
                 "sleep",
@@ -3642,14 +4257,31 @@ class EngineService:
                 # per-shard, and L2 sleeps discard instead of offload:
                 # all outside the pricing model, recorded unpriced
                 pred=pred if sleep_priced else None,
-                actual_bytes=out.get("bytes_offloaded", 0),
+                # a zero-drain park's KV page-out is part of what this
+                # sleep moved: the prediction (price_sleep) counts it,
+                # so the actual must too or byte_exact_frac lies
+                actual_bytes=out.get("bytes_offloaded", 0)
+                + (
+                    parked_for_sleep.kv_nbytes if parked_for_sleep else 0
+                ),
                 # priced records score like-for-like against the pure
                 # offload window price_sleep models (the quiesce and a
-                # device release are outside it)
+                # device release are outside it); the park's d2h window
+                # joins it — same link, same prediction
                 actual_s=(
                     self.sleeper.stats.last_sleep_transfer_s
+                    + (
+                        parked_for_sleep.pageout_s
+                        if parked_for_sleep
+                        else 0.0
+                    )
                     if sleep_priced
                     else (0.0 if was_sleeping else sleep_s)
+                ),
+                extra=(
+                    {"preempted": parked_for_sleep.preempted}
+                    if parked_for_sleep
+                    else None
                 ),
             )
         self._publish_usage()
@@ -3766,6 +4398,22 @@ class EngineService:
                 ENGINE_ACTUATION_BYTES.labels(
                     mode=self.sleeper.stats.last_quant or "off", dir="h2d"
                 ).inc(self.sleeper.stats.last_wake_bytes)
+            # zero-drain: the parked bundle's KV pages back into the
+            # fresh pool and the preempted streams continue mid-decode
+            # (a restore failure aborts them cleanly inside
+            # _resume_parked; the engine serves either way)
+            zd_resumed = zd_pagein = zd_dropped = 0
+            zd_resume_s = 0.0
+            zd_short = False
+            if (
+                was_sleeping
+                and not self.sleeper.is_sleeping
+                and getattr(self._runtime, "parked", None) is not None
+            ):
+                (
+                    zd_resumed, zd_pagein, zd_resume_s, zd_dropped,
+                    zd_short,
+                ) = self._resume_parked(self._runtime)
         if was_sleeping:
             # a wake on an already-awake engine is a no-op, not an
             # actuation the fleet rollup should charge for
@@ -3786,7 +4434,12 @@ class EngineService:
             ENGINE_ACTUATION_SECONDS.labels(
                 kind="wake", phase="total"
             ).observe(max(0.0, wake_s))
-            priced = not self.is_gang and was_l1
+            # a page-in shortfall (dropped parked clients, or a restore
+            # rolled back to the state_loss abort) makes the actual
+            # bytes fall short of the (full-bundle) prediction: record
+            # unpriced, like the other false-byte-miss classes (gang
+            # wakes, L2 edges)
+            priced = not self.is_gang and was_l1 and not zd_short
             self._record_actuation(
                 "wake",
                 self.args.model,
@@ -3798,14 +4451,24 @@ class EngineService:
                 # unpriced — a mismatched prediction would read as a
                 # false byte-exactness miss
                 pred=pred if priced else None,
-                actual_bytes=self.sleeper.stats.last_wake_bytes
-                if was_l1 or self.is_gang
-                else 0,
+                # parked-KV page-in is payload this wake moved: counted
+                # like the park's page-out on the sleep record, so
+                # predicted (price_wake) and actual stay byte-exact
+                actual_bytes=(
+                    self.sleeper.stats.last_wake_bytes + zd_pagein
+                    if was_l1 or self.is_gang
+                    else 0
+                ),
                 # a priced record scores the prediction like-for-like:
                 # the transfer window (what price_wake models — client
                 # reacquisition is deliberately outside it); unpriced
                 # records keep the whole-verb wall
-                actual_s=wake_transfer_s if priced else wake_s,
+                actual_s=(
+                    wake_transfer_s + zd_resume_s if priced else wake_s
+                ),
+                extra=(
+                    {"resumed": zd_resumed} if zd_resumed else None
+                ),
             )
         self._publish_usage()
         self._new_work.set()

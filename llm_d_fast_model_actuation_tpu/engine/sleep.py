@@ -1737,7 +1737,10 @@ def attach_sleep(
 ) -> SleepManager:
     """Wire a SleepManager to an InferenceEngine: the offloadable state is
     (params, kv page pool). Page tables / host bookkeeping stay put, so the
-    wake fast path resumes in-flight sequences.
+    wake fast path resumes in-flight sequences. Under zero-drain
+    (``engine.kv_detached`` after a park) the state is weights-only — the
+    live KV left compactly via engine/parked.py and the restore rebuilds a
+    fresh pool for the bundle to scatter back into.
 
     ``quant_mode`` opts the level-1 offload path into compressed transfers
     (int8/fp8 payloads + on-device dequant; docs/perf.md "Compressed
@@ -1750,10 +1753,20 @@ def attach_sleep(
         # a dispatched-but-unread decode chunk would be lost with the
         # device state: complete it (emitting its tokens) before offload
         engine.drain_inflight()
+        if engine.kv_detached:
+            # zero-drain park (engine/parked.py) already paged the live
+            # KV out compactly and dropped the pool arrays: the slept
+            # state is weights-only, and set_state rebuilds a fresh pool
+            return {"params": engine.params}
         return {"params": engine.params, "kv": engine.pool.as_tuple()}
 
     def peek_state():
-        # pricing reads shapes only: same tree, no quiesce
+        # pricing reads shapes only: same tree, no quiesce. Under
+        # zero-drain the L1 offload this prices will run AFTER a park,
+        # so the peeked tree must exclude the pool too (the parked-KV
+        # bytes are priced separately from parked_page_ids).
+        if engine.kv_detached or engine.zero_drain_park:
+            return {"params": engine.params}
         return {"params": engine.params, "kv": engine.pool.as_tuple()}
 
     def set_state(state):
@@ -1767,7 +1780,12 @@ def attach_sleep(
             engine.drop_device_sched_state()
         else:
             engine.params = state["params"]
-            engine.pool.replace(state["kv"])
+            if "kv" in state:
+                engine.pool.replace(state["kv"])
+            else:
+                # weights-only state (zero-drain park): fresh pool +
+                # allocator; the service re-seats the parked bundle next
+                engine.rebuild_kv_pool()
 
     return SleepManager(
         get_state,

