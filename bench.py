@@ -1478,6 +1478,11 @@ def _measure_fleet() -> None:
 
     seed = int(_argv_value("--seed", "0"))
     zero_drain = "--zero-drain" in sys.argv
+    # --coresident: serve the hot set as device-resident sibling variants
+    # (POST /v1/residents + per-request "model" routing) instead of
+    # swapping toward it — the zero-actuation path for sibling-heavy
+    # traffic (docs/perf.md "Co-resident sibling variants")
+    coresident = "--coresident" in sys.argv
     n_models = max(2, int(os.environ.get("FMA_FLEETBENCH_MODELS", "3")))
     duration = float(os.environ.get("FMA_FLEETBENCH_DURATION", "12"))
     base_rate = float(os.environ.get("FMA_FLEETBENCH_RATE", "6"))
@@ -1488,6 +1493,17 @@ def _measure_fleet() -> None:
     slo_tpot_ms = float(
         os.environ.get("FMA_FLEETBENCH_SLO_TPOT_MS", "1000")
     )
+    # sibling-heavy trace: all arrivals land uniformly in the hot set
+    # (benchmark/fleet.py hot_set_size). Defaults to the whole variant
+    # set in --coresident mode and to the classic Zipf/burst process
+    # otherwise; FMA_FLEETBENCH_HOTSET pins it for baseline runs that
+    # must serve the IDENTICAL trace via the swap path.
+    hot_set = int(
+        os.environ.get(
+            "FMA_FLEETBENCH_HOTSET", str(n_models if coresident else 1)
+        )
+    )
+    hot_set = max(1, min(hot_set, n_models))
     min_residency_s = 0.5  # router: no thrash — one swap per window
     max_hold_s = 3.0  # ...unless a queued model starved this long
 
@@ -1540,6 +1556,12 @@ def _measure_fleet() -> None:
             f"--slo-ttft-ms {slo_ttft_ms} --slo-tpot-ms {slo_tpot_ms} "
             f"--arrival-ewma-tau-s 10"
             + (" --zero-drain on" if zero_drain else "")
+            + (
+                f" --packed-serving on --resident-variants {n_models}"
+                f" --variant-hbm-mib 64"
+                if coresident
+                else ""
+            )
         )
         env_vars = {}
         if jax.devices()[0].platform != "tpu":
@@ -1573,6 +1595,60 @@ def _measure_fleet() -> None:
         for i in list(range(1, n_models)) + [0]:
             swap_to(i)
 
+        # --coresident: attach every hot-set sibling next to the base
+        # (delta-only uploads from the pool the pre-warm populated) and
+        # route per-request from then on — the measured window must then
+        # show ZERO swap actuations for hot-set traffic.
+        route_model = {}  # model index -> completions "model" field
+        attach_rows = []
+        swaps_before = 0
+        if coresident:
+            for i in range(1, hot_set):
+                status, body = _http_json(
+                    "POST", ebase + "/v1/residents",
+                    {"model": "tiny", "checkpoint_dir": ckpts[i]},
+                    timeout=180,
+                )
+                assert status == 200, (status, body)
+                route_model[i] = body["model"]
+                attach_rows.append(
+                    {
+                        "model": body["model"],
+                        "wire_bytes": body.get("wire_bytes"),
+                        "attach_s": body.get("attach_s"),
+                        "source_tier": body.get("source_tier"),
+                    }
+                )
+            # warm the multi-variant packed programs (mixed + decode
+            # chunk at every bucket the window hits) BEFORE the clock
+            # starts — the same reason the pre-warm loop above pays each
+            # solo compile up front: the window measures steady state,
+            # not first-dispatch compilation
+            warm_threads = []
+            for _rep in range(2):
+                for i in range(hot_set):
+                    wreq = {
+                        "prompt": [7] * 12,
+                        "max_tokens": 8,
+                        "ignore_eos": True,
+                    }
+                    if i in route_model:
+                        wreq["model"] = route_model[i]
+                    wt = threading.Thread(
+                        target=_http_json,
+                        args=("POST", ebase + "/v1/completions", wreq),
+                        kwargs={"timeout": 300},
+                        daemon=True,
+                    )
+                    wt.start()
+                    warm_threads.append(wt)
+            for wt in warm_threads:
+                wt.join(timeout=300)
+            _, stats0 = _http_json("GET", ebase + "/v1/stats", timeout=15)
+            swaps_before = int(
+                (stats0.get("actuations") or {}).get("swap", 0)
+            ) if isinstance(stats0, dict) else 0
+
         cfg = fleetmod.FleetTrafficConfig(
             seed=seed,
             num_models=n_models,
@@ -1580,6 +1656,7 @@ def _measure_fleet() -> None:
             base_rate_rps=base_rate,
             burst_rate_rps=burst_rate,
             vocab=vcfg.vocab_size,
+            hot_set_size=hot_set,
         )
         arrivals = fleetmod.generate_arrivals(cfg)
         trace_sha = fleetmod.trace_digest(arrivals)
@@ -1598,14 +1675,17 @@ def _measure_fleet() -> None:
             def run():
                 t_disp = time.monotonic()
                 try:
+                    req = {
+                        "prompt": list(arr.prompt),
+                        "max_tokens": arr.max_tokens,
+                        "ignore_eos": True,
+                    }
+                    # co-resident: route the sibling per request instead
+                    # of queuing it for a swap — the whole point
+                    if arr.model in route_model:
+                        req["model"] = route_model[arr.model]
                     status, body = _http_json(
-                        "POST", ebase + "/v1/completions",
-                        {
-                            "prompt": list(arr.prompt),
-                            "max_tokens": arr.max_tokens,
-                            "ignore_eos": True,
-                        },
-                        timeout=120,
+                        "POST", ebase + "/v1/completions", req, timeout=120,
                     )
                 except Exception as e:  # noqa: BLE001 — refused/reset mid-swap
                     status, body = 0, f"{type(e).__name__}: {e}"
@@ -1693,7 +1773,11 @@ def _measure_fleet() -> None:
             if delay > 0:
                 time.sleep(delay)
             with mu:
-                direct = arr.model == resident[0]
+                # attached siblings are served in place (mixed packed
+                # batch) — never queued, never a router swap
+                direct = (
+                    arr.model == resident[0] or arr.model in route_model
+                )
                 if not direct:
                     queues[arr.model].append((arr, sched))
             if direct:
@@ -1750,8 +1834,12 @@ def _measure_fleet() -> None:
         # with its model pinned resident and compare token ids. Replay
         # swaps hit an idle engine (nothing in flight), so they park
         # nothing and abort nothing.
+        # --coresident reuses the same replay to prove interleaved
+        # mixed-batch decoding is bit-exact vs solo: each request re-runs
+        # on the now-idle engine routed to the same resident (no swaps —
+        # residents pin the base) and must reproduce its token ids.
         zd_checked = zd_mismatches = 0
-        if zero_drain:
+        if zero_drain or coresident:
             with mu:
                 replay = [
                     (
@@ -1765,16 +1853,18 @@ def _measure_fleet() -> None:
                 todo = [r for r in replay if r[0] == i]
                 if not todo:
                     continue
-                swap_to(i)
+                if not coresident:
+                    swap_to(i)
                 for _, prompt, mt, got in todo:
+                    req = {
+                        "prompt": prompt,
+                        "max_tokens": mt,
+                        "ignore_eos": True,
+                    }
+                    if i in route_model:
+                        req["model"] = route_model[i]
                     status, body = _http_json(
-                        "POST", ebase + "/v1/completions",
-                        {
-                            "prompt": prompt,
-                            "max_tokens": mt,
-                            "ignore_eos": True,
-                        },
-                        timeout=120,
+                        "POST", ebase + "/v1/completions", req, timeout=120,
                     )
                     zd_checked += 1
                     ref = (
@@ -1815,6 +1905,23 @@ def _measure_fleet() -> None:
         # --- the observability surfaces this PR exists for --------------
         _, engine_metrics = _http_json("GET", ebase + "/metrics", timeout=15)
         _, engine_stats = _http_json("GET", ebase + "/v1/stats", timeout=15)
+        residents_view = {}
+        swap_actuations_in_window = None
+        if coresident:
+            _, residents_view = _http_json(
+                "GET", ebase + "/v1/residents", timeout=15
+            )
+            if not isinstance(residents_view, dict):
+                residents_view = {}
+            if isinstance(engine_stats, dict):
+                swap_actuations_in_window = (
+                    int(
+                        (engine_stats.get("actuations") or {}).get(
+                            "swap", 0
+                        )
+                    )
+                    - swaps_before
+                )
         _, instances = _http_json(
             "GET", lbase + "/v2/vllm/instances", timeout=30
         )
@@ -1832,6 +1939,7 @@ def _measure_fleet() -> None:
                 "fma_engine_goodput_tokens_total",
                 "fma_engine_request_arrival_rate",
             )
+            + (("fma_engine_resident_variants",) if coresident else ())
         }
 
         _http_json("DELETE", lbase + "/v2/vllm/instances", timeout=60)
@@ -1929,6 +2037,25 @@ def _measure_fleet() -> None:
                 "bit_exact_checked": zd_checked,
                 "bit_exact_mismatches": zd_mismatches,
             },
+            # co-resident scorecard (docs/perf.md "Co-resident sibling
+            # variants"): the CI gate asserts zero swap actuations during
+            # the measured window for hot-set traffic and attainment no
+            # worse than the zero-drain baseline on the same seeded trace
+            "coresident": {
+                "enabled": coresident,
+                "hot_set": hot_set,
+                "attached": attach_rows,
+                "swap_actuations_in_window": swap_actuations_in_window,
+                "router_swaps_in_window": swaps[0],
+                "bit_exact_checked": zd_checked if coresident else 0,
+                "bit_exact_mismatches": (
+                    zd_mismatches if coresident else 0
+                ),
+                "variant_hbm_bytes": residents_view.get(
+                    "variant_hbm_bytes"
+                ),
+                "ledger": residents_view.get("ledger"),
+            },
         },
     }
     if _trace_out_path():
@@ -1980,6 +2107,10 @@ def _run_child(
         # fleet sub-bench: actuate under live load WITHOUT aborting
         # streams (docs/perf.md "Zero-drain actuation")
         argv.append("--zero-drain")
+    if "--coresident" in sys.argv:
+        # fleet sub-bench: attach hot-set siblings device-resident and
+        # route per request (docs/perf.md "Co-resident sibling variants")
+        argv.append("--coresident")
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )

@@ -59,6 +59,14 @@ class FleetTrafficConfig:
     #: during a burst phase this fraction of draws goes to the phase's
     #: rotating hot model, the rest to the Zipf base distribution
     burst_hot_frac: float = 0.6
+    #: sibling-heavy trace mode: when > 1, every draw (burst hot-model
+    #: rotation included) lands uniformly in the hot set — models
+    #: ``[0, hot_set_size)`` — and the remaining models get zero traffic.
+    #: This is the workload co-residency serves with ZERO actuations (all
+    #: hot variants device-resident at once); the default (1) leaves the
+    #: Zipf/burst process untouched, so existing seeded trace digests are
+    #: unchanged.
+    hot_set_size: int = 1
     #: per-request shape (token ids drawn uniformly from [1, vocab))
     prompt_len_min: int = 4
     prompt_len_max: int = 12
@@ -111,6 +119,8 @@ def generate_arrivals(cfg: FleetTrafficConfig) -> List[Arrival]:
         raise ValueError("bad prompt_len range")
     if cfg.max_tokens_min < 1 or cfg.max_tokens_max < cfg.max_tokens_min:
         raise ValueError("bad max_tokens range")
+    if not (1 <= cfg.hot_set_size <= cfg.num_models):
+        raise ValueError("hot_set_size must be in [1, num_models]")
     rng = random.Random(cfg.seed)
     base_w = _zipf_weights(cfg.num_models, cfg.zipf_s)
     out: List[Arrival] = []
@@ -125,7 +135,14 @@ def generate_arrivals(cfg: FleetTrafficConfig) -> List[Arrival]:
         t += rng.expovariate(max(1e-9, rate))
         if t >= cfg.duration_s:
             break
-        if burst and cfg.num_models > 1 and rng.random() < cfg.burst_hot_frac:
+        if cfg.hot_set_size > 1:
+            # sibling-heavy mode: uniform over the hot set only — the
+            # trace a co-resident engine serves without a single swap
+            model = rng.randrange(cfg.hot_set_size)
+        elif (
+            burst and cfg.num_models > 1
+            and rng.random() < cfg.burst_hot_frac
+        ):
             # rotate the hot model per burst phase so every variant takes
             # a turn being the one the fleet must actuate toward
             model = (phase // 2) % cfg.num_models

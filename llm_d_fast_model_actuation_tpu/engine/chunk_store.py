@@ -146,9 +146,12 @@ def aligned_digests(
 
 #: digest prefix marking chunks whose digest is NOT the plain content
 #: hash of their bytes (transfer-quantized payloads, models/quant.py
-#: transfer_digest): such chunks never spill — a written blob could not
-#: pass the reload content re-verification, so the write would only
-#: churn the disk tier
+#: transfer_digest). Their spill blobs carry an explicit ``content``
+#: field in the header — the payload's own :func:`leaf_digest`, written
+#: by the process that held the genuine chunk — which the reload
+#: re-verification checks instead of recomputing the (un-invertible)
+#: transfer digest. Sound because transfer_digest's preimage includes
+#: leaf_digest(payload): equal q: digests imply equal payload bytes.
 QUANT_DIGEST_PREFIX = "q:"
 
 #: digest prefix of MESH-qualified digests: ``m:<qual>:<content>`` where
@@ -162,7 +165,12 @@ MESH_DIGEST_PREFIX = "m:"
 
 
 def digest_spillable(digest: str) -> bool:
-    return not digest.startswith(QUANT_DIGEST_PREFIX)
+    """Every digest scheme spills now. Quant-tier (``q:``) chunks were
+    historically pinned in host RAM because their digest can't be
+    recomputed from the blob bytes; the spill header's ``content`` field
+    restores a content-verified reload for them, so the pin is gone.
+    Kept as a function so external callers gating on it keep working."""
+    return True
 
 
 def qualify_digest(content_digest: str, qualifier: str) -> str:
@@ -258,8 +266,7 @@ class ChunkStore:
         when the chunk is new. Returns ``(canonical_array, added_bytes)``:
         on a dedup hit the canonical array is the EXISTING chunk's (the
         caller drops its duplicate — that is the host-DRAM saving) and
-        added_bytes is 0. Chunks under a :data:`QUANT_DIGEST_PREFIX`
-        digest never reach the disk tier (see :func:`digest_spillable`)."""
+        added_bytes is 0."""
         with self._mu:
             c = self._chunks.get(digest)
             if c is not None:
@@ -295,7 +302,7 @@ class ChunkStore:
         returns ``(digest, data)`` for the caller to :meth:`spill` after
         dropping its own locks — the eviction loop runs under the pool
         mutex and must not do disk I/O there. None while still
-        referenced (or for never-spillable quant-digest chunks)."""
+        referenced."""
         freed = self._drop_ref(digest)
         if freed is None or not digest_spillable(digest):
             return None
@@ -408,6 +415,10 @@ class ChunkStore:
                 "dtype": str(data.dtype),
                 "shape": list(data.shape),
                 "nbytes": int(data.nbytes),
+                # payload's own content hash, written while we hold the
+                # genuine chunk: the reload verify target for q: digests
+                # (whose digest is not recomputable from the blob bytes)
+                "content": leaf_digest(data),
             }
         ).encode()
         path = self._path(digest)
@@ -463,16 +474,27 @@ class ChunkStore:
             self._on_event("miss")
             return None
         try:
-            # CONTENT verify on every reload: the digest names the bytes,
-            # so recompute it over what the file actually holds — a stale
-            # blob, bitrot, or an (astronomically unlikely) collision
-            # must be a miss, never silently-wrong weights. Mesh-
-            # qualified digests verify their content suffix (the blob
-            # holds the full global array; the qualifier is part of the
-            # lookup key, already matched by reaching this path).
+            # CONTENT verify on every reload: a stale blob, bitrot, or an
+            # (astronomically unlikely) collision must be a miss, never
+            # silently-wrong weights. Plain and mesh-qualified digests
+            # recompute the content hash the digest itself names (the
+            # qualifier is part of the lookup key, already matched by
+            # reaching this path). Transfer-quantized q: digests are not
+            # recomputable from the blob bytes; they verify against the
+            # header's ``content`` field, written at spill time by the
+            # process holding the genuine chunk (sound because
+            # transfer_digest's preimage includes leaf_digest(payload)).
             dtype = np.dtype(header["dtype"])
             arr = np.frombuffer(raw, dtype=dtype).reshape(header["shape"])
-            if (
+            if digest.startswith(QUANT_DIGEST_PREFIX):
+                want = header.get("content")
+                if (
+                    header.get("digest") != digest
+                    or not want
+                    or leaf_digest(arr) != want
+                ):
+                    raise ValueError("content digest mismatch")
+            elif (
                 header.get("digest") != digest
                 or leaf_digest(arr) != digest_content_hash(digest)
             ):

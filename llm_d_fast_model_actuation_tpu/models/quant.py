@@ -310,10 +310,11 @@ def transfer_digest(payload: Any, meta: TransferQuant) -> str:
     dtype it dequantizes to): what the tiered pool dedupes quantized
     entries on. A distinct digest space from the full-precision leaf
     digests — a quantized payload must never content-match (and be handed
-    out as) the full-precision tensor it came from — and the "q:" prefix
-    keeps these chunks out of the disk spill tier
-    (chunk_store.digest_spillable: a spilled blob could never pass the
-    reload's content re-verification)."""
+    out as) the full-precision tensor it came from. Because the preimage
+    includes leaf_digest(payload), equal "q:" digests imply bit-equal
+    payloads, which is what lets the disk spill tier content-verify a
+    reloaded quant chunk against the ``content`` field its spill header
+    recorded (chunk_store._load_spilled)."""
     from ..engine.chunk_store import QUANT_DIGEST_PREFIX, leaf_digest
 
     h = hashlib.sha256()
