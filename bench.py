@@ -1483,7 +1483,18 @@ def _measure_fleet() -> None:
     # swapping toward it — the zero-actuation path for sibling-heavy
     # traffic (docs/perf.md "Co-resident sibling variants")
     coresident = "--coresident" in sys.argv
-    n_models = max(2, int(os.environ.get("FMA_FLEETBENCH_MODELS", "3")))
+    # --migrate: two sibling instances of the SAME model; drain instance
+    # A into instance B mid-first-burst via the launcher verb and prove
+    # zero migration-caused aborts + bit-exact replay of the migrated
+    # streams (docs/operations.md "Draining a node without dropping
+    # streams")
+    migrate = "--migrate" in sys.argv
+    if migrate:
+        zero_drain = True  # parking is the migration substrate
+    n_models = (
+        1 if migrate
+        else max(2, int(os.environ.get("FMA_FLEETBENCH_MODELS", "3")))
+    )
     duration = float(os.environ.get("FMA_FLEETBENCH_DURATION", "12"))
     base_rate = float(os.environ.get("FMA_FLEETBENCH_RATE", "6"))
     burst_rate = float(os.environ.get("FMA_FLEETBENCH_BURST", "18"))
@@ -1573,6 +1584,25 @@ def _measure_fleet() -> None:
         assert status == 201, (status, body)
         _wait_http_ok(ebase + "/health", 300)
 
+        # --migrate: a second sibling serving the IDENTICAL checkpoint
+        # (the engines' weight-fingerprint identity gate must pass) with
+        # slot/page headroom so an import mid-burst always has capacity
+        ebase2 = ""
+        if migrate:
+            eport2 = _free_port()
+            ebase2 = f"http://127.0.0.1:{eport2}"
+            options2 = (
+                options.replace(f"--port {eport}", f"--port {eport2}")
+                .replace("--max-batch 4", "--max-batch 12")
+                .replace("--num-pages 64", "--num-pages 128")
+            )
+            status, body = _http_json(
+                "PUT", lbase + "/v2/vllm/instances/fleet-1",
+                {"options": options2, "env_vars": env_vars}, timeout=60,
+            )
+            assert status == 201, (status, body)
+            _wait_http_ok(ebase2 + "/health", 300)
+
         def swap_to(i: int) -> dict:
             for attempt in (1, 2):
                 status, body = _http_json(
@@ -1591,9 +1621,26 @@ def _measure_fleet() -> None:
         # Pre-warm: one cold build per variant (pools them all, compiles
         # once into the shared executable pool), ending resident on 0 —
         # the measured window then exercises warm delta swaps, which is
-        # the steady state of a long-running fleet.
-        for i in list(range(1, n_models)) + [0]:
-            swap_to(i)
+        # the steady state of a long-running fleet. --migrate has one
+        # variant on two siblings: warm both engines' compile caches with
+        # direct requests instead (migrated-in streams must not pay a
+        # first-dispatch compile mid-handoff).
+        if migrate:
+            for b in (ebase, ebase2):
+                for _rep in range(2):
+                    status, body = _http_json(
+                        "POST", b + "/v1/completions",
+                        {
+                            "prompt": [7] * 12,
+                            "max_tokens": 8,
+                            "ignore_eos": True,
+                        },
+                        timeout=300,
+                    )
+                    assert status == 200, (status, body)
+        else:
+            for i in list(range(1, n_models)) + [0]:
+                swap_to(i)
 
         # --coresident: attach every hot-set sibling next to the base
         # (delta-only uploads from the pool the pre-warm populated) and
@@ -1670,6 +1717,78 @@ def _measure_fleet() -> None:
         swaps = [0]
         last_swap = [time.monotonic()]
         threads = []
+        # --migrate routing: requests go to target[0]; the drain thread
+        # flips it to the sibling before draining (the operator sequence
+        # the runbook prescribes: stop routing, THEN drain)
+        target = [ebase]
+        drain_at = fleetmod.drain_time_s(cfg) if migrate else None
+        drain_result: dict = {}
+
+        def fire_ballast(j: int) -> None:
+            """One long greedy generation straight at the SOURCE — the
+            multi-second stream a real drain contends with (the trace's
+            short requests finish in milliseconds on CPU, so without
+            ballast the drain would trivially find an empty engine).
+            Recorded like any trace request: the post-run replay then
+            proves the migrated stream was bit-exact."""
+
+            def run():
+                prompt = [3 + j] * 8
+                max_tokens = 80
+                try:
+                    status, body = _http_json(
+                        "POST", ebase + "/v1/completions",
+                        {
+                            "prompt": prompt,
+                            "max_tokens": max_tokens,
+                            "ignore_eos": True,
+                        },
+                        timeout=300,
+                    )
+                except Exception as e:  # noqa: BLE001
+                    status, body = 0, f"{type(e).__name__}: {e}"
+                rec = {"model": 0, "hold_s": 0.0}
+                if status == 200 and isinstance(body, dict):
+                    u = body.get("usage") or {}
+                    rec.update(
+                        ok=True,
+                        tokens=u.get("completion_tokens", 0),
+                        ttft_s=u.get("time_to_first_token_s") or 0.0,
+                        queue_wait_s=u.get("queue_wait_s") or 0.0,
+                        tpot_s=u.get("decode_tpot_s"),
+                        prompt=prompt,
+                        max_tokens=max_tokens,
+                        token_ids=(body.get("choices") or [{}])[0].get(
+                            "token_ids"
+                        ),
+                    )
+                else:
+                    rec.update(ok=False, tokens=0, status=status)
+                with mu:
+                    results.append(rec)
+
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            threads.append(t)
+
+        def do_drain(t_start: float) -> None:
+            time.sleep(max(0.0, t_start + drain_at - time.monotonic()))
+            # live work the drain must move: more streams than the
+            # source has slots, so the migrate pass carries running AND
+            # waiting requests across
+            for j in range(6):
+                fire_ballast(j)
+            time.sleep(0.1)  # let the submissions land on the engine
+            target[0] = ebase2
+            status, body = _http_json(
+                "POST", lbase + "/v2/vllm/instances/fleet-0/drain",
+                {}, timeout=300,
+            )
+            drain_result["status"] = status
+            if isinstance(body, dict):
+                drain_result.update(body)
+            else:
+                drain_result["error"] = str(body)[:500]
 
         def fire(arr, t_arr: float) -> None:
             def run():
@@ -1685,7 +1804,8 @@ def _measure_fleet() -> None:
                     if arr.model in route_model:
                         req["model"] = route_model[arr.model]
                     status, body = _http_json(
-                        "POST", ebase + "/v1/completions", req, timeout=120,
+                        "POST", target[0] + "/v1/completions", req,
+                        timeout=120,
                     )
                 except Exception as e:  # noqa: BLE001 — refused/reset mid-swap
                     status, body = 0, f"{type(e).__name__}: {e}"
@@ -1764,6 +1884,12 @@ def _measure_fleet() -> None:
                 fire(arr, t_arr)
 
         t0 = time.monotonic()
+        drain_thread = None
+        if migrate:
+            drain_thread = threading.Thread(
+                target=do_drain, args=(t0,), daemon=True
+            )
+            drain_thread.start()
         for arr in arrivals:
             # t_arr is the SCHEDULED arrival: if a synchronous swap (or
             # anything else) stalls this loop, the lag lands in hold_s —
@@ -1826,6 +1952,8 @@ def _measure_fleet() -> None:
             )
         for t in threads:
             t.join(timeout=180)
+        if drain_thread is not None:
+            drain_thread.join(timeout=300)
         wall_s = time.monotonic() - t0
 
         # --- zero-drain bit-exactness: every served (possibly
@@ -1853,7 +1981,9 @@ def _measure_fleet() -> None:
                 todo = [r for r in replay if r[0] == i]
                 if not todo:
                     continue
-                if not coresident:
+                if not coresident and not migrate:
+                    # --migrate has one variant already resident on the
+                    # (drained, now idle) source — replay needs no swap
                     swap_to(i)
                 for _, prompt, mt, got in todo:
                     req = {
@@ -1905,6 +2035,13 @@ def _measure_fleet() -> None:
         # --- the observability surfaces this PR exists for --------------
         _, engine_metrics = _http_json("GET", ebase + "/metrics", timeout=15)
         _, engine_stats = _http_json("GET", ebase + "/v1/stats", timeout=15)
+        engine_stats2 = {}
+        if migrate:
+            _, engine_stats2 = _http_json(
+                "GET", ebase2 + "/v1/stats", timeout=15
+            )
+            if not isinstance(engine_stats2, dict):
+                engine_stats2 = {}
         residents_view = {}
         swap_actuations_in_window = None
         if coresident:
@@ -1940,6 +2077,14 @@ def _measure_fleet() -> None:
                 "fma_engine_request_arrival_rate",
             )
             + (("fma_engine_resident_variants",) if coresident else ())
+            + (
+                (
+                    "fma_engine_migrations_total",
+                    "fma_engine_migrate_bytes_total",
+                )
+                if migrate
+                else ()
+            )
         }
 
         _http_json("DELETE", lbase + "/v2/vllm/instances", timeout=60)
@@ -2056,6 +2201,34 @@ def _measure_fleet() -> None:
                 ),
                 "ledger": residents_view.get("ledger"),
             },
+            # migration scorecard (docs/operations.md "Draining a node
+            # without dropping streams"): the CI gate asserts the drain
+            # succeeded, migrated at least one live stream, caused ZERO
+            # aborts and ZERO state_loss, and that every migrated stream
+            # replays bit-exact against an uninterrupted run
+            "migration": {
+                "enabled": migrate,
+                "drain_at_s": drain_at,
+                "drain": drain_result if migrate else {},
+                "source_zero_drain": (
+                    engine_stats.get("zero_drain")
+                    if migrate and isinstance(engine_stats, dict)
+                    else None
+                ),
+                "source_migration": (
+                    engine_stats.get("migration")
+                    if migrate and isinstance(engine_stats, dict)
+                    else None
+                ),
+                "dest_migration": (
+                    engine_stats2.get("migration") if migrate else None
+                ),
+                "fleet_migration": (
+                    fleet_block.get("migration") if migrate else None
+                ),
+                "bit_exact_checked": zd_checked if migrate else 0,
+                "bit_exact_mismatches": zd_mismatches if migrate else 0,
+            },
         },
     }
     if _trace_out_path():
@@ -2111,6 +2284,11 @@ def _run_child(
         # fleet sub-bench: attach hot-set siblings device-resident and
         # route per request (docs/perf.md "Co-resident sibling variants")
         argv.append("--coresident")
+    if "--migrate" in sys.argv:
+        # fleet sub-bench: drain one sibling into the other mid-burst
+        # without dropping a stream (docs/operations.md "Draining a node
+        # without dropping streams")
+        argv.append("--migrate")
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )

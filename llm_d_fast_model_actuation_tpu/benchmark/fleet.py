@@ -173,6 +173,18 @@ def trace_digest(arrivals: Sequence[Arrival]) -> str:
     return h.hexdigest()
 
 
+def drain_time_s(cfg: FleetTrafficConfig) -> float:
+    """Trace offset (seconds) where ``bench.py fleet --migrate`` drains
+    its source instance: the middle of the FIRST burst phase (phases
+    alternate base, burst, ... so the first burst spans
+    ``[phase_s, 2*phase_s)``). Draining mid-burst is the adversarial
+    moment — the source is at its deepest queue — and deriving it from
+    the config (not a flag) keeps the leg reproducible per seed. Pure
+    arithmetic on the config: the seeded arrival trace and its digest
+    are untouched."""
+    return 1.5 * cfg.phase_s
+
+
 def percentile(values: Sequence[float], q: float) -> float:
     """Nearest-rank percentile (q in [0, 100]) — no numpy dependency, and
     nearest-rank keeps p50 <= p95 <= p99 trivially monotonic."""

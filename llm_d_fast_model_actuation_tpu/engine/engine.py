@@ -285,6 +285,12 @@ class Request:
     #: Routed requests require packed serving — the bucketed programs
     #: always run base params.
     variant: int = 0
+    #: explicit [2] uint32 RNG key data seated instead of the derived
+    #: key at admission. Set only on migrated-in requests: a seed-None
+    #: request's key is derived from (engine seed, seq_id), both of
+    #: which differ on the importing engine, so the exporter pins the
+    #: exact key its own admission would have used.
+    rng_key_data: Optional[Any] = None
 
 
 def validate_logit_bias(lb, vocab_size: int) -> "Dict[int, float] | None":
@@ -1564,7 +1570,23 @@ class InferenceEngine:
         self._waiting.append(req)
         return req.seq_id
 
+    def new_seq_id(self) -> int:
+        """Mint a fresh local sequence id. Besides add_request, the
+        migration import path uses this to re-key foreign Request
+        objects before seating them — two engines' id spaces are
+        unrelated and a collision would cross-wire futures."""
+        sid = self._next_seq_id
+        self._next_seq_id += 1
+        return sid
+
     def _init_slot_key(self, req: Request) -> None:
+        if req.rng_key_data is not None:
+            # migrated-in seed-None request: the exporter pinned the
+            # exact key its own admission would have derived
+            self._slot_keys[req.slot] = np.asarray(
+                req.rng_key_data, dtype=np.uint32
+            )
+            return
         if req.seed is not None:
             k = jax.random.key(int(req.seed))
         else:

@@ -29,6 +29,8 @@ table.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -39,6 +41,11 @@ from ..utils import faults, tracing
 #: chunk bound fallback when the caller passes none: matches the swap
 #: bucket default (engine/sleep.py DEFAULT_SWAP_BUCKET_BYTES)
 DEFAULT_KV_CHUNK_BYTES = 256 << 20
+
+#: parked-bundle wire format version (GET/POST /v1/parked): bumped on
+#: any incompatible change so a mixed-version fleet rejects the handoff
+#: instead of mis-seating state
+WIRE_VERSION = 1
 
 
 class ParkedResumeFailed(RuntimeError):
@@ -251,3 +258,254 @@ def scatter_pages_h2d(
         if sp is not None:
             sp.end()
     return moved
+
+
+# -- wire format: transactional parked-bundle handoff between instances
+# (GET /v1/parked/{model} export, POST /v1/parked import; ROADMAP item 3a,
+# docs/operations.md "Draining a node without dropping streams") ------------
+#
+# A bundle on the wire is a single JSON document: the KV page payload is
+# chunked (whole pages, the same bucket discipline as the transfers above)
+# with a sha256 content digest PER CHUNK — the importer verifies every
+# digest before any device mutation, so a corrupted or truncated handoff is
+# rejected with the destination untouched. Scheduler rows and the RNG key
+# stream position ride per request, so the importer's ``resume_parked``
+# continues the stream bit-exact on other silicon. The ``identity`` block
+# (model name @ checkpoint + weight-digest fingerprint) pins which weights
+# the bundle may seat onto; the ``fence`` block (added by the exporting
+# service) makes the handoff single-use.
+
+#: Request fields that serialize verbatim (JSON-able scalars/lists).
+#: ``stop_seqs``/``logit_bias``/``out_top_logprobs`` need shape fixups and
+#: are handled explicitly; device-derived state (pages, slot) never travels
+#: — the importer re-derives it through resume_parked's old->new page map.
+_REQ_WIRE_FIELDS = (
+    "prompt", "max_new_tokens", "temperature", "top_p",
+    "presence_penalty", "frequency_penalty", "want_top_logprobs",
+    "want_prompt_logprobs", "seed", "ignore_eos", "out_tokens",
+    "out_logprobs", "prompt_logprobs", "pos", "cached_tokens",
+    "streamed", "stop_requested", "variant",
+)
+
+
+def pack_array(a: np.ndarray) -> Dict[str, Any]:
+    """One small host array as a JSON-able {b64, dtype, shape} triple
+    (scheduler counts rows, RNG key data)."""
+    a = np.ascontiguousarray(a)
+    return {
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+    }
+
+
+def unpack_array(d: Dict[str, Any]) -> np.ndarray:
+    return (
+        np.frombuffer(base64.b64decode(d["b64"]), dtype=_np_dtype(d["dtype"]))
+        .reshape(tuple(int(x) for x in d["shape"]))
+        .copy()
+    )
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 and friends are registered by ml_dtypes (a jax
+        # dependency), reachable by attribute even when the string
+        # lookup is not
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def weight_fingerprint(digests: Dict[str, str]) -> str:
+    """Order-independent sha256 fingerprint over a checkpoint's
+    flat-key -> content-digest map: two engines hold the SAME weights
+    iff their fingerprints match, which is what gates seating a
+    migrated bundle (a bundle on mismatched weights would decode
+    garbage from valid-looking KV)."""
+    h = hashlib.sha256()
+    for k in sorted(digests):
+        h.update(f"{k}:{digests[k]}\n".encode())
+    return h.hexdigest()
+
+
+def encode_request(req: Any) -> Dict[str, Any]:
+    """One engine Request as a JSON-able spec (host state only)."""
+    spec = {k: getattr(req, k) for k in _REQ_WIRE_FIELDS}
+    spec["seq_id"] = int(req.seq_id)
+    spec["stop_seqs"] = [list(s) for s in req.stop_seqs]
+    spec["logit_bias"] = {str(t): float(v) for t, v in req.logit_bias.items()}
+    spec["out_top_logprobs"] = [
+        [[int(t), float(v)] for t, v in alts] for alts in req.out_top_logprobs
+    ]
+    return spec
+
+
+def decode_request(spec: Dict[str, Any], request_cls: Any) -> Any:
+    """Rebuild an engine Request from its wire spec. The seq_id is the
+    EXPORTER'S — the importing service re-keys it with a fresh local id
+    before seating (two engines' id spaces are unrelated)."""
+    req = request_cls(
+        seq_id=int(spec["seq_id"]),
+        prompt=[int(t) for t in spec["prompt"]],
+        max_new_tokens=int(spec["max_new_tokens"]),
+        temperature=float(spec["temperature"]),
+    )
+    req.top_p = float(spec["top_p"])
+    req.presence_penalty = float(spec["presence_penalty"])
+    req.frequency_penalty = float(spec["frequency_penalty"])
+    req.want_top_logprobs = bool(spec["want_top_logprobs"])
+    req.want_prompt_logprobs = bool(spec["want_prompt_logprobs"])
+    req.seed = None if spec["seed"] is None else int(spec["seed"])
+    req.ignore_eos = bool(spec["ignore_eos"])
+    req.out_tokens = [int(t) for t in spec["out_tokens"]]
+    req.out_logprobs = [float(v) for v in spec["out_logprobs"]]
+    req.prompt_logprobs = [
+        None if v is None else float(v) for v in spec["prompt_logprobs"]
+    ]
+    req.pos = int(spec["pos"])
+    req.cached_tokens = int(spec["cached_tokens"])
+    req.streamed = int(spec["streamed"])
+    req.stop_requested = bool(spec["stop_requested"])
+    req.variant = int(spec["variant"])
+    req.stop_seqs = tuple(tuple(int(t) for t in s) for s in spec["stop_seqs"])
+    req.logit_bias = {int(t): float(v) for t, v in spec["logit_bias"].items()}
+    req.out_top_logprobs = [
+        [(int(t), float(v)) for t, v in alts]
+        for alts in spec["out_top_logprobs"]
+    ]
+    return req
+
+
+def encode_wire(
+    bundle: ParkedRequests,
+    identity: Dict[str, Any],
+    chunk_bytes: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Serialize a parked bundle for the handoff wire. ``identity`` is
+    the exporting service's model-identity block (weight_fingerprint et
+    al.); the caller adds the fence and the service-level request lists
+    (pending submissions, seed-None RNG carry-over) it alone owns."""
+    chunks: List[Dict[str, Any]] = []
+    kv: Dict[str, Any] = {
+        "page_ids": [int(p) for p in bundle.page_ids],
+        "nbytes": int(bundle.kv_nbytes),
+        "chunks": chunks,
+    }
+    if bundle.page_ids:
+        k_host, v_host = bundle.k_host, bundle.v_host
+        kv["dtype"] = str(k_host.dtype)
+        kv["shape"] = list(k_host.shape)
+        per_page = (int(k_host.nbytes) + int(v_host.nbytes)) // max(
+            1, len(bundle.page_ids)
+        )
+        per_chunk = max(
+            1,
+            int(chunk_bytes or DEFAULT_KV_CHUNK_BYTES) // max(1, per_page),
+        )
+        for lo, hi in _chunks(len(bundle.page_ids), per_chunk):
+            kb = np.ascontiguousarray(k_host[:, lo:hi]).tobytes()
+            vb = np.ascontiguousarray(v_host[:, lo:hi]).tobytes()
+            h = hashlib.sha256(kb)
+            h.update(vb)
+            chunks.append(
+                {
+                    "lo": lo,
+                    "hi": hi,
+                    "k": base64.b64encode(kb).decode("ascii"),
+                    "v": base64.b64encode(vb).decode("ascii"),
+                    "sha256": h.hexdigest(),
+                }
+            )
+    live = []
+    for pr in bundle.live:
+        spec = encode_request(pr.req)
+        spec["old_pages"] = [int(p) for p in pr.old_pages]
+        spec["counts_row"] = pack_array(pr.counts_row)
+        spec["key_data"] = pack_array(pr.key_data)
+        live.append(spec)
+    return {
+        "version": WIRE_VERSION,
+        "identity": dict(identity),
+        "kv": kv,
+        "requests": {
+            "live": live,
+            "waiting": [encode_request(r) for r in bundle.waiting],
+            "pending": [],
+        },
+        "pageout_s": float(bundle.pageout_s),
+        "nbytes": int(bundle.nbytes),
+    }
+
+
+def decode_wire(
+    doc: Dict[str, Any], request_cls: Any
+) -> Tuple[ParkedRequests, List[Dict[str, Any]]]:
+    """Rebuild a parked bundle from a wire document, verifying EVERY KV
+    chunk's content digest before returning — the caller touches no
+    device state until this succeeds, so a bad handoff is rejected with
+    the importer clean. Raises ValueError on any mismatch. Returns
+    ``(bundle, pending_specs)``; pending submissions are service-level
+    and the caller rebuilds their queue entries itself."""
+    if int(doc.get("version", -1)) != WIRE_VERSION:
+        raise ValueError(
+            f"parked wire version {doc.get('version')!r} != {WIRE_VERSION}"
+        )
+    kv = doc["kv"]
+    page_ids = [int(p) for p in kv["page_ids"]]
+    k_host = v_host = None
+    if page_ids:
+        dtype = _np_dtype(kv["dtype"])
+        shape = tuple(int(x) for x in kv["shape"])
+        if shape[1] != len(page_ids):
+            raise ValueError("KV shape does not match the page list")
+        k_host = np.empty(shape, dtype)
+        v_host = np.empty_like(k_host)
+        covered = 0
+        for ch in kv["chunks"]:
+            lo, hi = int(ch["lo"]), int(ch["hi"])
+            kb = base64.b64decode(ch["k"])
+            vb = base64.b64decode(ch["v"])
+            h = hashlib.sha256(kb)
+            h.update(vb)
+            if h.hexdigest() != ch["sha256"]:
+                raise ValueError(
+                    f"KV chunk [{lo}:{hi}] content digest mismatch"
+                )
+            sub = (shape[0], hi - lo) + shape[2:]
+            k_host[:, lo:hi] = np.frombuffer(kb, dtype).reshape(sub)
+            v_host[:, lo:hi] = np.frombuffer(vb, dtype).reshape(sub)
+            covered += hi - lo
+        if covered != len(page_ids):
+            raise ValueError("KV chunks do not cover the page list")
+    bundle = ParkedRequests(
+        page_ids=page_ids,
+        k_host=k_host,
+        v_host=v_host,
+        kv_nbytes=int(kv.get("nbytes", 0)),
+        nbytes=int(doc.get("nbytes", 0)),
+        pageout_s=float(doc.get("pageout_s", 0.0)),
+    )
+    reqs = doc["requests"]
+    for spec in reqs["live"]:
+        req = decode_request(spec, request_cls)
+        bundle.live.append(
+            ParkedRequest(
+                req=req,
+                old_pages=[int(p) for p in spec["old_pages"]],
+                counts_row=unpack_array(spec["counts_row"]),
+                key_data=unpack_array(spec["key_data"]),
+            )
+        )
+    for spec in reqs["waiting"]:
+        req = decode_request(spec, request_cls)
+        if spec.get("rng_key_data") is not None:
+            # seed-None sampled requests: the exporter pins the exact
+            # initial key its own engine would have derived from
+            # (engine seed, seq_id) — the importer's ids differ, and
+            # without this the resumed stream would sample differently
+            req.rng_key_data = unpack_array(spec["rng_key_data"])
+        bundle.waiting.append(req)
+    return bundle, list(reqs.get("pending", ()))

@@ -102,13 +102,34 @@ ENGINE_PREEMPTED = Counter(
     "Requests preempted by a zero-drain actuation, by final outcome "
     "(resumed = re-seated and continued; aborted = parked state lost — "
     "KV restore failure, parked-model eviction, or client disconnect "
-    "while parked)",
-    ["model", "outcome"],  # outcome: resumed | aborted
+    "while parked; migrated = handed off to a sibling instance and "
+    "continued there)",
+    ["model", "outcome"],  # outcome: resumed | aborted | migrated
 )
 ENGINE_KV_PAGEOUT = Counter(
     "fma_engine_kv_pageout_bytes_total",
     "Parked-KV bytes moved by zero-drain preempt/resume, by direction "
     "(d2h = page-out at park, h2d = page-in at resume)",
+    ["dir"],
+)
+# Live request migration (docs/operations.md "Draining a node without
+# dropping streams"): a zero-drain parked bundle handed to a sibling
+# instance over the wire, resumed mid-decode on the destination. Source
+# outcomes: committed (fence spent, results proxied) | resumed_local
+# (export/import failed, streams continued at home) | state_loss (the
+# double-fault degradation). Destination outcomes: imported | rolled_back.
+ENGINE_MIGRATIONS = Counter(
+    "fma_engine_migrations_total",
+    "Live request migrations, by role (source|destination) and terminal "
+    "outcome (committed | resumed_local | state_loss | imported | "
+    "rolled_back)",
+    ["role", "outcome"],
+)
+ENGINE_MIGRATE_BYTES = Counter(
+    "fma_engine_migrate_bytes_total",
+    "Parked-bundle KV bytes moved by live request migration, by "
+    "direction (export = serialized to the wire on the source, import = "
+    "paged into the destination pool)",
     ["dir"],
 )
 ENGINE_KV_USAGE = Gauge(
@@ -951,6 +972,21 @@ class ResidentRejected(Exception):
     chasing one more co-resident."""
 
 
+class MigrationRejected(Exception):
+    """A migration verb's precondition failed with nothing displaced —
+    identity mismatch, co-resident variants attached, no capacity,
+    spent fence token (the double-resume refusal) — surfaced as 409:
+    the orchestrator picks another destination or leaves the streams
+    where they are."""
+
+
+class MigrationFailed(Exception):
+    """A migration step failed AFTER recovery ran: export failure with
+    the streams resumed locally, import failure with the destination
+    rolled back clean, or an injected lost ack. Surfaced as 500; the
+    fence makes the orchestrator's retry safe."""
+
+
 class _RateEWMA:
     """Exponentially-decayed event rate (events/second).
 
@@ -1097,6 +1133,28 @@ class EngineService:
         self._zd_resumed = 0
         self._zd_aborted = 0
         self._zd_parked_bytes = 0
+        self._zd_migrated = 0
+        # Live request migration (ROADMAP item 3a; docs/operations.md
+        # "Draining a node without dropping streams"). Source side: at
+        # most ONE in-flight export — the fenced bundle awaiting the
+        # import ack — plus the set of spent fence tokens (single-use:
+        # a spent token can neither release nor locally resume again,
+        # which is what makes double-resume a 409, never a duplicate
+        # stream). Destination side: stored import acks keyed by fence
+        # token (a lost-ack retry replays the stored response instead
+        # of seating twice) and the claim table the source's result
+        # watchers poll. Counters are _slo_mu-guarded like the rest.
+        self._migration: Optional[Dict[str, Any]] = None
+        self._migration_gen = 0
+        self._spent_fences: set = set()
+        self._import_acks: Dict[str, Dict[str, Any]] = {}
+        self._imported_claims: Dict[str, Dict[str, Any]] = {}
+        self._mig = {
+            "exported": 0, "imported": 0, "committed": 0,
+            "resumed_local": 0, "rolled_back": 0, "state_loss": 0,
+            "requests_out": 0, "requests_in": 0,
+            "bytes_out": 0, "bytes_in": 0,
+        }
         self._arrival = _RateEWMA(
             getattr(args, "arrival_ewma_tau_s", 30.0) or 30.0
         )
@@ -2326,6 +2384,865 @@ class EngineService:
                 "restore live serving", exc_info=True,
             )
 
+    # -- live request migration: transactional parked-bundle handoff
+    # between sibling instances (docs/operations.md "Draining a node
+    # without dropping streams") ---------------------------------------------
+    #
+    # Verb sequence (the launcher drives it):
+    #   source GET  /v1/parked/{model}   export_parked  — park + serialize
+    #   dest   POST /v1/parked           import_parked  — verify + seat
+    #   source POST /v1/parked/release   release_parked — commit + proxy
+    #   source POST /v1/parked/abort     abort_migration — local resume
+    # The export mints a single-use fence token; the import stores its ack
+    # under it (a lost-ack retry replays the SAME ack instead of seating a
+    # second copy), and release/abort spend it exactly once — a
+    # double-resume is a 409 (MigrationRejected), never a duplicate stream.
+    # Client streams only ever resolve through the SOURCE's original
+    # futures: after release, per-stream watcher threads proxy the
+    # destination's claim views back into them.
+
+    def _migration_identity(self) -> Dict[str, Any]:
+        """The model-identity block both ends of a handoff compare:
+        name@checkpoint plus an order-independent fingerprint over the
+        weight content digests. A runtime with neither digests nor a
+        checkpoint directory (random-init dev weights) has no provable
+        identity and is refused — KV seated onto different weights
+        decodes garbage from valid-looking pages."""
+        from . import parked as parked_mod
+
+        rt = self._runtime
+        digests = rt.digests if self._content_hash else None
+        if not digests and not (rt.checkpoint_dir or ""):
+            raise MigrationRejected(
+                "no provable weight identity (no content digests and no "
+                "checkpoint): migration between random-init engines is "
+                "refused"
+            )
+        return {
+            "model": self.args.model,
+            "checkpoint_dir": rt.checkpoint_dir or "",
+            "weight_fingerprint": (
+                parked_mod.weight_fingerprint(digests) if digests else ""
+            ),
+            "page_size": int(self.args.page_size),
+            "vocab_size": int(self.engine.cfg.model.vocab_size),
+            "max_model_len": int(self.args.max_model_len or 0),
+        }
+
+    def _check_identity(self, theirs: Dict[str, Any]) -> None:
+        """Import-side identity gate. Fingerprints are authoritative when
+        both sides have them; otherwise the checkpoint path must match
+        exactly (same shared filesystem) or the import is refused."""
+        mine = self._migration_identity()
+        if theirs.get("model") != mine["model"]:
+            raise MigrationRejected(
+                f"model identity mismatch: bundle is "
+                f"{theirs.get('model')!r}, serving {mine['model']!r}"
+            )
+        fp_t = theirs.get("weight_fingerprint") or ""
+        fp_m = mine["weight_fingerprint"]
+        if fp_t and fp_m:
+            if fp_t != fp_m:
+                raise MigrationRejected(
+                    "weight fingerprint mismatch: same model name, "
+                    "different weights (refusing to seat KV onto foreign "
+                    "weights)"
+                )
+        elif (
+            not mine["checkpoint_dir"]
+            or (theirs.get("checkpoint_dir") or "") != mine["checkpoint_dir"]
+        ):
+            raise MigrationRejected(
+                "no comparable weight identity (enable --content-hash or "
+                "serve both instances from the same checkpoint)"
+            )
+        if int(theirs.get("page_size", -1)) != mine["page_size"]:
+            raise MigrationRejected(
+                f"page_size mismatch ({theirs.get('page_size')} != "
+                f"{mine['page_size']}): KV pages are not portable"
+            )
+
+    def _encode_pending(self, entry: tuple) -> Dict[str, Any]:
+        """One parked ``_pending`` submit tuple as a wire spec. The
+        future and streaming hook stay behind on the source (the proxy
+        leg resolves them); ``submit_time`` is deliberately dropped —
+        the importer stamps its own clock."""
+        (prompt, max_tokens, temperature, _fut, _on_token, top_p,
+         stop_seqs, presence, freq, want_alts, want_plp, seed,
+         ignore_eos, logit_bias, _submit_t, variant) = entry
+        return {
+            "prompt": [int(t) for t in prompt],
+            "max_tokens": int(max_tokens),
+            "temperature": float(temperature),
+            "top_p": float(top_p),
+            "stop_seqs": [list(s) for s in (stop_seqs or ())],
+            "presence_penalty": float(presence),
+            "frequency_penalty": float(freq),
+            "want_top_logprobs": bool(want_alts),
+            "want_prompt_logprobs": bool(want_plp),
+            "seed": None if seed is None else int(seed),
+            "ignore_eos": bool(ignore_eos),
+            "logit_bias": {
+                str(t): float(v) for t, v in (logit_bias or {}).items()
+            },
+            "variant": int(variant),
+        }
+
+    def _decode_pending(self, spec: Dict[str, Any], fut: Any) -> tuple:
+        """Rebuild a local ``_pending`` entry from a wire spec with a
+        fresh destination-side future (the importer's claim record holds
+        it; the source's original future is resolved by the proxy)."""
+        return (
+            [int(t) for t in spec["prompt"]],
+            int(spec["max_tokens"]),
+            float(spec["temperature"]),
+            fut,
+            None,
+            float(spec["top_p"]),
+            tuple(
+                tuple(int(t) for t in s) for s in spec.get("stop_seqs", ())
+            ),
+            float(spec["presence_penalty"]),
+            float(spec["frequency_penalty"]),
+            bool(spec["want_top_logprobs"]),
+            bool(spec["want_prompt_logprobs"]),
+            None if spec["seed"] is None else int(spec["seed"]),
+            bool(spec["ignore_eos"]),
+            {int(t): float(v) for t, v in spec.get("logit_bias", {}).items()},
+            time.monotonic(),
+            int(spec.get("variant", 0)),
+        )
+
+    def price_migrate(self) -> Dict[str, Any]:
+        """Predicted cost of exporting this engine's live work to a
+        sibling: live KV pages (the same arithmetic the park performs)
+        plus the per-live-request scheduler rows, priced through the
+        ``migrate.export`` bandwidth EWMA. What /v1/costs exposes so the
+        launcher can pick cheap drain moments."""
+        eng = self.engine
+        park = self._park_pageout_bytes()
+        live = sum(
+            1 for r in eng._slots
+            if r is not None and not r.done and not r.prefilling
+        )
+        # counts_row is [vocab] int32, key_data [2] uint32 — exact by
+        # construction, like the KV figure (park_requests stamps
+        # bundle.nbytes from the same quantities)
+        meta = live * (int(eng.cfg.model.vocab_size) * 4 + 8)
+        predicted = park + meta
+        s, measured = self.costs.bandwidths.seconds_for(
+            "migrate.export", predicted
+        )
+        return {
+            "kind": "migrate",
+            "model": self.args.model,
+            "enabled": self._zero_drain_parks(),
+            "predicted_bytes": predicted,
+            "predicted_kv_bytes": park,
+            "predicted_s": round(s, 6),
+            "measured": measured,
+            "requests": (
+                live + len(eng._waiting) + len(self._pending)
+            ),
+        }
+
+    def export_parked(self, model: str) -> Dict[str, Any]:
+        """GET /v1/parked/{model}: preempt-and-park every live stream
+        and serialize the bundle for a sibling. On success the engine is
+        ALREADY serving again (fresh pool) — new arrivals never wait on
+        the handoff — and the bundle is retained under a fence token
+        until release/abort. Fault point ``migrate.export`` fires after
+        the park: its drilled recovery is a LOCAL resume (the bundle
+        never left this process, so nothing can be lost)."""
+        from . import parked as parked_mod
+
+        if model != self.args.model:
+            raise MigrationRejected(
+                f"model {model!r} is not the serving base "
+                f"(serving {self.args.model!r})"
+            )
+        if self._residents:
+            raise MigrationRejected(
+                "co-resident variants attached "
+                f"({sorted(self._residents)}); detach them "
+                "(DELETE /v1/residents) before migrating the base"
+            )
+        if self.sleeper.is_sleeping:
+            raise MigrationRejected(
+                "instance is sleeping; wake it before migrating"
+            )
+        if not self._zero_drain_parks():
+            raise MigrationRejected(
+                "zero-drain parking unavailable (--zero-drain off, gang "
+                "serving, or --release-on-sleep): nothing can be parked "
+                "for migration"
+            )
+        if self._migration is not None:
+            raise MigrationRejected(
+                "a migration is already in flight "
+                f"(fence {self._migration['token']})"
+            )
+        identity = self._migration_identity()
+        try:
+            pred: Optional[Dict[str, Any]] = self.price_migrate()
+        except Exception:  # noqa: BLE001 — pricing must never block the verb
+            pred = None
+        t0 = time.monotonic()
+        with tracing.span("migrate.export", model=model) as sp:
+            with self._admin_lock():
+                bundle = self._park_current(park_pending=True)
+                if bundle is None:
+                    raise MigrationFailed(
+                        "zero-drain park failed; nothing was displaced "
+                        "(streams still live)"
+                    )
+                try:
+                    faults.fire("migrate.export")
+                    doc = parked_mod.encode_wire(
+                        bundle, identity,
+                        chunk_bytes=self._swap_bucket_bytes,
+                    )
+                    import jax
+                    import numpy as np
+
+                    eng = self.engine
+                    for spec in doc["requests"]["waiting"]:
+                        if spec.get("seed") is None:
+                            # pin the exact initial key THIS engine's
+                            # admission would derive from (seed, seq_id):
+                            # both differ on the importer
+                            k = jax.random.fold_in(
+                                jax.random.key(eng._seed + 1),
+                                int(spec["seq_id"]),
+                            )
+                            spec["rng_key_data"] = parked_mod.pack_array(
+                                np.asarray(jax.random.key_data(k))
+                            )
+                    doc["requests"]["pending"] = [
+                        self._encode_pending(e) for e in bundle.pending
+                    ]
+                except Exception as e:  # noqa: BLE001 — any export-leg failure resumes locally
+                    rt = self._runtime
+                    rt.parked = bundle
+                    self._unpark_current(rt)
+                    with self._slo_mu:
+                        self._mig["resumed_local"] += 1
+                    ENGINE_MIGRATIONS.labels(
+                        role="source", outcome="resumed_local"
+                    ).inc()
+                    self._record_actuation(
+                        "migrate", model, trigger="export", tier="wire",
+                        pred=None, actual_bytes=0,
+                        actual_s=time.monotonic() - t0,
+                        outcome="resumed_local",
+                        extra={"error": f"{type(e).__name__}: {e}"},
+                    )
+                    raise MigrationFailed(
+                        f"export failed ({e}); streams resumed locally"
+                    ) from e
+                import uuid
+
+                self._migration_gen += 1
+                token = (
+                    f"mig-{self._migration_gen}-{uuid.uuid4().hex[:12]}"
+                )
+                doc["fence"] = {
+                    "token": token,
+                    "gen": self._migration_gen,
+                    "source_model": model,
+                }
+                self._migration = {
+                    "token": token,
+                    "bundle": bundle,
+                    "model": model,
+                    "pred": pred,
+                    "t0": t0,
+                    "nbytes": int(doc["nbytes"]),
+                    "requests": bundle.preempted,
+                }
+                # the handoff spans separate HTTP round-trips: rebuild
+                # the pool NOW so new arrivals serve during the window —
+                # the abort leg's local resume re-seats into it, exactly
+                # like _unpark_current after a failed swap
+                self.engine.rebuild_kv_pool()
+            encode_s = time.monotonic() - t0
+            nbytes = int(doc["nbytes"])
+            if nbytes:
+                self.costs.observe_transfer(
+                    "migrate.export", nbytes, encode_s
+                )
+            ENGINE_MIGRATE_BYTES.labels(dir="export").inc(nbytes)
+            with self._slo_mu:
+                self._mig["exported"] += 1
+                self._mig["bytes_out"] += nbytes
+            sp.set(
+                nbytes=nbytes, requests=bundle.preempted, fence=token
+            )
+            self._new_work.set()
+            return doc
+
+    def import_parked(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /v1/parked: verify and seat a sibling's exported bundle.
+        Everything is checked BEFORE any engine state moves (wire
+        version, every KV chunk digest, weight identity, slot/page
+        capacity) so a refusal leaves the destination untouched; a seat
+        failure strips the foreign requests back out (clean rollback).
+        The ack is stored under the fence token BEFORE the ``migrate.ack``
+        fault point fires, so a lost-ack retry replays the SAME ack
+        instead of seating a duplicate."""
+        from . import parked as parked_mod
+        from .engine import Request
+        from .kv_cache import PageAllocator
+
+        fence = doc.get("fence") or {}
+        token = str(fence.get("token") or "")
+        if not token:
+            raise ValueError("parked import without a fence token")
+        with self._slo_mu:
+            replay = self._import_acks.get(token)
+        if replay is not None:
+            # idempotent lost-ack retry: the seat already happened
+            return dict(replay)
+        if token in self._spent_fences:
+            raise MigrationRejected(
+                f"fence token {token!r} already spent "
+                "(double-resume refused)"
+            )
+        if self.sleeper.is_sleeping:
+            raise MigrationRejected(
+                "instance is sleeping; wake it before importing"
+            )
+        if self._residents:
+            raise MigrationRejected(
+                "co-resident variants attached "
+                f"({sorted(self._residents)}); detach them "
+                "(DELETE /v1/residents) before importing a parked bundle"
+            )
+        self._check_identity(doc.get("identity") or {})
+        t0 = time.monotonic()
+        with tracing.span(
+            "migrate.import", model=self.args.model, fence=token
+        ) as sp:
+            # decode verifies every chunk digest (ValueError -> 400)
+            bundle, pending_specs = parked_mod.decode_wire(doc, Request)
+            try:
+                faults.fire("migrate.import")
+            except faults.FaultError as e:
+                with self._slo_mu:
+                    self._mig["rolled_back"] += 1
+                ENGINE_MIGRATIONS.labels(
+                    role="destination", outcome="rolled_back"
+                ).inc()
+                raise MigrationFailed(
+                    f"import failed before seating ({e}); destination "
+                    "clean"
+                ) from e
+            import uuid
+
+            with self._admin_lock():
+                eng = self.engine
+                if eng.kv_detached:
+                    raise MigrationRejected(
+                        "KV pool detached (mid-actuation); retry after "
+                        "it settles"
+                    )
+                free_slots = sum(1 for s in eng._slots if s is None)
+                if len(bundle.live) > free_slots:
+                    raise MigrationRejected(
+                        f"no capacity: {len(bundle.live)} live streams "
+                        f"need slots, {free_slots} free"
+                    )
+                # conservative (sharing-blind) page bound: resume
+                # allocates each live request's FULL budget
+                need_pages = sum(
+                    PageAllocator.pages_needed(
+                        len(pr.req.prompt) + pr.req.max_new_tokens,
+                        self.args.page_size,
+                    )
+                    for pr in bundle.live
+                )
+                if need_pages > eng.allocator.available:
+                    raise MigrationRejected(
+                        f"no capacity: bundle needs up to {need_pages} "
+                        f"KV pages, {eng.allocator.available} free"
+                    )
+                # re-key into this engine's id space; the ack's claims
+                # map (source seq_id -> claim id) lets the source proxy
+                # each stream back to its original client
+                claims: Dict[str, str] = {}
+                recs: List[tuple] = []
+                for pr in bundle.live:
+                    old = int(pr.req.seq_id)
+                    pr.req.seq_id = eng.new_seq_id()
+                    cid = uuid.uuid4().hex
+                    claims[str(old)] = cid
+                    recs.append((cid, pr.req))
+                for r in bundle.waiting:
+                    old = int(r.seq_id)
+                    r.seq_id = eng.new_seq_id()
+                    cid = uuid.uuid4().hex
+                    claims[str(old)] = cid
+                    recs.append((cid, r))
+                waiting_snapshot = list(bundle.waiting)
+                try:
+                    n_live, moved = eng.resume_parked(
+                        bundle, bucket_bytes=self._swap_bucket_bytes
+                    )
+                except parked_mod.ParkedResumeFailed as e:
+                    # the engine re-queued bundle.waiting — right for a
+                    # LOCAL resume, wrong here: these are foreign
+                    # requests the source still owns. Strip them so the
+                    # rollback really is clean.
+                    drop = {id(r) for r in waiting_snapshot}
+                    eng._waiting = [
+                        r for r in eng._waiting if id(r) not in drop
+                    ]
+                    with self._slo_mu:
+                        self._mig["rolled_back"] += 1
+                    ENGINE_MIGRATIONS.labels(
+                        role="destination", outcome="rolled_back"
+                    ).inc()
+                    raise MigrationFailed(
+                        f"import seat failed ({e}); destination rolled "
+                        "back clean"
+                    ) from e
+                for cid, r in recs:
+                    fut: concurrent.futures.Future = (
+                        concurrent.futures.Future()
+                    )
+                    self._futures[r.seq_id] = fut
+                    self._fut_seq[id(fut)] = r.seq_id
+                    self._imported_claims[cid] = {"req": r, "fut": fut}
+                for i, spec in enumerate(pending_specs):
+                    fut = concurrent.futures.Future()
+                    cid = uuid.uuid4().hex
+                    claims[f"p{i}"] = cid
+                    self._imported_claims[cid] = {"req": None, "fut": fut}
+                    self._pending.append(self._decode_pending(spec, fut))
+            if moved:
+                # kvrestore.h2d's bandwidth EWMA deliberately NOT
+                # observed here: this window includes decode+verify, and
+                # that EWMA only ever sees pure transfer windows
+                ENGINE_KV_PAGEOUT.labels(dir="h2d").inc(moved)
+            import_s = time.monotonic() - t0
+            nbytes = int(doc.get("nbytes", 0))
+            if nbytes:
+                self.costs.observe_transfer(
+                    "migrate.import", nbytes, import_s
+                )
+            ENGINE_MIGRATE_BYTES.labels(dir="import").inc(nbytes)
+            n_req = len(recs) + len(pending_specs)
+            with self._slo_mu:
+                self._mig["imported"] += 1
+                self._mig["bytes_in"] += nbytes
+                self._mig["requests_in"] += n_req
+            ENGINE_MIGRATIONS.labels(
+                role="destination", outcome="imported"
+            ).inc()
+            self._record_actuation(
+                "migrate", self.args.model, trigger="import",
+                tier="wire", pred=None, actual_bytes=nbytes,
+                actual_s=import_s, outcome="imported",
+                extra={"requests": n_req, "fence": token},
+            )
+            ack = {
+                "ok": True,
+                "fence_token": token,
+                "model": self.args.model,
+                "seated": n_live,
+                "waiting": len(waiting_snapshot),
+                "pending": len(pending_specs),
+                "requests": n_req,
+                "kv_bytes": moved,
+                "claims": claims,
+            }
+            with self._slo_mu:
+                self._import_acks[token] = dict(ack)
+            self._new_work.set()
+            sp.set(nbytes=nbytes, requests=n_req, seated=n_live)
+            try:
+                faults.fire("migrate.ack")
+            except faults.FaultError as e:
+                # the seat SUCCEEDED and the stored ack replays on the
+                # retry — only the response is lost (the drilled
+                # lost-ack leg)
+                raise MigrationFailed(
+                    f"import ack lost ({e}); retry the import (fenced, "
+                    "idempotent)"
+                ) from e
+            return ack
+
+    def release_parked(
+        self,
+        token: str,
+        dest: str = "",
+        claims: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        """POST /v1/parked/release: the destination acked the import —
+        commit. Spends the fence (a second release, or an abort after
+        this, is a 409) and hands every displaced stream to a watcher
+        thread that proxies the destination's claim back into the
+        ORIGINAL future and streaming hook: the client never reconnects,
+        and exactly-once holds because only the source resolves these
+        futures."""
+        mig = self._migration
+        if mig is None or mig["token"] != token:
+            raise MigrationRejected(
+                f"fence token {token!r} is not the in-flight migration "
+                "(spent or unknown)"
+            )
+        self._migration = None
+        self._spent_fences.add(token)
+        bundle = mig["bundle"]
+        claims = dict(claims or {})
+        model = mig["model"]
+        with tracing.span("migrate.release", model=model, fence=token):
+            watchers = 0
+            lost = 0
+            for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
+                fut = bundle.futures.get(r.seq_id)
+                cid = claims.get(str(int(r.seq_id)))
+                if fut is None or fut.done():
+                    continue  # client gone; the destination finishes alone
+                if not cid:
+                    fut.set_exception(RuntimeError(
+                        "migrated stream lost: destination acked no "
+                        "claim for it"
+                    ))
+                    self._count_abort("state_loss")
+                    lost += 1
+                    continue
+                self._start_claim_watcher(dest, cid, r, fut)
+                watchers += 1
+            for i, entry in enumerate(bundle.pending):
+                fut = entry[3]
+                cid = claims.get(f"p{i}")
+                if fut is None or fut.done():
+                    continue
+                if not cid:
+                    fut.set_exception(RuntimeError(
+                        "migrated submission lost: destination acked no "
+                        "claim for it"
+                    ))
+                    self._count_abort("state_loss")
+                    lost += 1
+                    continue
+                self._start_claim_watcher(
+                    dest, cid, self._pending_proxy_req(entry), fut
+                )
+                watchers += 1
+            n = bundle.preempted
+            migrated = n - lost
+            if lost:
+                ENGINE_PREEMPTED.labels(
+                    model=model, outcome="aborted"
+                ).inc(lost)
+            if migrated:
+                ENGINE_PREEMPTED.labels(
+                    model=model, outcome="migrated"
+                ).inc(migrated)
+            with self._slo_mu:
+                self._zd_migrated += migrated
+                self._zd_aborted += lost
+                self._zd_parked_bytes -= bundle.kv_nbytes
+                self._mig["committed"] += 1
+                self._mig["requests_out"] += migrated
+            ENGINE_MIGRATIONS.labels(
+                role="source", outcome="committed"
+            ).inc()
+            self._record_actuation(
+                "migrate", model, trigger="migrate", tier="wire",
+                pred=mig["pred"], actual_bytes=mig["nbytes"],
+                actual_s=time.monotonic() - mig["t0"],
+                outcome="committed",
+                extra={
+                    "requests": n,
+                    "proxied": watchers,
+                    "fence": token,
+                    "dest": dest or None,
+                },
+            )
+            return {
+                "ok": True,
+                "fence_token": token,
+                "model": model,
+                "migrated": migrated,
+                "proxied": watchers,
+            }
+
+    def abort_migration(self, token: str) -> Dict[str, Any]:
+        """POST /v1/parked/abort: the handoff failed after export (the
+        import errored twice, or the destination is gone) — spend the
+        fence and resume the bundle LOCALLY, the drilled recovery for
+        every single-fault case. Only an explicit double fault (the
+        local KV page-in failing too) degrades to the existing
+        ``state_loss`` abort."""
+        mig = self._migration
+        if mig is None or mig["token"] != token:
+            raise MigrationRejected(
+                f"fence token {token!r} is not the in-flight migration "
+                "(spent or unknown)"
+            )
+        self._migration = None
+        self._spent_fences.add(token)
+        bundle = mig["bundle"]
+        model = mig["model"]
+        resumed, moved, seconds, dropped = 0, 0, 0.0, 0
+        shortfall = True
+        with tracing.span("migrate.abort", model=model, fence=token):
+            rt = self._runtime
+            with self._admin_lock():
+                rt.parked = bundle
+                try:
+                    if rt.engine.kv_detached:
+                        rt.engine.rebuild_kv_pool()
+                except Exception:  # noqa: BLE001 — double fault: abort below
+                    logger.warning(
+                        "KV pool rebuild failed while aborting a "
+                        "migration", exc_info=True,
+                    )
+                if rt.parked is not None and not rt.engine.kv_detached:
+                    resumed, moved, seconds, dropped, shortfall = (
+                        self._resume_parked(rt)
+                    )
+                if rt.parked is not None:
+                    b, rt.parked = rt.parked, None
+                    self._abort_parked_bundle(
+                        b, model,
+                        "preempted request aborted: migration aborted "
+                        "and the KV pool could not be rebuilt "
+                        "(state_loss)",
+                    )
+            # _resume_parked's failure leg returns resumed=0 with
+            # shortfall set; a live-carrying bundle that hit it lost KV
+            outcome = "resumed_local"
+            if shortfall and resumed == 0 and mig["requests"] > dropped:
+                outcome = "state_loss"
+            with self._slo_mu:
+                self._mig[outcome] += 1
+            ENGINE_MIGRATIONS.labels(role="source", outcome=outcome).inc()
+            self._record_actuation(
+                "migrate", model, trigger="abort", tier="wire",
+                pred=None, actual_bytes=moved, actual_s=seconds,
+                outcome=outcome,
+                extra={
+                    "resumed": resumed,
+                    "dropped": dropped,
+                    "fence": token,
+                },
+            )
+            return {
+                "ok": outcome == "resumed_local",
+                "outcome": outcome,
+                "fence_token": token,
+                "model": model,
+                "resumed": resumed,
+            }
+
+    def claim_view(
+        self, claim_id: str, wait_s: float = 0.0, have: int = -1
+    ) -> Dict[str, Any]:
+        """GET /v1/parked/claims/{id}: the destination's view of one
+        migrated-in stream. Long-poll flavored: blocks up to ``wait_s``
+        until the stream finishes or more than ``have`` holdback-safe
+        tokens exist. Mid-flight snapshots exclude tokens a stop
+        sequence might yet strip (engine._stream's exact rule), so the
+        source proxy never streams content the engine itself would have
+        held back."""
+        from .engine import _stop_holdback
+
+        rec = self._imported_claims.get(claim_id)
+        if rec is None:
+            raise ValueError(f"unknown claim {claim_id!r}")
+        deadline = time.monotonic() + max(0.0, min(float(wait_s), 30.0))
+        while True:
+            fut = rec["fut"]
+            if fut.done():
+                from . import parked as parked_mod
+
+                try:
+                    req = fut.result()
+                except Exception as e:  # noqa: BLE001 — surfaced to the proxy
+                    return {
+                        "done": True,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                return {
+                    "done": True,
+                    "request": parked_mod.encode_request(req),
+                    "finish_reason": req.finish_reason,
+                }
+            req = rec.get("req")
+            if req is None:
+                # a parked PENDING submission: the Request exists only
+                # after the serving loop admits it
+                seq = self._fut_seq.get(id(fut))
+                if seq is not None:
+                    req = self._find_live_request(seq)
+                    if req is not None:
+                        rec["req"] = req
+            toks: List[int] = []
+            if req is not None:
+                out = list(req.out_tokens)
+                hold = _stop_holdback(out, req.stop_seqs)
+                toks = out[: len(out) - hold] if hold else out
+            if len(toks) > have or time.monotonic() >= deadline:
+                return {"done": False, "tokens": [int(t) for t in toks]}
+            time.sleep(0.02)
+
+    def _find_live_request(self, seq_id: int):
+        eng = self.engine
+        for r in eng._slots:
+            if r is not None and r.seq_id == seq_id:
+                return r
+        for r in eng._waiting:
+            if r.seq_id == seq_id:
+                return r
+        return None
+
+    def _pending_proxy_req(self, entry: tuple):
+        """A host-side Request stand-in for a parked PENDING
+        submission's proxy leg: the watcher streams into it and resolves
+        the original future with it — field-compatible with what the
+        local serving loop would have resolved."""
+        from .engine import Request
+
+        spec = self._encode_pending(entry)
+        req = Request(
+            seq_id=-1,
+            prompt=[int(t) for t in spec["prompt"]],
+            max_new_tokens=int(spec["max_tokens"]),
+            temperature=float(spec["temperature"]),
+        )
+        req.top_p = float(spec["top_p"])
+        req.stop_seqs = tuple(
+            tuple(int(t) for t in s) for s in spec["stop_seqs"]
+        )
+        req.presence_penalty = float(spec["presence_penalty"])
+        req.frequency_penalty = float(spec["frequency_penalty"])
+        req.want_top_logprobs = bool(spec["want_top_logprobs"])
+        req.want_prompt_logprobs = bool(spec["want_prompt_logprobs"])
+        req.seed = spec["seed"]
+        req.ignore_eos = bool(spec["ignore_eos"])
+        req.logit_bias = {
+            int(t): float(v) for t, v in spec["logit_bias"].items()
+        }
+        req.variant = int(spec["variant"])
+        req.on_token = entry[4]
+        req.submit_time = entry[14]
+        return req
+
+    def _claim_fetch(
+        self, dest: str, claim_id: str, have: int, wait_s: float
+    ) -> Dict[str, Any]:
+        """Fetch one claim view from the destination engine. A seam:
+        tests inject an in-process fetcher here; the default speaks the
+        engine HTTP API."""
+        import urllib.request
+
+        url = (
+            f"{dest.rstrip('/')}/v1/parked/claims/{claim_id}"
+            f"?have={int(have)}&wait_s={wait_s:g}"
+        )
+        with urllib.request.urlopen(url, timeout=wait_s + 10.0) as resp:
+            return json.loads(resp.read().decode())
+
+    def _start_claim_watcher(
+        self, dest: str, claim_id: str, req: Any, fut: Any
+    ) -> None:
+        threading.Thread(
+            target=self._watch_claim,
+            args=(dest, claim_id, req, fut),
+            name=f"migrate-claim-{claim_id[:8]}",
+            daemon=True,
+        ).start()
+
+    def _proxy_stream(self, req: Any, done: bool) -> None:
+        """Deliver proxied tokens through the original streaming hook
+        with engine._stream's exact contract: ``req.done`` is True only
+        on the final delivered token (the SSE writer keys its terminator
+        on it). Claim snapshots are already holdback-safe."""
+        if req.on_token is None:
+            req.streamed = len(req.out_tokens)
+            req.done = done
+            return
+        tail = req.out_tokens[req.streamed:]
+        try:
+            for i, t in enumerate(tail):
+                req.done = done and i == len(tail) - 1
+                req.on_token(req, t)
+                req.streamed += 1
+        finally:
+            req.done = done
+
+    def _watch_claim(
+        self, dest: str, claim_id: str, req: Any, fut: Any
+    ) -> None:
+        """Source-side proxy for one migrated stream: poll the
+        destination's claim, forward newly-safe tokens through the
+        original ``on_token`` hook, and resolve the original future with
+        the finished request. Destination-side aborts and a destination
+        that stays unreachable surface as the existing ``state_loss``
+        abort — never a silent hang."""
+        backoff = 0.1
+        first_fail: Optional[float] = None
+        while not self._stop:
+            if fut.done():
+                return  # client went away; nothing left to proxy
+            try:
+                view = self._claim_fetch(
+                    dest, claim_id, len(req.out_tokens), 5.0
+                )
+            except Exception as e:  # noqa: BLE001 — network/dest failures retry
+                now = time.monotonic()
+                if first_fail is None:
+                    first_fail = now
+                if now - first_fail > 60.0:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(
+                            "migrated stream lost: destination "
+                            f"unreachable ({e})"
+                        ))
+                        self._count_abort("state_loss")
+                    return
+                time.sleep(backoff)
+                backoff = min(2.0, backoff * 2)
+                continue
+            first_fail = None
+            backoff = 0.1
+            if view.get("done"):
+                err = view.get("error")
+                if err:
+                    if not fut.done():
+                        fut.set_exception(RuntimeError(
+                            "migrated stream aborted on the "
+                            f"destination: {err}"
+                        ))
+                        self._count_abort("state_loss")
+                    return
+                from . import parked as parked_mod
+
+                final = parked_mod.decode_request(
+                    view["request"], type(req)
+                )
+                req.out_tokens = final.out_tokens
+                req.out_logprobs = final.out_logprobs
+                req.out_top_logprobs = final.out_top_logprobs
+                req.prompt_logprobs = final.prompt_logprobs
+                req.pos = final.pos
+                req.cached_tokens = final.cached_tokens
+                req.stop_requested = final.stop_requested
+                req.finish_reason = view.get("finish_reason", "")
+                req.done_time = time.monotonic()
+                self._proxy_stream(req, done=True)
+                if not fut.done():
+                    fut.set_result(req)
+                return
+            toks = view.get("tokens") or []
+            if len(toks) > len(req.out_tokens):
+                req.out_tokens = [int(t) for t in toks]
+                self._proxy_stream(req, done=False)
+
     # -- actuation cost oracle (GET /v1/costs; docs/operations.md
     # "Pricing an actuation") ------------------------------------------------
 
@@ -2752,6 +3669,7 @@ class EngineService:
             "bandwidth_gibps": self.costs.bandwidths.describe(),
             "sleep": self.price_sleep(),
             "wake": self.price_wake(),
+            "migrate": self._price_migrate_row(),
             "compile": {
                 "mean_compile_s": exec_desc.get("mean_compile_s", 0.0),
                 "compiles_total": exec_desc.get("compiles_total", 0),
@@ -2759,6 +3677,14 @@ class EngineService:
             "candidates": candidates,
             "coresident": coresident,
         }
+
+    def _price_migrate_row(self) -> Dict[str, Any]:
+        """price_migrate, degraded to an error row instead of 500ing the
+        whole /v1/costs view (the sleep/wake row discipline)."""
+        try:
+            return self.price_migrate()
+        except Exception as e:  # noqa: BLE001 — one bad row never 500s the view
+            return {"kind": "migrate", "error": f"{type(e).__name__}: {e}"}
 
     def actuations_view(
         self, n: int = 0, kind: Optional[str] = None
@@ -4618,7 +5544,17 @@ class EngineService:
                     "preempted": self._zd_preempted,
                     "resumed": self._zd_resumed,
                     "aborted": self._zd_aborted,
+                    "migrated": self._zd_migrated,
                     "parked_kv_bytes": max(0, self._zd_parked_bytes),
+                },
+                # live-migration ledger (docs/operations.md "Draining a
+                # node without dropping streams"): per-role terminal
+                # outcomes plus the in-flight fence — what the launcher's
+                # drain loop polls and the fleet rollup aggregates
+                "migration": {
+                    **self._mig,
+                    "in_flight": bool(self._migration),
+                    "imported_claims": len(self._imported_claims),
                 },
             }
         # cost-oracle summary (utils/costs.py): per-kind bandwidth EWMAs
@@ -6125,6 +7061,114 @@ def build_app(service: EngineService) -> web.Application:
         faults.reset()
         return web.json_response(faults.describe())
 
+    async def parked_export(request: web.Request) -> web.Response:
+        """GET /v1/parked/{model}: park every live stream and export the
+        bundle wire document (docs/engine.md "/v1/parked"). 409 when a
+        precondition refuses with nothing displaced; 500 when the export
+        leg failed AFTER the park — the streams already resumed locally."""
+        model = request.match_info["model"]
+        try:
+            info = await _traced_call(
+                request, lambda: service.export_parked(model)
+            )
+        except MigrationRejected as e:
+            raise web.HTTPConflict(text=str(e))
+        except MigrationFailed as e:
+            raise web.HTTPInternalServerError(text=str(e))
+        return web.json_response(info)
+
+    async def parked_import(request: web.Request) -> web.Response:
+        """POST /v1/parked: seat an exported bundle. 400 on a corrupt
+        document (wire version, KV chunk digests), 409 on identity or
+        capacity refusal (destination untouched), 500 on a seat failure
+        (destination rolled back clean) or the drilled lost-ack —
+        retrying the SAME document is safe: the stored ack replays."""
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        try:
+            info = await _traced_call(
+                request, lambda: service.import_parked(body)
+            )
+        except MigrationRejected as e:
+            raise web.HTTPConflict(text=str(e))
+        except MigrationFailed as e:
+            raise web.HTTPInternalServerError(text=str(e))
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(info)
+
+    async def parked_release(request: web.Request) -> web.Response:
+        """POST /v1/parked/release: commit the handoff (import acked) —
+        spends the fence; a second release, or a release after abort, is
+        a 409 (double-resume refusal)."""
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        token = body.get("fence_token")
+        if not isinstance(token, str) or not token:
+            raise web.HTTPBadRequest(
+                text="release requires a 'fence_token' string"
+            )
+        dest = body.get("dest") or ""
+        claims = body.get("claims") or {}
+        if not isinstance(dest, str) or not isinstance(claims, dict):
+            raise web.HTTPBadRequest(
+                text="'dest' must be a string and 'claims' an object"
+            )
+        try:
+            info = await _traced_call(
+                request,
+                lambda: service.release_parked(
+                    token, dest=dest, claims=claims
+                ),
+            )
+        except MigrationRejected as e:
+            raise web.HTTPConflict(text=str(e))
+        return web.json_response(info)
+
+    async def parked_abort(request: web.Request) -> web.Response:
+        """POST /v1/parked/abort: roll the handoff back (import failed /
+        destination gone) — spends the fence and resumes the parked
+        streams locally."""
+        try:
+            body = await request.json()
+        except Exception:
+            raise web.HTTPBadRequest(text="invalid JSON body")
+        token = body.get("fence_token")
+        if not isinstance(token, str) or not token:
+            raise web.HTTPBadRequest(
+                text="abort requires a 'fence_token' string"
+            )
+        try:
+            info = await _traced_call(
+                request, lambda: service.abort_migration(token)
+            )
+        except MigrationRejected as e:
+            raise web.HTTPConflict(text=str(e))
+        return web.json_response(info)
+
+    async def parked_claim(request: web.Request) -> web.Response:
+        """GET /v1/parked/claims/{claim_id}: one migrated-in stream's
+        progress (long-poll with ?wait_s= and ?have=) — what the source's
+        proxy watchers consume."""
+        cid = request.match_info["claim_id"]
+        try:
+            wait_s = float(request.query.get("wait_s", "0"))
+            have = int(request.query.get("have", "-1"))
+        except ValueError:
+            raise web.HTTPBadRequest(text="wait_s/have must be numeric")
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: service.claim_view(cid, wait_s=wait_s, have=have),
+            )
+        except ValueError as e:
+            raise web.HTTPNotFound(text=str(e))
+        return web.json_response(info)
+
     async def traces(request: web.Request) -> web.Response:
         """Export this process's span ring buffer: Chrome trace-event JSON
         (Perfetto-loadable, the default) or ``?format=tree`` (human);
@@ -6182,6 +7226,11 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_get("/v1/residents", residents_get)
     app.router.add_post("/v1/residents", residents_post)
     app.router.add_delete("/v1/residents", residents_delete)
+    app.router.add_post("/v1/parked", parked_import)
+    app.router.add_post("/v1/parked/release", parked_release)
+    app.router.add_post("/v1/parked/abort", parked_abort)
+    app.router.add_get("/v1/parked/claims/{claim_id}", parked_claim)
+    app.router.add_get("/v1/parked/{model}", parked_export)
     app.router.add_get("/v1/traces", traces)
     app.router.add_post("/v1/profile", profile_start)
     app.router.add_delete("/v1/profile", profile_stop)

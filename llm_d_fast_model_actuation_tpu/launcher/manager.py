@@ -174,6 +174,36 @@ class StatsFailed(Exception):
         self.detail = detail
 
 
+class MigrateFailed(Exception):
+    """A live-request migration step failed (or was refused). Status 409
+    carries the engine's explicit precondition refusal — identity
+    mismatch, co-resident variants attached, spent fence, no capacity —
+    after which nothing was displaced. Any other status means recovery
+    already ran on the engines (source resumed locally or aborted the
+    fenced bundle); the streams survived, the handoff didn't."""
+
+    def __init__(self, instance_id: str, status: int, detail: str) -> None:
+        super().__init__(
+            f"migrate on instance {instance_id} failed ({status}): {detail}"
+        )
+        self.instance_id = instance_id
+        self.status = status
+        self.detail = detail
+
+
+class DrainFailed(Exception):
+    """A node-drain pass could not move the instance's remaining live
+    work to a sibling (no eligible sibling, or a migrate pass failed)."""
+
+    def __init__(self, instance_id: str, status: int, detail: str) -> None:
+        super().__init__(
+            f"drain of instance {instance_id} failed ({status}): {detail}"
+        )
+        self.instance_id = instance_id
+        self.status = status
+        self.detail = detail
+
+
 def probe_instance_state(
     instance: "EngineInstance", timeout: float = 2.0
 ) -> str:
@@ -1103,6 +1133,278 @@ class EngineProcessManager:
         self.ledger.set_residents(instance_id, body)
         return {"instance_id": instance_id, "residents": body}
 
+    # -- live request migration / node drain ---------------------------------
+
+    def _parsed_opts(self, instance_id: str):
+        """Parsed engine options of a live instance's stored config, or
+        None when the options are free-form (fake-kickoff managers)."""
+        from ..engine.server import parse_engine_options
+
+        try:
+            return parse_engine_options(
+                self.instances[instance_id].config.options
+            )
+        except Exception:
+            return None
+
+    def _resolve_migration_dest(
+        self, instance_id: str, model: str, dest_id: Optional[str]
+    ) -> str:
+        """Pick (or validate) the sibling instance a migration lands on.
+        Eligibility here is only 'another live instance whose stored
+        options serve the same model' — the engines themselves enforce
+        the real identity gate (weight fingerprint / checkpoint path +
+        page geometry) at import time."""
+        if dest_id is not None:
+            if dest_id == instance_id:
+                raise MigrateFailed(
+                    instance_id, 400,
+                    "destination must be a different instance",
+                )
+            if dest_id not in self.instances:
+                raise MigrateFailed(
+                    instance_id, 404,
+                    f"no such destination instance {dest_id}",
+                )
+            opts = self._parsed_opts(dest_id)
+            if opts is None or opts.model != model:
+                raise MigrateFailed(
+                    instance_id, 409,
+                    f"destination {dest_id} does not serve {model!r}; "
+                    "migration needs a sibling with provable weight "
+                    "identity",
+                )
+            return dest_id
+        for other in self.instances:
+            if other == instance_id:
+                continue
+            opts = self._parsed_opts(other)
+            if opts is not None and opts.model == model:
+                return other
+        raise MigrateFailed(
+            instance_id, 409,
+            f"no sibling instance serves {model!r}; nothing to migrate to",
+        )
+
+    def _abort_migration_on_source(
+        self, instance_id: str, token: str, timeout: float
+    ) -> None:
+        """Best-effort fenced abort after a failed import: the source
+        resumes the parked bundle locally. A failure here is logged, not
+        raised — the import failure stays the primary error, and the
+        bundle remains fenced on the source for a later manual abort."""
+        if not token:
+            return
+        try:
+            self._engine_request(
+                instance_id, "POST", "/v1/parked/abort",
+                {"fence_token": token}, timeout, MigrateFailed,
+            )
+        except (MigrateFailed, KeyError) as e:
+            logger.error(
+                "migration abort on source %s failed (%s); the bundle "
+                "stays fenced under token %s — POST /v1/parked/abort "
+                "when the engine is reachable again",
+                instance_id, e, token,
+            )
+
+    def migrate_instance(
+        self,
+        instance_id: str,
+        dest_id: Optional[str] = None,
+        timeout: float = 300,
+    ) -> Dict[str, Any]:
+        """Traced entry for the live-migration verb (docs/launcher.md)."""
+        with tracing.span(
+            "launcher.migrate", instance=instance_id, dest=dest_id or ""
+        ):
+            return self._migrate_instance_impl(instance_id, dest_id, timeout)
+
+    def _migrate_instance_impl(
+        self,
+        instance_id: str,
+        dest_id: Optional[str],
+        timeout: float,
+    ) -> Dict[str, Any]:
+        """Transactional handoff of an instance's live work to a sibling
+        serving the same model: export the fenced bundle (engine GET
+        /v1/parked/{model}), import it on the destination (POST
+        /v1/parked), release the source (POST /v1/parked/release) so it
+        proxies every surviving stream to the destination's claims.
+
+        Failure discipline mirrors the engine's drilled recoveries:
+
+        * export failure — the bundle never left the source; the engine
+          already resumed it locally, we just surface the error;
+        * import refusal (409/400) or import timeout (504, never
+          re-sent) — abort the fence so the source resumes locally;
+        * import failure (5xx/502) — ONE blind retry: the fence makes it
+          idempotent (a seated import replays its stored ack, a rolled-
+          back one seats fresh); a second failure aborts back to the
+          source.
+        """
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        opts = self._parsed_opts(instance_id)
+        if opts is None:
+            raise MigrateFailed(
+                instance_id, 400,
+                "stored options are not engine options",
+            )
+        model = opts.model
+        dest = self._resolve_migration_dest(instance_id, model, dest_id)
+        doc = self._engine_request(
+            instance_id, "GET", f"/v1/parked/{model}", None, timeout,
+            MigrateFailed,
+        )
+        token = str((doc.get("fence") or {}).get("token") or "")
+        try:
+            ack = self._engine_request(
+                dest, "POST", "/v1/parked", doc, timeout, MigrateFailed,
+            )
+        except MigrateFailed as e:
+            if e.status in (400, 409, 504):
+                # refusal (nothing displaced) or timeout (may still be
+                # executing — never re-send): resume on the source
+                self._abort_migration_on_source(instance_id, token, timeout)
+                raise
+            try:
+                ack = self._engine_request(
+                    dest, "POST", "/v1/parked", doc, timeout,
+                    MigrateFailed,
+                )
+            except MigrateFailed:
+                self._abort_migration_on_source(instance_id, token, timeout)
+                raise
+        dest_opts = self._parsed_opts(dest)
+        dest_url = f"http://127.0.0.1:{dest_opts.port}" if dest_opts else ""
+        rel = self._engine_request(
+            instance_id, "POST", "/v1/parked/release",
+            {
+                "fence_token": token,
+                "dest": dest_url,
+                "claims": ack.get("claims") or {},
+            },
+            timeout, MigrateFailed,
+        )
+        result = {
+            "instance_id": instance_id,
+            "dest_id": dest,
+            "model": model,
+            "fence_token": token,
+            "requests": int(ack.get("requests", 0)),
+            "migrated": int(rel.get("migrated", 0)),
+            "proxied": int(rel.get("proxied", 0)),
+            "bytes": int(doc.get("nbytes", 0)),
+            "import": {k: v for k, v in ack.items() if k != "claims"},
+            "release": rel,
+        }
+        obj = self.instances[instance_id].get_status()
+        obj["migration"] = {
+            k: result[k]
+            for k in (
+                "dest_id", "model", "fence_token", "requests", "migrated",
+                "proxied", "bytes",
+            )
+        }
+        result["revision"] = self._publish("MIGRATED", obj)
+        logger.info(
+            "migrated instance %s -> %s: %d request(s), %d byte(s), "
+            "%d stream(s) proxied (rev %s)",
+            instance_id, dest, result["requests"], result["bytes"],
+            result["proxied"], result["revision"],
+        )
+        return result
+
+    def drain_instance(
+        self,
+        instance_id: str,
+        timeout: float = 300,
+        max_passes: int = 8,
+    ) -> Dict[str, Any]:
+        """Traced entry for the node-drain verb (docs/operations.md
+        "Draining a node without dropping streams")."""
+        with tracing.span("launcher.drain", instance=instance_id):
+            return self._drain_instance_impl(instance_id, timeout, max_passes)
+
+    def _drain_instance_impl(
+        self, instance_id: str, timeout: float, max_passes: int
+    ) -> Dict[str, Any]:
+        """Repeat migrate passes until the instance reports no queued or
+        in-flight work, then declare it drained: every displaced stream
+        keeps flowing through the source's claim proxies, new arrivals
+        between passes are caught by the next pass, and the instance is
+        left idle — safe to stop or kill. Streams still mid-proxy do not
+        count as work: the source only forwards tokens for them."""
+        if instance_id not in self.instances:
+            raise KeyError(instance_id)
+        passes: List[Dict[str, Any]] = []
+        drained = False
+        depth = 0
+        for _ in range(max_passes + 1):
+            try:
+                stats = self._poll_instance_stats(
+                    instance_id, min(timeout, 10.0)
+                )
+            except (StatsFailed, KeyError) as e:
+                raise DrainFailed(
+                    instance_id, 502, f"stats poll failed: {e}"
+                )
+            depth = int(stats.get("queue_depth", 0))
+            if depth == 0:
+                drained = True
+                break
+            if len(passes) >= max_passes:
+                break
+            try:
+                res = self.migrate_instance(instance_id, timeout=timeout)
+            except MigrateFailed as e:
+                if e.status == 409 and len(passes) + 1 < max_passes:
+                    # a refused pass displaced nothing (the source
+                    # resumed or kept its streams): a busy sibling may
+                    # free slot/page capacity by the next pass
+                    passes.append({"refused": e.detail[:200]})
+                    time.sleep(0.2)
+                    continue
+                raise DrainFailed(
+                    instance_id, e.status,
+                    f"migrate pass {len(passes) + 1} failed: {e.detail}",
+                )
+            passes.append({
+                "dest_id": res["dest_id"],
+                "requests": res["requests"],
+                "migrated": res["migrated"],
+                "bytes": res["bytes"],
+            })
+        if not drained:
+            raise DrainFailed(
+                instance_id, 409,
+                f"{depth} request(s) still live after {len(passes)} "
+                "migrate pass(es); arrival rate may exceed drain rate — "
+                "stop routing new work to this instance and retry",
+            )
+        result = {
+            "instance_id": instance_id,
+            "drained": True,
+            "passes": passes,
+            "migrated": sum(p.get("migrated", 0) for p in passes),
+            "bytes": sum(p.get("bytes", 0) for p in passes),
+        }
+        obj = self.instances[instance_id].get_status()
+        obj["drain"] = {
+            "passes": len(passes),
+            "migrated": result["migrated"],
+            "bytes": result["bytes"],
+        }
+        result["revision"] = self._publish("DRAINED", obj)
+        logger.info(
+            "drained instance %s: %d pass(es), %d stream(s) migrated "
+            "(rev %s)",
+            instance_id, len(passes), result["migrated"],
+            result["revision"],
+        )
+        return result
+
     def _poll_instance_stats(
         self, instance_id: str, timeout: float
     ) -> Dict[str, Any]:
@@ -1161,8 +1463,13 @@ class EngineProcessManager:
         actuations = 0
         actuations_per_hour = 0.0
         aborted: Dict[str, int] = {}
-        preempted = resumed = zd_aborted = 0
+        preempted = resumed = zd_aborted = zd_migrated = 0
         parked_kv_bytes = 0
+        mig: Dict[str, int] = {
+            "committed": 0, "resumed_local": 0, "state_loss": 0,
+            "requests_out": 0, "requests_in": 0,
+            "bytes_out": 0, "bytes_in": 0,
+        }
         resident_variants = 0
         variant_hbm_bytes = coresident_saved_bytes = 0
         reporting = 0
@@ -1191,7 +1498,11 @@ class EngineProcessManager:
             preempted += int(zd.get("preempted", 0))
             resumed += int(zd.get("resumed", 0))
             zd_aborted += int(zd.get("aborted", 0))
+            zd_migrated += int(zd.get("migrated", 0))
             parked_kv_bytes += int(zd.get("parked_kv_bytes", 0))
+            mg = row.get("migration") or {}
+            for k in mig:
+                mig[k] += int(mg.get(k, 0))
             res = row.get("residents") or {}
             resident_variants += 1 + len(res.get("attached") or [])
             variant_hbm_bytes += int(res.get("variant_hbm_bytes", 0))
@@ -1218,8 +1529,12 @@ class EngineProcessManager:
                 "preempted": preempted,
                 "resumed": resumed,
                 "aborted": zd_aborted,
+                "migrated": zd_migrated,
                 "parked_kv_bytes": parked_kv_bytes,
             },
+            # live-migration rollup (engine /v1/stats migration):
+            # fleet-wide "did any handoff lose state" in one read
+            "migration": mig,
             # co-residency rollup (engine /v1/stats residents): how many
             # variants are device-resident fleet-wide, their delta HBM
             # footprint, and what sharing the base tensors saved

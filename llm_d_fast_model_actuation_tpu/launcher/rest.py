@@ -23,7 +23,9 @@ from ..utils import tracing
 from ..utils.events import RevisionTooOld
 from .instance import InstanceConfig, InvalidInstanceConfig, LogRangeNotAvailable
 from .manager import ChipConflict
+from .manager import DrainFailed
 from .manager import EngineProcessManager
+from .manager import MigrateFailed
 from .manager import PrefetchFailed
 from .manager import ResidentsFailed
 from .manager import SwapFailed
@@ -99,6 +101,8 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "prefetch_instance": "POST /v2/vllm/instances/{instance_id}/prefetch",
                     "prefetch_status": "GET /v2/vllm/instances/{instance_id}/prefetch",
                     "abort_prefetch": "DELETE /v2/vllm/instances/{instance_id}/prefetch",
+                    "migrate_instance": "POST /v2/vllm/instances/{instance_id}/migrate",
+                    "drain_instance": "POST /v2/vllm/instances/{instance_id}/drain",
                     "attach_resident": "POST /v2/vllm/instances/{instance_id}/residents",
                     "residents_status": "GET /v2/vllm/instances/{instance_id}/residents",
                     "detach_resident": "DELETE /v2/vllm/instances/{instance_id}/residents",
@@ -347,6 +351,72 @@ def build_app(manager: EngineProcessManager) -> web.Application:
             raise _map_prefetch_error(e)
         return web.json_response(result)
 
+    def _map_migrate_error(e):
+        # the engines' 409 is an explicit precondition refusal (identity
+        # mismatch, residents attached, spent fence, no capacity / drain
+        # not converging) with nothing displaced — preserved verbatim so
+        # an orchestrator can react to exactly that signal; 404 is a bad
+        # destination id; 504 timed out (recovery already ran on the
+        # engines); anything else is a gateway/engine failure
+        if e.status == 409:
+            return web.HTTPConflict(text=str(e))
+        if e.status == 404:
+            return web.HTTPNotFound(text=str(e))
+        if 400 <= e.status < 500:
+            return web.HTTPBadRequest(text=str(e))
+        if e.status == 504:
+            return web.HTTPGatewayTimeout(text=str(e))
+        return web.HTTPBadGateway(text=str(e))
+
+    async def migrate_instance(request: web.Request) -> web.Response:
+        """Live-migration verb: hand the instance's in-flight and queued
+        requests to a sibling serving the same model — transactional,
+        fenced, streams keep flowing (docs/operations.md "Draining a
+        node without dropping streams"). Body: optional ``dest_id`` to
+        pin the destination (default: first eligible sibling)."""
+        instance_id = request.match_info["instance_id"]
+        if request.can_read_body:
+            try:
+                body = await request.json()
+            except Exception:
+                raise web.HTTPBadRequest(text="invalid JSON body")
+        else:
+            body = {}
+        dest_id = body.get("dest_id")
+        if dest_id is not None and (
+            not isinstance(dest_id, str) or not dest_id
+        ):
+            raise web.HTTPUnprocessableEntity(
+                text="dest_id must be a non-empty string"
+            )
+        try:
+            # export + import move KV bytes for seconds; keep the loop free
+            result = await _traced_call(
+                request,
+                lambda: manager.migrate_instance(instance_id, dest_id=dest_id),
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except MigrateFailed as e:
+            raise _map_migrate_error(e)
+        return web.json_response(result)
+
+    async def drain_instance(request: web.Request) -> web.Response:
+        """Node-drain verb: repeat migrate passes until the instance
+        reports no live work, leaving it idle and safe to kill while
+        every displaced stream keeps flowing through the source's
+        proxies."""
+        instance_id = request.match_info["instance_id"]
+        try:
+            result = await _traced_call(
+                request, lambda: manager.drain_instance(instance_id)
+            )
+        except KeyError:
+            raise web.HTTPNotFound(text=f"Instance {instance_id} not found")
+        except (DrainFailed, MigrateFailed) as e:
+            raise _map_migrate_error(e)
+        return web.json_response(result)
+
     def _map_residents_error(e: ResidentsFailed):
         # the engine's 409 is the explicit admission rejection (cap / HBM
         # budget / detach-while-live) — preserved verbatim so a scheduler
@@ -531,6 +601,12 @@ def build_app(manager: EngineProcessManager) -> web.Application:
     )
     app.router.add_delete(
         "/v2/vllm/instances/{instance_id}/prefetch", abort_instance_prefetch
+    )
+    app.router.add_post(
+        "/v2/vllm/instances/{instance_id}/migrate", migrate_instance
+    )
+    app.router.add_post(
+        "/v2/vllm/instances/{instance_id}/drain", drain_instance
     )
     app.router.add_post(
         "/v2/vllm/instances/{instance_id}/residents",

@@ -14,7 +14,9 @@ This module holds the two pieces every engine keeps:
     ``swap.total`` — the whole-verb effective rate pool-hit pricing
     prefers — ``wake.h2d``, ``sleep.d2h``, ``coldload.read``,
     ``coldload.h2d``, ``coresident.h2d`` (the delta-only upload a
-    variant attach streams), and ``quant.dequant``, the non-hidden
+    variant attach streams), ``migrate.export`` / ``migrate.import``
+    (a live-migration parked bundle's wire serialization and its
+    destination-side page-in), and ``quant.dequant``, the non-hidden
     on-device expansion tail of compressed transfers),
     fed by the byte/time figures the transfer paths already compute
     (engine/sleep.py, models/hf.py) and surviving across actuations in
@@ -186,7 +188,7 @@ class ActuationRecord:
 
     seq: int
     t_wall: float  #: unix seconds at record time (the ring is ordered)
-    kind: str  #: swap | sleep | wake | coldload | prefetch | attach | detach
+    kind: str  #: swap | sleep | wake | coldload | prefetch | attach | detach | migrate
     model: str
     trigger: str  #: client | restart | escalation | startup
     #: where the moved state lived / went: pool | prefetched | host |

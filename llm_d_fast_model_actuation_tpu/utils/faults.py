@@ -15,6 +15,12 @@ add their own):
   ``swap.h2d``        hot-swap incoming bucket issue (engine/sleep.py)
   ``kvsave.d2h``      zero-drain park: live-KV page-out chunk (engine/parked.py)
   ``kvrestore.h2d``   zero-drain resume: KV page-in chunk (engine/parked.py)
+  ``migrate.export``  migration export: bundle serialization after the park
+                      (engine/server.py; recovery = local resume)
+  ``migrate.import``  migration import: before the destination seats anything
+                      (engine/server.py; recovery = clean rollback)
+  ``migrate.ack``     migration import ack lost after a successful seat
+                      (engine/server.py; recovery = fenced idempotent retry)
   ``coldload.read``   cold HF shard read start (models/hf.py)
   ``coldload.h2d``    cold-load / staged-placement H2D bucket (models/hf.py)
   ``prefetch.stage``  background prefetch staging start (engine/server.py)
@@ -57,6 +63,9 @@ KNOWN_POINTS = (
     "swap.h2d",
     "kvsave.d2h",
     "kvrestore.h2d",
+    "migrate.export",
+    "migrate.import",
+    "migrate.ack",
     "coldload.read",
     "coldload.h2d",
     "prefetch.stage",
