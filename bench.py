@@ -1477,6 +1477,15 @@ def _measure_fleet() -> None:
     from llm_d_fast_model_actuation_tpu.models import llama
 
     seed = int(_argv_value("--seed", "0"))
+    # --trace-requests FRAC: head-sample per-request lifecycle traces at
+    # FRAC (forwarded to the engine flag); violated/aborted/migrated
+    # requests are tail-kept regardless, which is what makes the
+    # slo_attribution scorecard below exemplar-backed
+    try:
+        trace_frac = float(_argv_value("--trace-requests", "0") or 0)
+    except ValueError:
+        trace_frac = 0.0
+    trace_frac = max(0.0, min(1.0, trace_frac))
     zero_drain = "--zero-drain" in sys.argv
     # --coresident: serve the hot set as device-resident sibling variants
     # (POST /v1/residents + per-request "model" routing) instead of
@@ -1566,6 +1575,9 @@ def _measure_fleet() -> None:
             f"--model-pool-mib 512 --content-hash on "
             f"--slo-ttft-ms {slo_ttft_ms} --slo-tpot-ms {slo_tpot_ms} "
             f"--arrival-ewma-tau-s 10"
+            + (
+                f" --trace-requests {trace_frac}" if trace_frac > 0 else ""
+            )
             + (" --zero-drain on" if zero_drain else "")
             + (
                 f" --packed-serving on --resident-variants {n_models}"
@@ -1756,6 +1768,7 @@ def _measure_fleet() -> None:
                         ttft_s=u.get("time_to_first_token_s") or 0.0,
                         queue_wait_s=u.get("queue_wait_s") or 0.0,
                         tpot_s=u.get("decode_tpot_s"),
+                        trace_id=u.get("trace_id") or "",
                         prompt=prompt,
                         max_tokens=max_tokens,
                         token_ids=(body.get("choices") or [{}])[0].get(
@@ -1821,6 +1834,7 @@ def _measure_fleet() -> None:
                         ttft_s=u.get("time_to_first_token_s") or 0.0,
                         queue_wait_s=u.get("queue_wait_s") or 0.0,
                         tpot_s=u.get("decode_tpot_s"),
+                        trace_id=u.get("trace_id") or "",
                         # zero-drain bit-exactness replay: what this
                         # (possibly preempted-and-resumed) stream
                         # produced, re-checked against an uninterrupted
@@ -2020,6 +2034,7 @@ def _measure_fleet() -> None:
             ok = ttft_total <= slo_ttft_ms / 1e3
             if rec.get("tpot_s") is not None:
                 ok = ok and rec["tpot_s"] <= slo_tpot_ms / 1e3
+            rec["violated"] = not ok
             if ok:
                 met += 1
                 goodput_tokens += rec["tokens"]
@@ -2087,6 +2102,133 @@ def _measure_fleet() -> None:
             )
         }
 
+        # --- SLO attribution: every client-judged violated request
+        # bucketed by its dominant lifecycle leg. Legs come from the
+        # engine's violated-exemplar breakdown when the request's
+        # trace_id matched one (those carry the preempt/migrate time the
+        # usage block can't express), else from the usage fields the
+        # completion itself returned.
+        exemplar_rows = []
+        for st in (engine_stats, engine_stats2):
+            if isinstance(st, dict):
+                exemplar_rows.extend(st.get("slo_exemplars") or [])
+        exemplar_legs = {
+            str(ex.get("trace_id")): dict(ex.get("legs") or {})
+            for ex in exemplar_rows
+            if isinstance(ex, dict) and ex.get("trace_id")
+        }
+        attribution = {
+            "queue": 0, "prefill": 0, "decode": 0,
+            "actuation-preempt": 0, "migration": 0,
+        }
+        violated_recs = [r for r in results if r.get("violated")]
+        exemplar_matched = 0
+        leg_sum_checked = leg_sum_within_10pct = 0
+        for rec in violated_recs:
+            ex = exemplar_legs.get(rec.get("trace_id") or "")
+            n_tok = int(rec.get("tokens") or 0)
+            decode_wall = (
+                float(rec.get("tpot_s") or 0.0) * max(0, n_tok - 1)
+            )
+            if ex is not None:
+                exemplar_matched += 1
+            if ex and (ex.get("preempt") or ex.get("migrate")):
+                legs = {
+                    "queue": float(ex.get("queue", 0.0)) + rec["hold_s"],
+                    "prefill": float(ex.get("prefill", 0.0)),
+                    "decode": float(ex.get("decode", 0.0)),
+                    "actuation-preempt": float(ex.get("preempt", 0.0)),
+                    "migration": float(ex.get("migrate", 0.0)),
+                }
+            else:
+                qw = float(rec.get("queue_wait_s") or 0.0)
+                legs = {
+                    "queue": rec["hold_s"] + qw,
+                    "prefill": max(
+                        0.0, float(rec.get("ttft_s") or 0.0) - qw
+                    ),
+                    "decode": decode_wall,
+                    "actuation-preempt": 0.0,
+                    "migration": 0.0,
+                }
+            attribution[max(legs, key=legs.get)] += 1
+            if ex is not None:
+                # acceptance: the retained request.* legs must
+                # reconstruct the request's measured TTFT+decode wall
+                # time to within 10% (the legs partition submit->done)
+                wall = float(rec.get("ttft_s") or 0.0) + decode_wall
+                leg_sum = sum(float(v) for v in ex.values())
+                leg_sum_checked += 1
+                if wall > 0 and abs(leg_sum - wall) <= 0.1 * wall:
+                    leg_sum_within_10pct += 1
+
+        # --- exemplar trace round-trip: a violated exemplar's trace
+        # must export from GET /v1/traces as Chrome trace-event JSON
+        # carrying its request.* spans (the CI assertion)
+        exemplar_roundtrip: dict = {}
+        for ex in exemplar_rows:
+            tid = (
+                str(ex.get("trace_id") or "")
+                if isinstance(ex, dict)
+                else ""
+            )
+            if not tid:
+                continue
+            events = 0
+            for b in (ebase, ebase2) if ebase2 else (ebase,):
+                try:
+                    status, payload = _http_json(
+                        "GET", b + "/v1/traces?trace_id=" + tid,
+                        timeout=15,
+                    )
+                except Exception:  # noqa: BLE001 — instance gone
+                    continue
+                if status != 200 or not isinstance(payload, dict):
+                    continue
+                evs = payload.get("traceEvents")
+                if isinstance(evs, list) and any(
+                    isinstance(e, dict)
+                    and str(e.get("name", "")).startswith("request.")
+                    and (e.get("args") or {}).get("trace_id") == tid
+                    for e in evs
+                ):
+                    events += len(evs)
+            if events:
+                exemplar_roundtrip = {
+                    "trace_id": tid, "events": events, "ok": True,
+                }
+                break
+
+        # --- migrate acceptance: at least one migrated stream whose
+        # request.* spans exist on BOTH instances under one trace_id
+        migrated_shared_traces: list = []
+        if migrate and ebase2:
+
+            def _req_tids(payload) -> set:
+                out = set()
+                if isinstance(payload, dict):
+                    for e in payload.get("traceEvents") or []:
+                        if isinstance(e, dict) and str(
+                            e.get("name", "")
+                        ).startswith("request."):
+                            tid = (e.get("args") or {}).get("trace_id")
+                            if tid:
+                                out.add(str(tid))
+                return out
+
+            try:
+                _, src_tr = _http_json(
+                    "GET", ebase + "/v1/traces", timeout=15
+                )
+                _, dst_tr = _http_json(
+                    "GET", ebase2 + "/v1/traces", timeout=15
+                )
+                migrated_shared_traces = sorted(
+                    _req_tids(src_tr) & _req_tids(dst_tr)
+                )[:8]
+            except Exception:  # noqa: BLE001 — scorecard, not the run
+                migrated_shared_traces = []
+
         _http_json("DELETE", lbase + "/v2/vllm/instances", timeout=60)
     finally:
         launcher.terminate()
@@ -2152,6 +2294,21 @@ def _measure_fleet() -> None:
                 if isinstance(engine_stats, dict)
                 else None
             ),
+            # request-lifecycle attribution scorecard (docs/tracing.md
+            # "Request-lifecycle spans"): every client-judged violated
+            # request lands in exactly one dominant-leg bucket, so the
+            # counts sum to violated_requests by construction — the CI
+            # gate asserts that plus the exemplar round-trip
+            "slo_attribution": {
+                "trace_requests": trace_frac,
+                "violated_requests": len(violated_recs),
+                "counts": attribution,
+                "engine_exemplars": len(exemplar_rows),
+                "exemplar_matched": exemplar_matched,
+                "leg_sum_checked": leg_sum_checked,
+                "leg_sum_within_10pct": leg_sum_within_10pct,
+                "exemplar_roundtrip": exemplar_roundtrip,
+            },
             "fleet": fleet_block,
             "launcher_fleet_metrics_present": (
                 isinstance(launcher_metrics, str)
@@ -2228,6 +2385,10 @@ def _measure_fleet() -> None:
                 ),
                 "bit_exact_checked": zd_checked if migrate else 0,
                 "bit_exact_mismatches": zd_mismatches if migrate else 0,
+                # trace ids whose request.* spans exist on BOTH source
+                # and destination: one timeline for a stream that lived
+                # on two chips (empty when tracing is off)
+                "shared_trace_ids": migrated_shared_traces,
             },
         },
     }
@@ -2289,6 +2450,11 @@ def _run_child(
         # without dropping a stream (docs/operations.md "Draining a node
         # without dropping streams")
         argv.append("--migrate")
+    tr_frac = _argv_value("--trace-requests", "")
+    if tr_frac:
+        # fleet sub-bench: head-sample request-lifecycle traces at this
+        # fraction (violated/aborted/migrated are tail-kept regardless)
+        argv += ["--trace-requests", tr_frac]
     return subprocess.run(
         argv + ["--child"], env=env, capture_output=True, text=True,
     )

@@ -291,6 +291,23 @@ class Request:
     #: which differ on the importing engine, so the exporter pins the
     #: exact key its own admission would have used.
     rng_key_data: Optional[Any] = None
+    #: request-lifecycle trace collector (tracing.RequestTrace) or None.
+    #: None — the --trace-requests 0 default — keeps every hook on the
+    #: serving hot path to a single ``is None`` check.
+    trace: Optional[Any] = None
+    #: stamped when the trace is finished, so the serving layer's usage
+    #: block can surface it after the collector is gone
+    trace_id: str = ""
+    #: total wall time this request spent preempted (parked + the park /
+    #: resume transfers themselves), and the share of it that happened
+    #: before the first token — the leg accounting that keeps
+    #: queue/prefill/decode legs a partition of submit→done
+    preempt_s: float = 0.0
+    preempt_pre_token_s: float = 0.0
+    #: migrated-in requests: origin trace context ({"trace_id","span_id"})
+    #: decoded from the parked bundle, so destination spans join the SAME
+    #: trace the source started
+    trace_parent: Optional[dict] = None
 
 
 def validate_logit_bias(lb, vocab_size: int) -> "Dict[int, float] | None":
@@ -1492,6 +1509,7 @@ class InferenceEngine:
         logit_bias: "Dict[int, float] | None" = None,
         submit_time: Optional[float] = None,
         variant: int = 0,
+        trace: Optional[Any] = None,
     ) -> int:
         if not prompt:
             raise ValueError("empty prompt")
@@ -1560,6 +1578,7 @@ class InferenceEngine:
             ignore_eos=ignore_eos,
             logit_bias=logit_bias or {},
             variant=int(variant),
+            trace=trace,
         )
         if submit_time is not None:
             # the HTTP layer's enqueue time, not this (possibly later)
@@ -1660,6 +1679,13 @@ class InferenceEngine:
             # echo fallback) funnels through here: the one stamp that
             # closes the queue-wait window
             req.first_sched_time = time.monotonic()
+            if req.trace is not None:
+                req.trace.add(
+                    "request.queue",
+                    req.submit_time,
+                    req.first_sched_time,
+                    depth=len(self._waiting),
+                )
         self._slots[slot] = req
         self._init_slot_key(req)
         self._eos_on[slot] = 0 if req.ignore_eos else 1
@@ -1893,6 +1919,22 @@ class InferenceEngine:
     ) -> None:
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
+            if (
+                req.trace is not None
+                and req.first_sched_time is not None
+                and not req.out_tokens
+            ):
+                # out_tokens non-empty with no first_token_time = a
+                # migrated-in mid-decode request: its prefill happened
+                # on the source; don't mislabel the re-seat window
+                req.trace.add(
+                    "request.prefill",
+                    req.first_sched_time,
+                    req.first_token_time,
+                    prompt_tokens=len(req.prompt),
+                    cached_tokens=req.cached_tokens,
+                    packed=bool(self._packed),
+                )
         req.out_tokens.append(token)
         req.out_logprobs.append(logprob)
         req.out_top_logprobs.append(alts or [])

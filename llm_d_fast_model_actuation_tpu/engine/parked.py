@@ -340,6 +340,12 @@ def encode_request(req: Any) -> Dict[str, Any]:
     spec["out_top_logprobs"] = [
         [[int(t), float(v)] for t, v in alts] for alts in req.out_top_logprobs
     ]
+    if getattr(req, "trace", None) is not None:
+        # origin trace context: destination request.* spans parent on the
+        # source's lifecycle root, so one trace_id covers both chips.
+        # Optional field — WIRE_VERSION unchanged; old importers ignore it.
+        ctx = req.trace.context()
+        spec["trace"] = {"trace_id": ctx.trace_id, "span_id": ctx.span_id}
     return spec
 
 
@@ -376,6 +382,12 @@ def decode_request(spec: Dict[str, Any], request_cls: Any) -> Any:
         [(int(t), float(v)) for t, v in alts]
         for alts in spec["out_top_logprobs"]
     ]
+    tr = spec.get("trace")
+    if isinstance(tr, dict) and tr.get("trace_id"):
+        req.trace_parent = {
+            "trace_id": str(tr["trace_id"]),
+            "span_id": str(tr.get("span_id", "")),
+        }
     return req
 
 

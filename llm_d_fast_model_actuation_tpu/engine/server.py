@@ -37,6 +37,7 @@ import os
 import shlex
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -616,6 +617,19 @@ def make_arg_parser() -> argparse.ArgumentParser:
         "request like --slo-ttft-ms; 0 disables",
     )
     p.add_argument(
+        "--trace-requests",
+        type=float,
+        default=0.0,
+        help="head-sampling fraction [0, 1] for per-request lifecycle "
+        "traces (request.* span family, docs/tracing.md): each sampled "
+        "request's queue/prefill/decode/preempt/migrate legs are "
+        "retained in a dedicated trace ring served by GET /v1/traces. "
+        "Independent of the fraction, SLO-violated, aborted, and "
+        "migrated requests always keep their spans (tail-keep). "
+        "0 (default) disables per-request tracing entirely — the "
+        "serving hot path stays byte-identical",
+    )
+    p.add_argument(
         "--arrival-ewma-tau-s",
         type=float,
         default=30.0,
@@ -892,6 +906,8 @@ def validate_parsed_args(args: argparse.Namespace) -> None:
         raise ValueError("--slo-tpot-ms must be >= 0 (0 = off)")
     if getattr(args, "arrival_ewma_tau_s", 30.0) <= 0:
         raise ValueError("--arrival-ewma-tau-s must be > 0")
+    if not 0.0 <= getattr(args, "trace_requests", 0.0) <= 1.0:
+        raise ValueError("--trace-requests must be in [0, 1]")
     if getattr(args, "model_pool_mib", 0) < 0:
         raise ValueError("--model-pool-mib must be >= 0")
     if getattr(args, "swap_bucket_mib", 1) < 1:
@@ -1155,6 +1171,26 @@ class EngineService:
             "requests_out": 0, "requests_in": 0,
             "bytes_out": 0, "bytes_in": 0,
         }
+        # Request-lifecycle tracing (docs/tracing.md "request.* spans"):
+        # head-sampling fraction applied at submit; tail-keep (violated /
+        # aborted / migrated) decided at completion. The exemplar deque
+        # pairs each retained violation with its leg breakdown so
+        # /v1/stats can answer "which leg" without a trace fetch.
+        self._trace_frac = max(
+            0.0, min(1.0, getattr(args, "trace_requests", 0.0) or 0.0)
+        )
+        tracing.configure_request_sampling(self._trace_frac)
+        self._slo_exemplars: deque = deque(
+            maxlen=int(os.environ.get("FMA_SLO_EXEMPLARS", "16") or 16)
+        )
+        # Migrated-away streams whose client is still attached: id(fut)
+        # -> {"dest", "claim"}, registered when the claim watcher starts
+        # and popped (idempotently) on every watcher exit path. This is
+        # what lets a client disconnect AFTER migration resolve to
+        # exactly one abort on each instance (the source counts
+        # reason="client" here; the destination counts its own when the
+        # claim-abort notification lands).
+        self._proxied: Dict[int, Dict[str, Any]] = {}
         self._arrival = _RateEWMA(
             getattr(args, "arrival_ewma_tau_s", 30.0) or 30.0
         )
@@ -1453,7 +1489,11 @@ class EngineService:
         Caller holds the step lock."""
         aborted = self.engine.abort_all(reason)
         self._count_abort(cause, len(aborted))
+        now = time.monotonic()
         for req in aborted:
+            self._finish_request_trace(
+                req, now, aborted=True, outcome=cause
+            )
             fut = self._futures.pop(req.seq_id, None)
             if fut is not None:
                 self._fut_seq.pop(id(fut), None)
@@ -2167,6 +2207,7 @@ class EngineService:
         untouched, caller falls back to the abort path — when the
         page-out failed."""
         eng = self.engine
+        t0 = time.monotonic()
         try:
             bundle, finished = eng.park_requests(
                 bucket_bytes=self._swap_bucket_bytes
@@ -2177,21 +2218,33 @@ class EngineService:
                 exc_info=True,
             )
             return None
+        t1 = time.monotonic()
         # requests a pipelined drain completed during the quiesce: they
         # finished on their own terms and were never preempted
         for req in finished:
             req.done_time = time.monotonic()
+            self._observe_finished(req)
             fut = self._futures.pop(req.seq_id, None)
             if fut is not None:
                 self._fut_seq.pop(id(fut), None)
                 if not fut.done():
                     fut.set_result(req)
-            self._observe_finished(req)
         for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
             fut = self._futures.pop(r.seq_id, None)
             if fut is not None:
                 self._fut_seq.pop(id(fut), None)
                 bundle.futures[r.seq_id] = fut
+            # the preempt/park/resume leg accounting: the whole parked
+            # window [t0, resume-end] accumulates into preempt_s at
+            # resume (or export time, for migrated bundles)
+            r._park_t0 = t0
+            r._park_t1 = t1
+            r._park_pre_token = r.first_token_time is None
+            if r.trace is not None:
+                r.trace.add(
+                    "request.preempt", t0, t1,
+                    kv_bytes=bundle.kv_nbytes,
+                )
         if park_pending:
             # still-queued HTTP submissions target the outgoing model
             # (validated against its vocab): they park too and re-enter
@@ -2221,15 +2274,24 @@ class EngineService:
         clean abort, never a wedged slot."""
         exc = RuntimeError(why)
         n = 0
+        now = time.monotonic()
         for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
             fut = bundle.futures.get(r.seq_id)
             if fut is not None and not fut.done():
                 fut.set_exception(exc)
+            self._finish_request_trace(
+                r, now, aborted=True, outcome="state_loss"
+            )
             n += 1
         for entry in bundle.pending:
             fut = entry[3]
             if fut is not None and not fut.done():
                 fut.set_exception(exc)
+            tr = entry[16]
+            if tr is not None:
+                tr.finish(
+                    entry[14], now, keep=True, outcome="state_loss",
+                )
             n += 1
         if n:
             self._count_abort("state_loss", n)
@@ -2288,6 +2350,13 @@ class EngineService:
             ).inc(dropped)
             with self._slo_mu:
                 self._zd_aborted += dropped
+            now = time.monotonic()
+            for r in [pr.req for pr in dead] + dead_wait:
+                # tail-keep: a stream the client dropped mid-park is a
+                # lifecycle worth reading
+                self._finish_request_trace(
+                    r, now, aborted=True, outcome="aborted"
+                )
         t0 = time.monotonic()
         try:
             n_live, moved = eng.resume_parked(
@@ -2302,10 +2371,14 @@ class EngineService:
                 f"failed ({e})"
             )
             nlost = 0
+            tloss = time.monotonic()
             for pr in bundle.live:
                 fut = bundle.futures.get(pr.req.seq_id)
                 if fut is not None and not fut.done():
                     fut.set_exception(exc)
+                self._finish_request_trace(
+                    pr.req, tloss, aborted=True, outcome="state_loss"
+                )
                 nlost += 1
             for r in bundle.waiting:
                 fut = bundle.futures.get(r.seq_id)
@@ -2346,10 +2419,26 @@ class EngineService:
             # shortfall=True: the prediction counted the bundle's pages,
             # none moved — the caller must record unpriced
             return 0, 0, time.monotonic() - t0, dropped, True
-        resume_s = time.monotonic() - t0
+        t3 = time.monotonic()
+        resume_s = t3 - t0
         if moved:
             ENGINE_KV_PAGEOUT.labels(dir="h2d").inc(moved)
             self.costs.observe_transfer("kvrestore.h2d", moved, resume_s)
+        for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
+            # close the preempt window: parked dwell + the resume
+            # transfer accumulate into the request's preempt leg
+            pt0 = getattr(r, "_park_t0", None)
+            if pt0 is not None:
+                r.preempt_s += max(0.0, t3 - pt0)
+                if getattr(r, "_park_pre_token", False):
+                    r.preempt_pre_token_s += max(0.0, t3 - pt0)
+                if r.trace is not None:
+                    pt1 = getattr(r, "_park_t1", pt0)
+                    r.trace.add("request.park", pt1, t0)
+                    r.trace.add(
+                        "request.resume", t0, t3, kv_bytes=moved
+                    )
+                r._park_t0 = None
         for seq_id, fut in bundle.futures.items():
             if not fut.done():
                 self._futures[seq_id] = fut
@@ -2469,8 +2558,8 @@ class EngineService:
         the importer stamps its own clock."""
         (prompt, max_tokens, temperature, _fut, _on_token, top_p,
          stop_seqs, presence, freq, want_alts, want_plp, seed,
-         ignore_eos, logit_bias, _submit_t, variant) = entry
-        return {
+         ignore_eos, logit_bias, _submit_t, variant, trace) = entry
+        spec = {
             "prompt": [int(t) for t in prompt],
             "max_tokens": int(max_tokens),
             "temperature": float(temperature),
@@ -2487,11 +2576,33 @@ class EngineService:
             },
             "variant": int(variant),
         }
+        if trace is not None:
+            ctx = trace.context()
+            spec["trace"] = {
+                "trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            }
+        return spec
 
     def _decode_pending(self, spec: Dict[str, Any], fut: Any) -> tuple:
         """Rebuild a local ``_pending`` entry from a wire spec with a
         fresh destination-side future (the importer's claim record holds
         it; the source's original future is resolved by the proxy)."""
+        tr = spec.get("trace")
+        trace = None
+        if (
+            isinstance(tr, dict)
+            and tr.get("trace_id")
+            and tracing.enabled()
+        ):
+            # adopt the origin trace: destination spans join the SAME
+            # trace_id, parented on the source's lifecycle root.
+            # Migrated-in work is always retained (migration forensics).
+            trace = tracing.RequestTrace(
+                sampled=True,
+                parent=tracing.SpanContext(
+                    str(tr["trace_id"]), str(tr.get("span_id", ""))
+                ),
+            )
         return (
             [int(t) for t in spec["prompt"]],
             int(spec["max_tokens"]),
@@ -2511,6 +2622,7 @@ class EngineService:
             {int(t): float(v) for t, v in spec.get("logit_bias", {}).items()},
             time.monotonic(),
             int(spec.get("variant", 0)),
+            trace,
         )
 
     def price_migrate(self) -> Dict[str, Any]:
@@ -2806,6 +2918,7 @@ class EngineService:
                         f"import seat failed ({e}); destination rolled "
                         "back clean"
                     ) from e
+                t_seat = time.monotonic()
                 for cid, r in recs:
                     fut: concurrent.futures.Future = (
                         concurrent.futures.Future()
@@ -2813,6 +2926,22 @@ class EngineService:
                     self._futures[r.seq_id] = fut
                     self._fut_seq[id(fut)] = r.seq_id
                     self._imported_claims[cid] = {"req": r, "fut": fut}
+                    if r.trace_parent and tracing.enabled():
+                        # join the origin trace: same trace_id, spans
+                        # parented on the source's lifecycle root.
+                        # Always retained — the bench's shared-trace_id
+                        # acceptance reads both sides' /v1/traces.
+                        r.trace = tracing.RequestTrace(
+                            sampled=True,
+                            parent=tracing.SpanContext(
+                                str(r.trace_parent["trace_id"]),
+                                str(r.trace_parent.get("span_id", "")),
+                            ),
+                        )
+                        r.trace.add(
+                            "request.resume", t0, t_seat,
+                            migrated=True, fence=token,
+                        )
                 for i, spec in enumerate(pending_specs):
                     fut = concurrent.futures.Future()
                     cid = uuid.uuid4().hex
@@ -2899,11 +3028,24 @@ class EngineService:
         with tracing.span("migrate.release", model=model, fence=token):
             watchers = 0
             lost = 0
+            gone = 0
+            gone_claims: List[str] = []
+            now = time.monotonic()
             for r in [pr.req for pr in bundle.live] + list(bundle.waiting):
                 fut = bundle.futures.get(r.seq_id)
                 cid = claims.get(str(int(r.seq_id)))
                 if fut is None or fut.done():
-                    continue  # client gone; the destination finishes alone
+                    # client dropped while the bundle was in flight: ONE
+                    # abort (reason=client) HERE, and the destination is
+                    # told to abort its claim so it both stops decoding
+                    # and counts its own single client abort
+                    gone += 1
+                    if cid:
+                        gone_claims.append(cid)
+                    self._finish_migrate_trace(
+                        r, mig["t0"], now, dest, outcome="aborted"
+                    )
+                    continue
                 if not cid:
                     fut.set_exception(RuntimeError(
                         "migrated stream lost: destination acked no "
@@ -2911,13 +3053,31 @@ class EngineService:
                     ))
                     self._count_abort("state_loss")
                     lost += 1
+                    self._finish_migrate_trace(
+                        r, mig["t0"], now, dest, outcome="state_loss"
+                    )
                     continue
+                self._finish_migrate_trace(
+                    r, mig["t0"], now, dest, outcome="migrated"
+                )
                 self._start_claim_watcher(dest, cid, r, fut)
                 watchers += 1
             for i, entry in enumerate(bundle.pending):
                 fut = entry[3]
                 cid = claims.get(f"p{i}")
+                tr = entry[16]
                 if fut is None or fut.done():
+                    gone += 1
+                    if cid:
+                        gone_claims.append(cid)
+                    if tr is not None:
+                        tr.add(
+                            "request.migrate", mig["t0"], now,
+                            dest=dest or "", outcome="aborted",
+                        )
+                        tr.finish(
+                            entry[14], now, keep=True, outcome="aborted"
+                        )
                     continue
                 if not cid:
                     fut.set_exception(RuntimeError(
@@ -2926,27 +3086,51 @@ class EngineService:
                     ))
                     self._count_abort("state_loss")
                     lost += 1
+                    if tr is not None:
+                        tr.add(
+                            "request.migrate", mig["t0"], now,
+                            dest=dest or "", outcome="state_loss",
+                        )
+                        tr.finish(
+                            entry[14], now, keep=True,
+                            outcome="state_loss",
+                        )
                     continue
+                if tr is not None:
+                    tr.add(
+                        "request.migrate", mig["t0"], now,
+                        dest=dest or "", outcome="migrated",
+                    )
+                    tr.finish(
+                        entry[14], now, keep=True, outcome="migrated"
+                    )
                 self._start_claim_watcher(
                     dest, cid, self._pending_proxy_req(entry), fut
                 )
                 watchers += 1
             n = bundle.preempted
-            migrated = n - lost
-            if lost:
+            migrated = n - lost - gone
+            if gone:
+                # the dropped-client invariant (tests pin it): exactly
+                # one reason=client abort and one outcome=aborted on the
+                # source for a migrated-then-disconnected stream
+                self._count_abort("client", gone)
+            if lost or gone:
                 ENGINE_PREEMPTED.labels(
                     model=model, outcome="aborted"
-                ).inc(lost)
+                ).inc(lost + gone)
             if migrated:
                 ENGINE_PREEMPTED.labels(
                     model=model, outcome="migrated"
                 ).inc(migrated)
             with self._slo_mu:
                 self._zd_migrated += migrated
-                self._zd_aborted += lost
+                self._zd_aborted += lost + gone
                 self._zd_parked_bytes -= bundle.kv_nbytes
                 self._mig["committed"] += 1
                 self._mig["requests_out"] += migrated
+            if gone_claims:
+                self._abort_claims_async(dest, gone_claims)
             ENGINE_MIGRATIONS.labels(
                 role="source", outcome="committed"
             ).inc()
@@ -3090,6 +3274,21 @@ class EngineService:
                 return {"done": False, "tokens": [int(t) for t in toks]}
             time.sleep(0.02)
 
+    def abort_claim(self, claim_id: str) -> Dict[str, Any]:
+        """DELETE /v1/parked/claims/{id}: the source's proxy learned its
+        client went away — stop generating for the migrated-in stream
+        here too. Funnels through the normal abort choke point so this
+        instance records its own single client abort; the source records
+        the matching one when it reaps the dropped future."""
+        rec = self._imported_claims.pop(claim_id, None)
+        if rec is None:
+            raise ValueError(f"unknown claim {claim_id!r}")
+        fut = rec["fut"]
+        aborted = not fut.done()
+        if aborted:
+            self.abort(fut)
+        return {"ok": True, "claim_id": claim_id, "aborted": aborted}
+
     def _find_live_request(self, seq_id: int):
         eng = self.engine
         for r in eng._slots:
@@ -3147,9 +3346,45 @@ class EngineService:
         with urllib.request.urlopen(url, timeout=wait_s + 10.0) as resp:
             return json.loads(resp.read().decode())
 
+    def _claim_abort(self, dest: str, claim_id: str) -> None:
+        """Tell the destination a migrated stream's client went away
+        (DELETE its claim). A seam like _claim_fetch: tests inject an
+        in-process caller; the default speaks the engine HTTP API."""
+        import urllib.request
+
+        url = f"{dest.rstrip('/')}/v1/parked/claims/{claim_id}"
+        urllib.request.urlopen(
+            urllib.request.Request(url, method="DELETE"), timeout=10.0
+        ).close()
+
+    def _abort_claims_async(self, dest: str, claim_ids: List[str]) -> None:
+        """Best-effort destination claim aborts off-thread (release and
+        _drain_aborts run under locks; a dead destination must not wedge
+        them). Failure is tolerable — the destination merely decodes a
+        dead stream to completion and counts it finished."""
+        if not dest or not claim_ids:
+            return
+
+        def run() -> None:
+            for cid in claim_ids:
+                try:
+                    self._claim_abort(dest, cid)
+                except Exception:  # noqa: BLE001 — best-effort
+                    logger.debug(
+                        "claim abort %s on %s failed", cid, dest,
+                        exc_info=True,
+                    )
+
+        threading.Thread(
+            target=run, name="migrate-claim-abort", daemon=True
+        ).start()
+
     def _start_claim_watcher(
         self, dest: str, claim_id: str, req: Any, fut: Any
     ) -> None:
+        # register BEFORE the thread starts: a client disconnect racing
+        # the watcher must find the proxy record in _drain_aborts
+        self._proxied[id(fut)] = {"dest": dest, "claim": claim_id}
         threading.Thread(
             target=self._watch_claim,
             args=(dest, claim_id, req, fut),
@@ -3184,6 +3419,17 @@ class EngineService:
         the finished request. Destination-side aborts and a destination
         that stays unreachable surface as the existing ``state_loss``
         abort — never a silent hang."""
+        try:
+            self._watch_claim_inner(dest, claim_id, req, fut)
+        finally:
+            # idempotent: _drain_aborts may have popped it already (and
+            # counted the client abort); this keeps the registry clean
+            # on the watcher's own terminal paths
+            self._proxied.pop(id(fut), None)
+
+    def _watch_claim_inner(
+        self, dest: str, claim_id: str, req: Any, fut: Any
+    ) -> None:
         backoff = 0.1
         first_fail: Optional[float] = None
         while not self._stop:
@@ -5286,12 +5532,38 @@ class EngineService:
             for i, entry in enumerate(self._pending):
                 if entry[3] is fut:
                     self._pending.pop(i)
+                    if entry[16] is not None:
+                        # tail-keep: aborted lifecycles always retain
+                        entry[16].finish(
+                            entry[14], time.monotonic(), keep=True,
+                            outcome="aborted",
+                        )
                     break
             seq_id = self._fut_seq.pop(id(fut), None)
             if seq_id is not None:
+                req = self._find_live_request(seq_id)
                 if self.engine.abort(seq_id, reason="client disconnected"):
                     self._count_abort("client")
+                    if req is not None:
+                        self._finish_request_trace(
+                            req, time.monotonic(), aborted=True,
+                            outcome="aborted",
+                        )
                 self._futures.pop(seq_id, None)
+            else:
+                rec = self._proxied.pop(id(fut), None)
+                if rec is not None:
+                    # migrated-away stream whose client dropped: the
+                    # claim watcher exits silently on fut.done(), so the
+                    # ONE source-side client abort is counted here (the
+                    # outcome was already committed as "migrated" at
+                    # release), and the destination is told to abort its
+                    # claim — it stops decoding and counts its own
+                    # single client abort
+                    self._count_abort("client")
+                    self._abort_claims_async(
+                        rec.get("dest", ""), [rec.get("claim", "")]
+                    )
             if not fut.done():
                 fut.cancel()
 
@@ -5307,7 +5579,7 @@ class EngineService:
                                 prompt, max_tokens, temperature, fut,
                                 on_token, top_p, stop_seqs, presence, freq,
                                 want_alts, want_plp, seed, ignore_eos,
-                                logit_bias, submit_t, variant,
+                                logit_bias, submit_t, variant, trace,
                             ) = self._pending.pop(0)
                             try:
                                 seq_id = self.engine.add_request(
@@ -5323,20 +5595,33 @@ class EngineService:
                                     logit_bias=logit_bias,
                                     submit_time=submit_t,
                                     variant=variant,
+                                    trace=trace,
                                 )
                                 self._futures[seq_id] = fut
                                 self._fut_seq[id(fut)] = seq_id
                             except Exception as e:
+                                if trace is not None:
+                                    # rejected at admission: tail-keep
+                                    # (an aborted lifecycle, however
+                                    # short, is exactly what to debug)
+                                    trace.finish(
+                                        submit_t, time.monotonic(),
+                                        keep=True, outcome="rejected",
+                                        error=f"{type(e).__name__}: {e}",
+                                    )
                                 fut.set_exception(e)
                         if self.engine.has_work():
                             for req in self.engine.step():
                                 req.done_time = time.monotonic()
+                                # observe BEFORE resolving: the usage
+                                # block reads req.trace_id, stamped by
+                                # the trace finish inside observe
+                                self._observe_finished(req)
                                 fut = self._futures.pop(req.seq_id, None)
                                 if fut is not None:
                                     self._fut_seq.pop(id(fut), None)
                                     if not fut.done():
                                         fut.set_result(req)
-                                self._observe_finished(req)
                             self._observe_kv_usage()
                             self._observe_step()
                             stepped = True
@@ -5391,11 +5676,14 @@ class EngineService:
         # (vacuously all of them, when none is configured).
         met_all = True
         evaluated = False
+        violated_slos: List[str] = []
         if self._slo_ttft_s > 0:
             ok = ttft is not None and ttft <= self._slo_ttft_s
             ENGINE_SLO_REQUESTS.labels(
                 model=m, slo="ttft", outcome="met" if ok else "violated"
             ).inc()
+            if not ok:
+                violated_slos.append("ttft")
             met_all = met_all and ok
             evaluated = True
         if self._slo_tpot_s > 0:
@@ -5409,10 +5697,17 @@ class EngineService:
             ENGINE_SLO_REQUESTS.labels(
                 model=m, slo="tpot", outcome="met" if ok else "violated"
             ).inc()
+            if not ok:
+                violated_slos.append("tpot")
             met_all = met_all and ok
             evaluated = True
         if met_all:
             ENGINE_GOODPUT_TOKENS.labels(model=m).inc(gen)
+        violated = evaluated and not met_all
+        trace_id = self._finish_request_trace(
+            req, now, violated=violated,
+            aborted=bool(getattr(req, "error", None)),
+        )
         with self._slo_mu:
             self._finished_requests += 1
             self._generated_tokens += gen
@@ -5423,6 +5718,148 @@ class EngineService:
                     self._slo_met += 1
                 else:
                     self._slo_violated += 1
+            if violated and trace_id:
+                self._slo_exemplars.append(
+                    {
+                        "trace_id": trace_id,
+                        "model": m,
+                        "violated": violated_slos,
+                        "ttft_s": None if ttft is None else round(ttft, 6),
+                        "legs": {
+                            k: round(v, 6)
+                            for k, v in self._request_legs(
+                                req, now
+                            ).items()
+                        },
+                    }
+                )
+
+    def _request_legs(self, req, now: float) -> Dict[str, float]:
+        """Decompose submit→done into the leg durations the SLO
+        exemplars (and bench.py's slo_attribution) bucket by. Preemption
+        wall time is INSIDE the raw queue/prefill/decode windows (the
+        stamps don't pause while parked), so it is carved out — the
+        pre-first-token share from queue first, then prefill; the rest
+        from decode — leaving {queue, prefill, decode, preempt} a
+        partition of the request's server-side wall time."""
+        pre = max(0.0, getattr(req, "preempt_pre_token_s", 0.0))
+        total_pre = max(0.0, getattr(req, "preempt_s", 0.0))
+        if req.first_sched_time is None:
+            queue = max(0.0, now - req.submit_time)
+            prefill = decode = 0.0
+        else:
+            queue = max(0.0, req.first_sched_time - req.submit_time)
+            if req.first_token_time is not None:
+                prefill = max(
+                    0.0, req.first_token_time - req.first_sched_time
+                )
+                decode = max(0.0, now - req.first_token_time)
+            else:
+                prefill = max(0.0, now - req.first_sched_time)
+                decode = 0.0
+        take = min(queue, pre)
+        queue -= take
+        prefill = max(0.0, prefill - (pre - take))
+        decode = max(0.0, decode - (total_pre - pre))
+        return {
+            "queue": queue,
+            "prefill": prefill,
+            "decode": decode,
+            "preempt": total_pre,
+            "migrate": 0.0,
+        }
+
+    def _finish_request_trace(
+        self,
+        req,
+        now: float,
+        violated: bool = False,
+        aborted: bool = False,
+        migrated: bool = False,
+        outcome: str = "finished",
+    ) -> str:
+        """Close out a request's lifecycle trace: decide retention
+        (head-sample draw OR tail-keep on violation/abort/migration),
+        record the one whole-window decode span, flush to the request
+        ring, and stamp req.trace_id for the usage block. At
+        --trace-requests 0 a violated/aborted request still gets a
+        retained trace, synthesized here from the Request's timestamps —
+        the hot path recorded nothing for it. Returns the trace_id when
+        spans were retained, else ''."""
+        if getattr(req, "_trace_done", False):
+            # a request can reach two finish paths (engine abort, then
+            # the step loop's finished list): first one wins
+            return req.trace_id
+        req._trace_done = True
+        tr = getattr(req, "trace", None)
+        if tr is None:
+            if not (violated or aborted) or not tracing.enabled():
+                return ""
+            tr = tracing.RequestTrace(sampled=True)
+            if req.first_sched_time is not None:
+                tr.add(
+                    "request.queue", req.submit_time, req.first_sched_time
+                )
+                first_tok = req.first_token_time
+                tr.add(
+                    "request.prefill",
+                    req.first_sched_time,
+                    first_tok if first_tok is not None else now,
+                    prompt_tokens=len(req.prompt),
+                    cached_tokens=req.cached_tokens,
+                    synthesized=True,
+                )
+        if (
+            req.first_token_time is not None
+            and now > req.first_token_time
+            and not migrated
+        ):
+            # ONE span for the whole decode window — never one per step.
+            # Migrated-away requests skip it: their decode continues on
+            # the destination, which records its own window.
+            tr.add(
+                "request.decode",
+                req.first_token_time,
+                now,
+                tokens=len(req.out_tokens),
+                finish_reason=req.finish_reason or "",
+            )
+        keep = tr.sampled or violated or aborted or migrated
+        if aborted and outcome == "finished":
+            outcome = "aborted"
+        tid = tr.finish(
+            req.submit_time,
+            now,
+            keep,
+            outcome=outcome,
+            violated=bool(violated),
+            prompt_tokens=len(req.prompt),
+            tokens=len(req.out_tokens),
+            preempt_s=round(getattr(req, "preempt_s", 0.0), 6),
+        )
+        req.trace = None
+        req.trace_id = tid if keep else ""
+        return req.trace_id
+
+    def _finish_migrate_trace(
+        self, req, t0: float, now: float, dest: str, outcome: str
+    ) -> str:
+        """Source-side close-out for a migrated-away stream: a
+        ``request.migrate`` span over the handoff window
+        [export-park, release], then the lifecycle root with
+        outcome=migrated — ALWAYS retained (migration forensics: a
+        cross-chip stream's source half must be fetchable whatever the
+        sampling draw was). The destination's spans carry the same
+        trace_id, so the two exports concatenate into one timeline."""
+        if getattr(req, "trace", None) is None:
+            return ""
+        req.trace.add(
+            "request.migrate", t0, now, dest=dest or "", outcome=outcome
+        )
+        req.trace.sampled = True
+        return self._finish_request_trace(
+            req, now, migrated=True, outcome=outcome
+        )
 
     def _observe_kv_usage(self) -> None:
         alloc = self.engine.allocator
@@ -5556,6 +5993,11 @@ class EngineService:
                     "in_flight": bool(self._migration),
                     "imported_claims": len(self._imported_claims),
                 },
+                # last-N SLO-violated exemplars (docs/tracing.md): each
+                # row pairs a retained trace_id with its leg-duration
+                # breakdown, so "attainment dropped — which leg?" is one
+                # stats read + one /v1/traces fetch
+                "slo_exemplars": list(self._slo_exemplars),
             }
         # cost-oracle summary (utils/costs.py): per-kind bandwidth EWMAs
         # + last-N prediction accuracy — the fleet harness scores oracle
@@ -5591,11 +6033,15 @@ class EngineService:
         ignore_eos: bool = False,
         logit_bias: "Dict[int, float] | None" = None,
         variant: int = 0,
+        trace_ctx: "tracing.SpanContext | None" = None,
     ) -> concurrent.futures.Future:
         """Enqueue a request. `on_token(req, tok)` — if given — fires on the
         engine thread for every emitted token (the streaming hook); keep it
         to an enqueue. ``variant`` routes to a co-resident sibling
-        (resolve_request_model) — 0 is the base model."""
+        (resolve_request_model) — 0 is the base model. ``trace_ctx`` is
+        the client's ``traceparent`` (completions handlers): it forces a
+        lifecycle trace even at --trace-requests 0 and parents it on the
+        caller's span."""
         fut: concurrent.futures.Future = concurrent.futures.Future()
         if self.is_follower:
             fut.set_exception(
@@ -5613,11 +6059,21 @@ class EngineService:
             # demand signal, stamped at the HTTP edge: the EWMA must see
             # offered load even when the engine is saturated or asleep
             self._arrival.observe(now)
+        trace = None
+        if tracing.enabled() and (
+            trace_ctx is not None or tracing.request_sampling() > 0.0
+        ):
+            # frac 0 with no client traceparent: no collector, and every
+            # downstream hook is a single `is None` check (byte-inert)
+            trace = tracing.RequestTrace(
+                sampled=trace_ctx is not None or tracing.sample_request(),
+                parent=trace_ctx,
+            )
         self._pending.append(
             (prompt, max_tokens, temperature, fut, on_token, top_p, stop_seqs,
              presence_penalty, frequency_penalty, want_top_logprobs,
              want_prompt_logprobs, seed, ignore_eos, logit_bias, now,
-             int(variant))
+             int(variant), trace)
         )
         self._new_work.set()
         ENGINE_QUEUE_DEPTH.labels(model=self.args.model).set(self.queue_depth())
@@ -6109,7 +6565,13 @@ def _lifecycle_usage(req: Any) -> Dict[str, Any]:
         and n > 1
     ):
         tpot = max(0.0, (req.done_time - req.first_token_time) / (n - 1))
-    return {"queue_wait_s": qw, "decode_tpot_s": tpot}
+    out = {"queue_wait_s": qw, "decode_tpot_s": tpot}
+    tid = getattr(req, "trace_id", "")
+    if tid:
+        # retained lifecycle trace (sampled or tail-kept): the handle a
+        # client/harness passes to GET /v1/traces?trace_id=...
+        out["trace_id"] = tid
+    return out
 
 
 def _finish_reason(service: "EngineService", req: Any) -> str:
@@ -6566,6 +7028,8 @@ def build_app(service: EngineService) -> web.Application:
         ignore_eos=False,
         logit_bias=None,
         variant=0,
+        trace_ctx=None,
+        usage_chunk=None,
     ) -> web.StreamResponse:
         """OpenAI-style SSE stream: one `data: {json}` event per emitted
         token, `data: [DONE]` terminator. Tokens cross the engine-thread ->
@@ -6574,7 +7038,13 @@ def build_app(service: EngineService) -> web.Application:
 
         Chunk text comes from an incremental detokenizer; stop STRINGS are
         matched here on the decoded text (held back until disambiguated)
-        and end the stream early, aborting the in-flight generation."""
+        and end the stream early, aborting the in-flight generation.
+
+        When the stream completes normally a final ``usage_chunk`` event
+        precedes ``[DONE]``, carrying the lifecycle fields non-streaming
+        responses already expose (queue_wait_s / decode_tpot_s /
+        trace_id) — streamed requests are scoreable by the fleet harness
+        too."""
         from .tokenizer import IncrementalDecoder, TextStopStream
 
         filt = TextStopStream(tok, stop_texts) if stop_texts else None
@@ -6590,7 +7060,7 @@ def build_app(service: EngineService) -> web.Application:
             top_p=top_p, stop_seqs=stop_seqs,
             presence_penalty=presence, frequency_penalty=frequency,
             seed=seed, ignore_eos=ignore_eos, logit_bias=logit_bias,
-            variant=variant,
+            variant=variant, trace_ctx=trace_ctx,
         )
         afut = asyncio.ensure_future(asyncio.wrap_future(fut))
         resp = web.StreamResponse(
@@ -6600,6 +7070,7 @@ def build_app(service: EngineService) -> web.Application:
             }
         )
         qtask: Optional[asyncio.Task] = None
+        completed = False
         try:
             # inside the try: a disconnect cancelling this await must still
             # abort the in-flight generation
@@ -6637,6 +7108,7 @@ def build_app(service: EngineService) -> web.Application:
                                 )
                             if not req_done:
                                 service.abort(fut)
+                            completed = req_done
                             break
                         if not text and not req_done:
                             continue  # held back: ids stay in the filter
@@ -6649,6 +7121,7 @@ def build_app(service: EngineService) -> web.Application:
                     index += 1
                     await resp.write(f"data: {payload}\n\n".encode())
                     if req_done:
+                        completed = True
                         break
                 elif afut.done():
                     # finished without a terminal token event: submit error,
@@ -6663,6 +7136,28 @@ def build_app(service: EngineService) -> web.Application:
                         err = json.dumps({"error": str(exc)})
                         await resp.write(f"data: {err}\n\n".encode())
                     break
+            if completed and usage_chunk is not None:
+                # the future resolves right after the terminal token (the
+                # engine loop resolves it in the same step); shield keeps
+                # the finally's cancel from killing a racing completion
+                req = None
+                with contextlib.suppress(Exception):
+                    req = await asyncio.wait_for(
+                        asyncio.shield(afut), timeout=5.0
+                    )
+                if req is not None and getattr(req, "error", None) is None:
+                    u = {
+                        "prompt_tokens": len(req.prompt),
+                        "completion_tokens": len(req.out_tokens),
+                        "time_to_first_token_s": (
+                            (req.first_token_time - req.submit_time)
+                            if req.first_token_time
+                            else None
+                        ),
+                        **_lifecycle_usage(req),
+                    }
+                    payload = json.dumps(usage_chunk(u))
+                    await resp.write(f"data: {payload}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (asyncio.CancelledError, ConnectionResetError):
             service.abort(fut)
@@ -6748,7 +7243,7 @@ def build_app(service: EngineService) -> web.Application:
         n: int, tokens, max_tokens, temperature, top_p, stop_seqs,
         presence, frequency, stop_texts=(), want_alts=False,
         want_prompt_logprobs=False, seed=None, ignore_eos=False,
-        logit_bias=None, variant=0,
+        logit_bias=None, variant=0, trace_ctx=None,
     ):
         """n parallel submissions; abort every sibling if any fails or the
         client goes away (no orphan decode cycles). Prefix caching makes
@@ -6775,6 +7270,9 @@ def build_app(service: EngineService) -> web.Application:
                 ignore_eos=ignore_eos,
                 logit_bias=logit_bias,
                 variant=variant,
+                # the client's traceparent traces choice 0 (whose usage
+                # the response carries); siblings stay on the sampler
+                trace_ctx=trace_ctx if i == 0 else None,
             )
             for i in range(n)
         ]
@@ -6809,6 +7307,7 @@ def build_app(service: EngineService) -> web.Application:
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         resp_model = body.get("model") or service.args.model
+        trace_ctx = tracing.context_from_headers(request.headers)
 
         n = _parse_n(body)
         try:
@@ -6835,11 +7334,20 @@ def build_app(service: EngineService) -> web.Application:
                     ],
                 }
 
+            def usage_chunk(usage: Dict[str, Any]) -> Dict[str, Any]:
+                return {
+                    "object": "text_completion",
+                    "model": resp_model,
+                    "choices": [],
+                    "usage": usage,
+                }
+
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
                 ignore_eos=ignore_eos, logit_bias=logit_bias,
-                variant=variant,
+                variant=variant, trace_ctx=trace_ctx,
+                usage_chunk=usage_chunk,
             )
 
         reqs = await _gather_n(
@@ -6847,7 +7355,7 @@ def build_app(service: EngineService) -> web.Application:
             presence, frequency, stop_texts, want_alts=logprobs_n > 0,
             want_prompt_logprobs=echo and bool(body.get("logprobs")),
             seed=seed, ignore_eos=ignore_eos, logit_bias=logit_bias,
-            variant=variant,
+            variant=variant, trace_ctx=trace_ctx,
         )
         req = reqs[0]
         ttft = (
@@ -6940,6 +7448,7 @@ def build_app(service: EngineService) -> web.Application:
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         resp_model = body.get("model") or service.args.model
+        trace_ctx = tracing.context_from_headers(request.headers)
         n = _parse_n(body)
         try:
             top_n = (
@@ -6965,17 +7474,27 @@ def build_app(service: EngineService) -> web.Application:
                     "choices": [{"index": 0, "delta": delta}],
                 }
 
+            def usage_chunk(usage: Dict[str, Any]) -> Dict[str, Any]:
+                return {
+                    "object": "chat.completion.chunk",
+                    "model": resp_model,
+                    "choices": [],
+                    "usage": usage,
+                }
+
             return await _stream_sse(
                 request, tokens, max_tokens, temperature, top_p, stop_seqs,
                 stop_texts, presence, frequency, chunk, seed=seed,
                 ignore_eos=ignore_eos, logit_bias=logit_bias,
-                variant=variant,
+                variant=variant, trace_ctx=trace_ctx,
+                usage_chunk=usage_chunk,
             )
 
         reqs = await _gather_n(
             n, tokens, max_tokens, temperature, top_p, stop_seqs,
             presence, frequency, stop_texts, want_alts=top_n > 0, seed=seed,
             ignore_eos=ignore_eos, logit_bias=logit_bias, variant=variant,
+            trace_ctx=trace_ctx,
         )
         from .tokenizer import truncate_at_text_stop
 
@@ -7169,6 +7688,19 @@ def build_app(service: EngineService) -> web.Application:
             raise web.HTTPNotFound(text=str(e))
         return web.json_response(info)
 
+    async def parked_claim_abort(request: web.Request) -> web.Response:
+        """DELETE /v1/parked/claims/{claim_id}: the source proxy's
+        client dropped — abort the migrated-in stream on this
+        (destination) instance too."""
+        cid = request.match_info["claim_id"]
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: service.abort_claim(cid)
+            )
+        except ValueError as e:
+            raise web.HTTPNotFound(text=str(e))
+        return web.json_response(info)
+
     async def traces(request: web.Request) -> web.Response:
         """Export this process's span ring buffer: Chrome trace-event JSON
         (Perfetto-loadable, the default) or ``?format=tree`` (human);
@@ -7230,6 +7762,7 @@ def build_app(service: EngineService) -> web.Application:
     app.router.add_post("/v1/parked/release", parked_release)
     app.router.add_post("/v1/parked/abort", parked_abort)
     app.router.add_get("/v1/parked/claims/{claim_id}", parked_claim)
+    app.router.add_delete("/v1/parked/claims/{claim_id}", parked_claim_abort)
     app.router.add_get("/v1/parked/{model}", parked_export)
     app.router.add_get("/v1/traces", traces)
     app.router.add_post("/v1/profile", profile_start)

@@ -1472,11 +1472,15 @@ class EngineProcessManager:
         }
         resident_variants = 0
         variant_hbm_bytes = coresident_saved_bytes = 0
+        slo_exemplars: List[Dict[str, Any]] = []
         reporting = 0
-        for row in per_instance.values():
+        for iid, row in per_instance.items():
             if not row.get("reporting"):
                 continue
             reporting += 1
+            for ex in row.get("slo_exemplars") or []:
+                if isinstance(ex, dict):
+                    slo_exemplars.append({"instance": iid, **ex})
             slo = row.get("slo") or {}
             met += int(slo.get("met", 0))
             violated += int(slo.get("violated", 0))
@@ -1543,6 +1547,11 @@ class EngineProcessManager:
                 "variant_hbm_bytes": variant_hbm_bytes,
                 "coresident_saved_bytes": coresident_saved_bytes,
             },
+            # SLO-violation exemplars lifted from every reporting child
+            # (engine /v1/stats slo_exemplars), each tagged with the
+            # instance it came from so an operator can pull the trace
+            # via that child's GET /v1/traces?trace_id=
+            "slo_exemplars": slo_exemplars[-16:],
             "per_instance": per_instance,
         }
         LAUNCHER_FLEET_INSTANCES.labels(state="reporting").set(reporting)

@@ -109,6 +109,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
                     "watch_instances": "GET /v2/vllm/instances/watch",
                     "faults": "GET/POST/DELETE /v2/vllm/faults",
                     "traces": "GET /v2/vllm/traces",
+                    "exemplars": "GET /v2/vllm/exemplars",
                 },
             }
         )
@@ -565,6 +566,29 @@ def build_app(manager: EngineProcessManager) -> web.Application:
         faults.reset()
         return web.json_response(faults.describe())
 
+    async def exemplars(request: web.Request) -> web.Response:
+        """GET /v2/vllm/exemplars: the fleet's SLO-violation exemplars —
+        last-N violated requests across every reporting child, each with
+        its trace_id, leg-duration breakdown, and owning instance, so an
+        operator can jump straight from "attainment is dropping" to one
+        child's GET /v1/traces?trace_id= (docs/operations.md)."""
+        try:
+            fleet = await asyncio.get_running_loop().run_in_executor(
+                None, manager.fleet_rollup
+            )
+        except Exception as e:  # noqa: BLE001 — degraded poll, not a 500
+            logger.warning("fleet rollup for exemplars failed", exc_info=True)
+            raise web.HTTPServiceUnavailable(text=str(e))
+        return web.json_response(
+            {
+                "slo_exemplars": fleet.get("slo_exemplars") or [],
+                "slo_attainment": fleet.get("slo_attainment"),
+                "slo_requests_violated": fleet.get(
+                    "slo_requests_violated", 0
+                ),
+            }
+        )
+
     async def traces(request: web.Request) -> web.Response:
         """Export the LAUNCHER process's span ring buffer (create/swap/
         restart verbs + launcher.rpc hops). The engine children export
@@ -579,6 +603,7 @@ def build_app(manager: EngineProcessManager) -> web.Application:
 
     app.router.add_get("/health", health)
     app.router.add_get("/v2/vllm/traces", traces)
+    app.router.add_get("/v2/vllm/exemplars", exemplars)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/", index)
     app.router.add_get("/v2/vllm/faults", faults_get)

@@ -42,6 +42,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -176,11 +177,15 @@ def disable() -> None:
 
 def reset_after_fork() -> None:
     """Forked-child hygiene (the launcher's process model): the fork
-    duplicates the parent's ring buffer — drop the copies so the child's
+    duplicates the parent's ring buffers — drop the copies so the child's
     export is its own spans only, and re-read the env so per-instance
-    env_vars (FMA_TRACING / FMA_TRACE_BUFFER) win over inherited state."""
-    global _BUFFER, _enabled
+    env_vars (FMA_TRACING / FMA_TRACE_BUFFER) win over inherited state.
+    Request sampling resets to 0 (off): the child re-applies its own
+    ``--trace-requests`` during engine construction."""
+    global _BUFFER, _enabled, _REQ_BUFFER, _req_frac
     _BUFFER = TraceBuffer(_env_capacity())
+    _REQ_BUFFER = TraceBuffer(_req_env_capacity())
+    _req_frac = 0.0
     _enabled = _env_enabled()
     _current.set(None)
 
@@ -406,6 +411,167 @@ def buffer_len() -> int:
     return len(_BUFFER)
 
 
+# -- request-scoped tracing ---------------------------------------------------
+#
+# The ``request.*`` span family (docs/tracing.md): one trace per served
+# request, spans recorded retrospectively at lifecycle edges (explicit
+# start/end monotonic times — no open handles crossing threads, no
+# per-decode-step span flood). Retained spans land in a DEDICATED ring,
+# separate from the actuation ring above, so decode traffic can never
+# evict swap forensics (and vice versa). Retention is head sampling
+# (``--trace-requests <frac>``) plus tail-keep: SLO-violated, aborted,
+# and migrated requests always keep their spans.
+
+#: ring capacity override for the request-span ring (spans per process).
+REQ_BUFFER_ENV_VAR = "FMA_REQ_TRACE_BUFFER"
+DEFAULT_REQ_BUFFER_SPANS = 8192
+
+
+def _req_env_capacity() -> int:
+    try:
+        return int(
+            os.environ.get(REQ_BUFFER_ENV_VAR, "")
+            or DEFAULT_REQ_BUFFER_SPANS
+        )
+    except ValueError:
+        return DEFAULT_REQ_BUFFER_SPANS
+
+
+_REQ_BUFFER = TraceBuffer(_req_env_capacity())
+_req_frac = 0.0
+
+
+def configure_request_sampling(frac: float) -> None:
+    """Set the head-sampling fraction for request traces
+    (``--trace-requests``). 0 — the default — keeps the serving hot path
+    byte-inert: no RequestTrace objects are created and every hook
+    reduces to one ``is None`` check."""
+    global _req_frac
+    try:
+        _req_frac = min(1.0, max(0.0, float(frac)))
+    except (TypeError, ValueError):
+        _req_frac = 0.0
+
+
+def request_sampling() -> float:
+    return _req_frac
+
+
+def sample_request() -> bool:
+    """One head-sampling draw, decided at request creation. The draw is
+    carried on the RequestTrace (``sampled``) so tail-keep can overrule
+    a negative draw at completion — not the other way around."""
+    return _enabled and _req_frac > 0.0 and random.random() < _req_frac
+
+
+class RequestTrace:
+    """Per-request span collector.
+
+    Spans accumulate privately on the instance (appends are GIL-atomic;
+    the engine's step discipline serializes real mutators anyway) and
+    nothing touches any ring until :meth:`finish` decides retention:
+    head-sampled requests keep their spans, everyone else's are dropped
+    at completion unless tail-keep (SLO violation / abort / migration)
+    overrules. The lifecycle root's span_id is allocated up front so
+    child spans — including spans recorded by ANOTHER process after a
+    migration, via :meth:`context` serialized into the parked bundle —
+    parent on it before it is finished."""
+
+    __slots__ = ("trace_id", "root_id", "parent_id", "sampled", "spans",
+                 "_done")
+
+    def __init__(
+        self,
+        sampled: bool = False,
+        parent: Optional[SpanContext] = None,
+    ) -> None:
+        self.trace_id = parent.trace_id if parent else _new_trace_id()
+        self.parent_id = parent.span_id if parent else ""
+        self.root_id = _new_span_id()
+        self.sampled = bool(sampled)
+        self.spans: List[Span] = []
+        self._done = False
+
+    def context(self) -> SpanContext:
+        """What a child recorded elsewhere (another thread, or another
+        process across the migration wire) parents on: the lifecycle
+        root."""
+        return SpanContext(self.trace_id, self.root_id)
+
+    def traceparent(self) -> str:
+        return format_traceparent(self.context())
+
+    def add(
+        self,
+        name: str,
+        start_s: float,
+        end_s: float,
+        parent_id: Optional[str] = None,
+        **attrs: Any,
+    ) -> str:
+        """Record one retrospective child span from explicit monotonic
+        times; returns its span_id (for grandchildren)."""
+        span = Span(
+            trace_id=self.trace_id,
+            span_id=_new_span_id(),
+            parent_id=self.root_id if parent_id is None else parent_id,
+            name=name,
+            start_s=float(start_s),
+            end_s=float(end_s),
+            attrs=dict(attrs) if attrs else {},
+            pid=os.getpid(),
+            thread=threading.current_thread().name,
+        )
+        self.spans.append(span)
+        return span.span_id
+
+    def finish(
+        self,
+        start_s: float,
+        end_s: float,
+        keep: bool,
+        name: str = "request.lifecycle",
+        **attrs: Any,
+    ) -> str:
+        """Build the ``request.lifecycle`` root over [start_s, end_s] and,
+        iff ``keep``, flush root + children to the request ring. Always
+        returns the trace_id; idempotent (a double finish flushes
+        nothing twice)."""
+        if self._done:
+            return self.trace_id
+        self._done = True
+        if keep:
+            _REQ_BUFFER.add(
+                Span(
+                    trace_id=self.trace_id,
+                    span_id=self.root_id,
+                    parent_id=self.parent_id,
+                    name=name,
+                    start_s=float(start_s),
+                    end_s=float(end_s),
+                    attrs=dict(attrs) if attrs else {},
+                    pid=os.getpid(),
+                    thread=threading.current_thread().name,
+                )
+            )
+            for s in self.spans:
+                _REQ_BUFFER.add(s)
+        self.spans = []
+        return self.trace_id
+
+
+def request_snapshot(trace_id: Optional[str] = None) -> List[Span]:
+    return _REQ_BUFFER.snapshot(trace_id=trace_id)
+
+
+def request_buffer_len() -> int:
+    return len(_REQ_BUFFER)
+
+
+def clear_requests() -> None:
+    _REQ_BUFFER.clear()
+
+
 # -- export -------------------------------------------------------------------
 
 
@@ -536,13 +702,20 @@ def export_http(
     ``chrome`` (Perfetto-loadable JSON, the default) or ``tree`` (text);
     ``clear`` drains atomically with the snapshot, and composed with
     ``trace_id`` removes ONLY the exported trace — other traces' spans
-    are never dropped unexported."""
+    are never dropped unexported. Exports the union of the actuation
+    ring and the request-span ring (a ``trace_id`` filter naturally
+    scopes to whichever ring holds that trace)."""
     import json
 
     if fmt not in ("chrome", "tree"):
         return 400, "format must be chrome or tree\n", "text/plain"
     spans = (
         _BUFFER.drain(trace_id) if clear else _BUFFER.snapshot(trace_id)
+    )
+    spans += (
+        _REQ_BUFFER.drain(trace_id)
+        if clear
+        else _REQ_BUFFER.snapshot(trace_id)
     )
     if fmt == "tree":
         return 200, render_tree(spans), "text/plain"

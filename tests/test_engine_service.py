@@ -168,8 +168,16 @@ def test_streaming_completion_delivers_every_token(service):
         assert r.headers["Content-Type"].startswith("text/event-stream")
         events, done = await _read_sse(r)
         assert done
-        toks = [e["choices"][0]["token_ids"][0] for e in events]
+        # token chunks carry choices; the final usage chunk (OpenAI
+        # include_usage shape: empty choices) closes the stream
+        tok_events = [e for e in events if e.get("choices")]
+        toks = [e["choices"][0]["token_ids"][0] for e in tok_events]
         assert len(toks) == 5
+        tails = [e for e in events if not e.get("choices")]
+        assert len(tails) == 1 and events[-1] is tails[0]
+        u = tails[0]["usage"]
+        assert u["completion_tokens"] == 5
+        assert "queue_wait_s" in u and "decode_tpot_s" in u
 
         # the streamed tokens match a non-streamed run of the same prompt
         r2 = await client.post(
@@ -230,12 +238,18 @@ def test_chat_completions_roundtrip_and_stream(service):
         )
         assert r.status == 200
         events, done = await _read_sse(r)
-        assert done and len(events) == 4
-        assert events[0]["choices"][0]["delta"]["role"] == "assistant"
+        assert done
+        tok_events = [e for e in events if e.get("choices")]
+        assert len(tok_events) == 4
+        assert tok_events[0]["choices"][0]["delta"]["role"] == "assistant"
         streamed = "".join(
-            e["choices"][0]["delta"]["content"] for e in events
+            e["choices"][0]["delta"]["content"] for e in tok_events
         )
         assert streamed == msg["content"]
+        # the final usage chunk mirrors the non-streamed usage block
+        u = events[-1]["usage"]
+        assert not events[-1]["choices"]
+        assert u["completion_tokens"] == 4 and "queue_wait_s" in u
 
         # malformed messages are 400s
         r = await client.post("/v1/chat/completions", json={"messages": []})
@@ -822,7 +836,10 @@ def test_logit_bias_over_http(service):
         assert r.status == 200
         events, done = await _read_sse(r)
         assert done
-        toks = [t for e in events for t in e["choices"][0]["token_ids"]]
+        toks = [
+            t for e in events if e.get("choices")
+            for t in e["choices"][0]["token_ids"]
+        ]
         assert toks == [23, 23, 23]
 
     run_async(_client(service, scenario))

@@ -198,7 +198,7 @@ def test_server_uses_model_dir_tokenizer(tmp_path, tok_dir):
                     if not line.startswith("data: ") or line == "data: [DONE]":
                         continue
                     ev = json.loads(line[6:])
-                    if "choices" in ev:
+                    if ev.get("choices"):  # skip the final usage chunk
                         texts.append(ev["choices"][0]["text"])
                         toks.extend(ev["choices"][0]["token_ids"])
                 assert "".join(texts) == svc.tokenizer.decode(toks)
